@@ -1,0 +1,25 @@
+"""reporter_tpu — a TPU-native probe→OSMLR map-matching framework.
+
+A ground-up re-design of the capabilities of Open Traffic Reporter
+(burritojustice/reporter) plus the native Valhalla/Meili + OSMLR machinery it
+drives (see SURVEY.md §0–§2; the reference mount was empty, so citations are to
+SURVEY.md sections rather than file:line).
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+  service/    HTTP ``POST /report`` endpoint, per-uuid partial-trace cache,
+              segment filter, datastore publisher            (reference L6/L4)
+  streaming/  replayable ingest queue + staged worker pipeline (Kafka analog, L5)
+  matcher/    ``SegmentMatcher`` backend boundary:
+              ``matcher_backend={reference_cpu, jax}``        (reference L3)
+  ops/        JAX kernels: vmapped point→polyline kNN, emission/transition,
+              ``lax.scan`` Viterbi                            (reference L2, Meili)
+  tiles/      offline tile compiler → flat padded device arrays; OSMLR
+              chaining + association; reachability tables     (reference L1/L0)
+  parallel/   ``jax.sharding`` Mesh: batch data-parallelism and multi-city
+              tile sharding over ICI                          (replaces Kafka scale-out)
+  netgen/     road-network sources: synthetic cities, OSM XML parser,
+              probe-trace synthesis with ground truth
+"""
+
+__version__ = "0.1.0"

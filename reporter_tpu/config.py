@@ -1,0 +1,129 @@
+"""Configuration for reporter_tpu.
+
+Mirrors the reference's two-layer config (SURVEY.md §5 "Config / flag system"):
+a structured matcher/compiler config (the ``valhalla.json`` analog — sigma_z,
+beta, search radius, costing-ish knobs) plus environment variables for service
+wiring (``DATASTORE_URL``, port, thread count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class MatcherParams:
+    """HMM map-matching parameters (the meili section of valhalla.json).
+
+    Defaults follow Meili's documented defaults (SURVEY.md §2.2: emission =
+    Gaussian(GPS error; sigma_z), transition = |route_dist − gc_dist| / beta).
+    """
+
+    sigma_z: float = 4.07          # GPS noise std-dev (m), emission model
+    beta: float = 3.0              # transition scale (m)
+    search_radius: float = 50.0    # candidate search radius (m)
+    max_candidates: int = 8        # top-K candidates per point
+    breakage_distance: float = 2000.0  # consecutive points farther apart break the HMM chain
+    max_route_distance_factor: float = 5.0  # route dist > factor*gc ⇒ transition disallowed
+    interpolation_distance: float = 10.0    # points closer than this are interpolated, not matched
+
+    def replace(self, **kw: Any) -> "MatcherParams":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class CompilerParams:
+    """Offline tile-compiler parameters (the mjolnir/osmlr analog, SURVEY.md §7.1)."""
+
+    cell_size: float = 64.0        # spatial-grid cell edge (m); must be >= search_radius for 3x3 query
+    cell_capacity: int = 32        # max line-segments indexed per cell (padded, -1 sentinel)
+    reach_radius: float = 600.0    # reachability precompute radius (m)
+    reach_max: int = 32            # max reachable target edges kept per edge
+    osmlr_max_length: float = 1000.0  # OSMLR segment chaining target length (m)
+    use_native: bool = True        # use the C++ reach/grid builder when available
+
+
+@dataclass(frozen=True)
+class ServiceConfig:
+    """Service wiring (env-var layer of the reference, SURVEY.md §3.2)."""
+
+    datastore_url: str = ""        # empty ⇒ publishing disabled (logged only)
+    port: int = 8002
+    threads: int = 4
+    cache_ttl: float = 60.0        # per-uuid partial-trace cache TTL (s)
+    cache_max_uuids: int = 100_000
+    min_segment_length: float = 0.0
+    mode: str = "auto"             # report transport mode tag
+
+    def with_env_overrides(self, env: dict[str, str] | None = None) -> "ServiceConfig":
+        """Apply env vars on top of this config; only set variables override."""
+        e = os.environ if env is None else env
+        kw: dict[str, Any] = {}
+        if "DATASTORE_URL" in e:
+            kw["datastore_url"] = e["DATASTORE_URL"]
+        if "REPORTER_PORT" in e:
+            kw["port"] = int(e["REPORTER_PORT"])
+        if "THREAD_POOL_COUNT" in e:
+            kw["threads"] = int(e["THREAD_POOL_COUNT"])
+        if "PARTIAL_TRACE_TTL" in e:
+            kw["cache_ttl"] = float(e["PARTIAL_TRACE_TTL"])
+        if "REPORTER_MODE" in e:
+            kw["mode"] = e["REPORTER_MODE"]
+        return dataclasses.replace(self, **kw) if kw else self
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "ServiceConfig":
+        return cls().with_env_overrides(env)
+
+
+@dataclass(frozen=True)
+class Config:
+    """Top-level structured config (the valhalla.json analog)."""
+
+    matcher: MatcherParams = field(default_factory=MatcherParams)
+    compiler: CompilerParams = field(default_factory=CompilerParams)
+    service: ServiceConfig = field(default_factory=ServiceConfig)
+    matcher_backend: str = "jax"   # {"jax", "reference_cpu"} — the backend boundary
+
+    def validate(self) -> "Config":
+        """Cross-section invariants. The grid's 3×3-gather candidate search is
+        only a superset of the radius ball when cells are at least radius-sized
+        (tiles/compiler._build_grid)."""
+        if self.compiler.cell_size < self.matcher.search_radius:
+            raise ValueError(
+                f"compiler.cell_size ({self.compiler.cell_size}) must be >= "
+                f"matcher.search_radius ({self.matcher.search_radius}) for the "
+                "3x3 grid gather to cover the search radius")
+        if self.matcher_backend not in ("jax", "reference_cpu"):
+            raise ValueError(f"unknown matcher_backend {self.matcher_backend!r}")
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Config":
+        raw = json.loads(text)
+        return cls(
+            matcher=MatcherParams(**raw.get("matcher", {})),
+            compiler=CompilerParams(**raw.get("compiler", {})),
+            service=ServiceConfig(**raw.get("service", {})),
+            matcher_backend=raw.get("matcher_backend", "jax"),
+        )
+
+    @classmethod
+    def load(cls, path: str | None = None) -> "Config":
+        """Load from a JSON file if given/exists; env vars that are actually
+        set override the file's service section (the reference's two-layer
+        precedence, SURVEY.md §5)."""
+        if path and os.path.exists(path):
+            with open(path) as f:
+                cfg = cls.from_json(f.read())
+        else:
+            cfg = cls()
+        cfg = dataclasses.replace(cfg, service=cfg.service.with_env_overrides())
+        return cfg.validate()

@@ -1,0 +1,7 @@
+"""Road-network sources: synthetic cities, OSM XML parsing, probe synthesis."""
+
+from reporter_tpu.netgen.network import RoadNetwork, Way
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.osm_xml import parse_osm_xml
+
+__all__ = ["RoadNetwork", "Way", "generate_city", "parse_osm_xml"]

@@ -1,0 +1,51 @@
+"""RoadNetwork — the intermediate representation between data sources and the
+tile compiler.
+
+Plays the role of the parsed-OSM stage inside the reference's offline pipeline
+(SURVEY.md §3.4: OSM extract → valhalla_build_tiles → graph tiles): sources
+(synthetic generator, OSM XML parser) produce a RoadNetwork; the compiler
+(reporter_tpu.tiles.compiler) lowers it to flat device arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class Way:
+    """A drivable way: an ordered chain of node indices, optionally with
+    intermediate shape geometry per leg (lonlat points strictly between the
+    leg's endpoint nodes)."""
+
+    way_id: int
+    nodes: list[int]                     # indices into RoadNetwork.node_lonlat
+    oneway: bool = False
+    name: str = ""
+    speed_mps: float = 13.4              # free-flow speed, ~30 mph default
+    # leg index i (between nodes[i] and nodes[i+1]) → [k, 2] lonlat shape points
+    geometry: dict[int, np.ndarray] = field(default_factory=dict)
+
+
+@dataclass
+class RoadNetwork:
+    """Graph-agnostic road network: nodes in lon/lat + ways."""
+
+    node_lonlat: np.ndarray              # [N, 2] float64 (lon, lat) degrees
+    ways: list[Way]
+    name: str = "net"
+
+    @property
+    def num_nodes(self) -> int:
+        return int(len(self.node_lonlat))
+
+    def bbox(self) -> tuple[np.ndarray, np.ndarray]:
+        lo = self.node_lonlat.min(axis=0)
+        hi = self.node_lonlat.max(axis=0)
+        return lo, hi
+
+    def origin(self) -> np.ndarray:
+        lo, hi = self.bbox()
+        return (lo + hi) / 2.0

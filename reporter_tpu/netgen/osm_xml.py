@@ -1,0 +1,86 @@
+"""Minimal OSM XML parser → RoadNetwork.
+
+Capability-parity stand-in for the front of the reference's offline pipeline
+(SURVEY.md §3.4: OSM extract → valhalla_build_tiles). Supports the subset
+needed to build a drivable graph: <node> elements and <way> elements tagged
+``highway=*`` from a drivable whitelist, with ``oneway`` and ``maxspeed``
+handling. PBF input is out of scope (no protobuf OSM fixtures available here);
+the XML path exercises the same compiler.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from reporter_tpu.netgen.network import RoadNetwork, Way
+
+DRIVABLE_HIGHWAY = {
+    "motorway", "trunk", "primary", "secondary", "tertiary", "unclassified",
+    "residential", "service", "motorway_link", "trunk_link", "primary_link",
+    "secondary_link", "tertiary_link", "living_street",
+}
+
+_DEFAULT_SPEED = {  # m/s by highway class
+    "motorway": 29.0, "trunk": 24.5, "primary": 17.9, "secondary": 15.6,
+    "tertiary": 13.4, "residential": 11.2, "service": 6.7, "living_street": 4.5,
+}
+
+
+def _speed_mps(tags: dict[str, str]) -> float:
+    ms = tags.get("maxspeed", "")
+    try:
+        if ms.endswith("mph"):
+            return float(ms[:-3].strip()) * 0.44704
+        if ms:
+            return float(ms) / 3.6
+    except ValueError:
+        pass
+    hw = tags.get("highway", "")
+    return _DEFAULT_SPEED.get(hw.removesuffix("_link"), 13.4)
+
+
+def parse_osm_xml(source: str, name: str = "osm") -> RoadNetwork:
+    """Parse an .osm XML document (path or XML string) into a RoadNetwork."""
+    if source.lstrip().startswith("<"):
+        root = ET.fromstring(source)
+    else:
+        root = ET.parse(source).getroot()
+
+    node_pos: dict[int, tuple[float, float]] = {}
+    for nd in root.iter("node"):
+        node_pos[int(nd.get("id"))] = (float(nd.get("lon")), float(nd.get("lat")))
+
+    raw_ways: list[tuple[int, list[int], dict[str, str]]] = []
+    for w in root.iter("way"):
+        tags = {t.get("k"): t.get("v") for t in w.findall("tag")}
+        if tags.get("highway") not in DRIVABLE_HIGHWAY:
+            continue
+        refs = [int(nd.get("ref")) for nd in w.findall("nd")]
+        refs = [r for r in refs if r in node_pos]
+        if len(refs) >= 2:
+            raw_ways.append((int(w.get("id")), refs, tags))
+
+    # Keep only nodes referenced by drivable ways; remap to dense indices.
+    used: dict[int, int] = {}
+    for _, refs, _ in raw_ways:
+        for r in refs:
+            if r not in used:
+                used[r] = len(used)
+    lonlat = np.zeros((len(used), 2), dtype=np.float64)
+    for osm_id, idx in used.items():
+        lonlat[idx] = node_pos[osm_id]
+
+    ways: list[Way] = []
+    for way_id, refs, tags in raw_ways:
+        ow = tags.get("oneway", "no") in ("yes", "true", "1")
+        nodes = [used[r] for r in refs]
+        if tags.get("oneway") == "-1":
+            nodes = nodes[::-1]
+            ow = True
+        ways.append(
+            Way(way_id=way_id, nodes=nodes, oneway=ow,
+                name=tags.get("name", ""), speed_mps=_speed_mps(tags))
+        )
+    return RoadNetwork(node_lonlat=lonlat, ways=ways, name=name)

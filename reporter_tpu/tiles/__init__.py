@@ -1,0 +1,12 @@
+"""Offline tile pipeline: RoadNetwork → flat, padded, TPU-resident arrays.
+
+TPU-native replacement for the reference's L1/L0 (SURVEY.md §1): Valhalla's
+baldr graph tiles + mjolnir tile build + OSMLR generation/association. Instead
+of pointer-rich C++ tiles read at match time, everything the online matcher
+needs is compiled offline into fixed-shape arrays that live in HBM.
+"""
+
+from reporter_tpu.tiles.tileset import TileSet, TileMeta
+from reporter_tpu.tiles.compiler import compile_network
+
+__all__ = ["TileSet", "TileMeta", "compile_network"]

@@ -1,0 +1,265 @@
+"""Tile compiler: RoadNetwork → TileSet.
+
+One offline pass replacing the reference's whole L0 pipeline (SURVEY.md §3.4):
+
+  valhalla_build_tiles  → directed-edge/node arrays + shape decomposition
+  osmlr generation      → directional segment chaining (~1 km target length)
+  associate_segments    → edge→OSMLR row + offset arrays
+  (new, TPU-first)      → padded spatial grid over line segments, and
+                          reachability tables (tiles/reach.py) that replace
+                          match-time Dijkstra with offline precompute
+
+Everything downstream is fixed-shape: the matcher never touches the
+RoadNetwork again.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from reporter_tpu.config import CompilerParams
+from reporter_tpu.geometry import lonlat_to_xy
+from reporter_tpu.netgen.network import RoadNetwork
+from reporter_tpu.tiles.tileset import TileMeta, TileSet
+
+
+def _build_edges(net: RoadNetwork, node_xy: np.ndarray, origin: np.ndarray):
+    """Directed edges + per-edge polylines from ways."""
+    src: list[int] = []
+    dst: list[int] = []
+    way: list[int] = []
+    speed: list[float] = []
+    shapes: list[np.ndarray] = []          # per-edge [k>=2, 2] xy polyline
+    fwd_of_leg: dict[tuple[int, int], int] = {}   # (way_idx, leg) → fwd edge id
+    rev_of_leg: dict[tuple[int, int], int] = {}
+
+    for wi, w in enumerate(net.ways):
+        for leg in range(len(w.nodes) - 1):
+            a, b = w.nodes[leg], w.nodes[leg + 1]
+            mid_ll = w.geometry.get(leg)
+            if mid_ll is not None and len(mid_ll):
+                mid = lonlat_to_xy(mid_ll, origin)
+                poly = np.vstack([node_xy[a][None], mid, node_xy[b][None]])
+            else:
+                poly = np.vstack([node_xy[a][None], node_xy[b][None]])
+            fwd_of_leg[(wi, leg)] = len(src)
+            src.append(a); dst.append(b); way.append(w.way_id); speed.append(w.speed_mps)
+            shapes.append(poly.astype(np.float32))
+            if not w.oneway:
+                rev_of_leg[(wi, leg)] = len(src)
+                src.append(b); dst.append(a); way.append(w.way_id); speed.append(w.speed_mps)
+                shapes.append(poly[::-1].astype(np.float32))
+
+    E = len(src)
+    edge_opp = np.full(E, -1, dtype=np.int32)
+    for key, f in fwd_of_leg.items():
+        r = rev_of_leg.get(key)
+        if r is not None:
+            edge_opp[f] = r
+            edge_opp[r] = f
+    return (
+        np.asarray(src, np.int32), np.asarray(dst, np.int32),
+        np.asarray(way, np.int64), np.asarray(speed, np.float32),
+        shapes, edge_opp, fwd_of_leg, rev_of_leg,
+    )
+
+
+def _chain_osmlr(net: RoadNetwork, edge_len: np.ndarray, fwd_of_leg, rev_of_leg,
+                 max_len: float):
+    """Directional OSMLR chaining: consecutive edges of a way (per direction)
+    are grouped into segments of ~max_len meters. Stable id packs
+    (way_id, direction, chunk). Real OSMLR can cross way boundaries; chaining
+    within a way preserves the association *behavior* (stable ≤~1 km linear
+    references with per-edge offsets, SURVEY.md §2.2 "OSMLR segments")."""
+    E = len(edge_len)
+    edge_osmlr = np.full(E, -1, dtype=np.int32)
+    edge_osmlr_off = np.zeros(E, dtype=np.float32)
+    osmlr_ids: list[int] = []
+    osmlr_lens: list[float] = []
+
+    def chain(edge_ids: list[int], way_id: int, direction: int) -> None:
+        chunk = 0
+        cur: list[int] = []
+        cur_len = 0.0
+        def flush() -> None:
+            nonlocal chunk, cur, cur_len
+            if not cur:
+                return
+            row = len(osmlr_ids)
+            osmlr_ids.append((way_id << 20) | (direction << 19) | chunk)
+            off = 0.0
+            for e in cur:
+                edge_osmlr[e] = row
+                edge_osmlr_off[e] = off
+                off += float(edge_len[e])
+            osmlr_lens.append(off)
+            chunk += 1
+            cur = []
+            cur_len = 0.0
+        for e in edge_ids:
+            if cur and cur_len + float(edge_len[e]) > max_len:
+                flush()
+            cur.append(e)
+            cur_len += float(edge_len[e])
+        flush()
+
+    for wi, w in enumerate(net.ways):
+        legs = range(len(w.nodes) - 1)
+        fwd = [fwd_of_leg[(wi, leg)] for leg in legs]
+        chain(fwd, w.way_id, 0)
+        if not w.oneway:
+            rev = [rev_of_leg[(wi, leg)] for leg in reversed(list(legs))]
+            chain(rev, w.way_id, 1)
+
+    return (edge_osmlr, edge_osmlr_off,
+            np.asarray(osmlr_ids, np.int64), np.asarray(osmlr_lens, np.float32))
+
+
+def _decompose_segments(shapes: list[np.ndarray]):
+    """Edge polylines → flat line-segment arrays (the kNN index unit)."""
+    seg_a, seg_b, seg_edge, seg_off = [], [], [], []
+    edge_len = np.zeros(len(shapes), dtype=np.float32)
+    for e, poly in enumerate(shapes):
+        off = 0.0
+        for i in range(len(poly) - 1):
+            a, b = poly[i], poly[i + 1]
+            L = float(np.linalg.norm(b - a))
+            if L <= 1e-6:
+                continue
+            seg_a.append(a); seg_b.append(b); seg_edge.append(e); seg_off.append(off)
+            off += L
+        edge_len[e] = off
+    seg_a = np.asarray(seg_a, np.float32).reshape(-1, 2)
+    seg_b = np.asarray(seg_b, np.float32).reshape(-1, 2)
+    seg_len = np.linalg.norm(seg_b - seg_a, axis=1).astype(np.float32)
+    return (seg_a, seg_b, np.asarray(seg_edge, np.int32),
+            np.asarray(seg_off, np.float32), seg_len, edge_len)
+
+
+def _build_grid(seg_a: np.ndarray, seg_b: np.ndarray, cell_size: float, capacity: int):
+    """Padded uniform grid over line segments.
+
+    A segment is registered in every cell its bbox overlaps; with
+    cell_size >= search_radius, a 3×3 gather around the query point's cell is
+    a superset of all segments within the radius (SURVEY.md §7.2a)."""
+    lo = np.minimum(seg_a, seg_b).min(axis=0) - 1.0
+    hi = np.maximum(seg_a, seg_b).max(axis=0) + 1.0
+    gw = max(1, int(np.ceil((hi[0] - lo[0]) / cell_size)))
+    gh = max(1, int(np.ceil((hi[1] - lo[1]) / cell_size)))
+    grid = np.full((gw * gh, capacity), -1, dtype=np.int32)
+    counts = np.zeros(gw * gh, dtype=np.int32)
+    overflow = 0
+
+    smin = np.minimum(seg_a, seg_b)
+    smax = np.maximum(seg_a, seg_b)
+    c0 = np.floor((smin - lo) / cell_size).astype(np.int64)
+    c1 = np.floor((smax - lo) / cell_size).astype(np.int64)
+    c0 = np.clip(c0, 0, [gw - 1, gh - 1])
+    c1 = np.clip(c1, 0, [gw - 1, gh - 1])
+    for s in range(len(seg_a)):
+        for cx in range(c0[s, 0], c1[s, 0] + 1):
+            for cy in range(c0[s, 1], c1[s, 1] + 1):
+                cell = cx * gh + cy
+                if counts[cell] < capacity:
+                    grid[cell, counts[cell]] = s
+                    counts[cell] += 1
+                else:
+                    overflow += 1
+    return grid, (gw, gh), lo.astype(np.float64), overflow
+
+
+def _build_node_out(num_nodes: int, edge_src: np.ndarray):
+    order = np.argsort(edge_src, kind="stable")
+    degree = np.bincount(edge_src, minlength=num_nodes)
+    dmax = max(1, int(degree.max()) if len(degree) else 1)
+    node_out = np.full((num_nodes, dmax), -1, dtype=np.int32)
+    fill = np.zeros(num_nodes, dtype=np.int32)
+    for e in order:
+        u = edge_src[e]
+        node_out[u, fill[u]] = e
+        fill[u] += 1
+    return node_out
+
+
+def compile_network(net: RoadNetwork, params: CompilerParams | None = None) -> TileSet:
+    """Compile a RoadNetwork into a device-ready TileSet."""
+    params = params or CompilerParams()
+    if net.num_nodes == 0 or not net.ways:
+        raise ValueError(
+            f"RoadNetwork {net.name!r} has no drivable ways/nodes; nothing to compile")
+    t0 = time.time()
+    origin = net.origin()
+    node_xy = lonlat_to_xy(net.node_lonlat, origin).astype(np.float32)
+
+    (edge_src, edge_dst, edge_way, edge_speed,
+     shapes, edge_opp, fwd_of_leg, rev_of_leg) = _build_edges(net, node_xy, origin)
+
+    seg_a, seg_b, seg_edge, seg_off, seg_len, edge_len = _decompose_segments(shapes)
+
+    edge_osmlr, edge_osmlr_off, osmlr_id, osmlr_len = _chain_osmlr(
+        net, edge_len, fwd_of_leg, rev_of_leg, params.osmlr_max_length)
+
+    grid, grid_dims, grid_origin, overflow = _build_grid(
+        seg_a, seg_b, params.cell_size, params.cell_capacity)
+
+    node_out = _build_node_out(net.num_nodes, edge_src)
+
+    reach_to, reach_dist, reach_next, reach_truncated = _build_reach(
+        node_out, edge_src, edge_dst, edge_len, params)
+
+    if overflow:
+        import warnings
+
+        warnings.warn(
+            f"{net.name}: spatial grid dropped {overflow} segment registrations "
+            f"(cell_capacity={params.cell_capacity} too small); candidate search "
+            "may miss roads in dense cells", stacklevel=2)
+
+    meta = TileMeta(
+        grid_origin=(float(grid_origin[0]), float(grid_origin[1])),
+        cell_size=float(params.cell_size),
+        grid_dims=grid_dims,
+        origin_lonlat=(float(origin[0]), float(origin[1])),
+    )
+    ts = TileSet(
+        name=net.name, meta=meta,
+        node_xy=node_xy, node_out=node_out,
+        edge_src=edge_src, edge_dst=edge_dst, edge_len=edge_len,
+        edge_way=edge_way, edge_speed=edge_speed, edge_opp=edge_opp,
+        edge_osmlr=edge_osmlr, edge_osmlr_off=edge_osmlr_off,
+        osmlr_id=osmlr_id, osmlr_len=osmlr_len,
+        seg_a=seg_a, seg_b=seg_b, seg_edge=seg_edge, seg_off=seg_off, seg_len=seg_len,
+        grid=grid,
+        reach_to=reach_to, reach_dist=reach_dist, reach_next=reach_next,
+        stats={
+            "nodes": int(net.num_nodes), "edges": int(len(edge_len)),
+            "line_segments": int(len(seg_a)), "osmlr_segments": int(len(osmlr_id)),
+            "grid_cells": int(grid_dims[0] * grid_dims[1]),
+            "grid_overflow": int(overflow),
+            "reach_truncated_nodes": int(reach_truncated),
+            "compile_seconds": round(time.time() - t0, 3),
+        },
+    )
+    return ts
+
+
+def _build_reach(node_out, edge_src, edge_dst, edge_len, params: CompilerParams):
+    """Reach tables via the native C++ builder when available, else Python."""
+    if params.use_native:
+        try:
+            from reporter_tpu.tiles.native import build_reach_native
+
+            out = build_reach_native(
+                node_out, edge_src, edge_dst, edge_len,
+                params.reach_radius, params.reach_max)
+            if out is not None:
+                return out  # (reach_to, reach_dist, reach_next, truncated)
+        except ImportError:
+            pass
+    from reporter_tpu.tiles.reach import build_reach_tables
+
+    return build_reach_tables(
+        node_out, edge_src, edge_dst, edge_len,
+        params.reach_radius, params.reach_max)

@@ -1,0 +1,126 @@
+"""Reachability tables: bounded all-pairs-nearby network distances.
+
+This is the TPU-first answer to SURVEY.md §7's hardest part, "transition costs
+without Dijkstra": Meili runs a label-set Dijkstra between candidate pairs at
+match time (SURVEY.md §2.2 "Inter-candidate routing" — the dominant cost of
+the reference's hot loop, §3.1). A data-dependent priority queue cannot run on
+the MXU, so we move the graph search OFFLINE: for every directed edge ``e``,
+precompute the network distance from the END of ``e`` to the START of every
+edge reachable within ``radius`` meters, keep the ``M`` nearest, and store
+them as fixed-shape [E, M] tables. At match time a transition cost is then a
+single gather + compare — exactly what the TPU is good at. ``reach_next``
+(first edge of each path) lets the host reconstruct full paths after Viterbi
+by repeated next-hop lookup, replacing Meili's edge walk.
+
+A C++ builder (native/reach.cc) accelerates this for large metros; this module
+is the reference implementation and fallback.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+
+def node_dijkstra(
+    u: int,
+    node_out: np.ndarray,
+    edge_dst: np.ndarray,
+    edge_len: np.ndarray,
+    radius: float,
+) -> dict[int, tuple[float, int]]:
+    """Single-source bounded Dijkstra over nodes.
+
+    Returns {node v: (dist(u→v), first_edge_id on a shortest path)}; u itself
+    maps to (0.0, -1).
+    """
+    dist: dict[int, float] = {u: 0.0}
+    first: dict[int, int] = {u: -1}
+    pq: list[tuple[float, int]] = [(0.0, u)]
+    while pq:
+        d, v = heapq.heappop(pq)
+        if d > dist.get(v, np.inf):
+            continue
+        for e in node_out[v]:
+            if e < 0:
+                break
+            w = int(edge_dst[e])
+            nd = d + float(edge_len[e])
+            if nd <= radius and nd < dist.get(w, np.inf):
+                dist[w] = nd
+                first[w] = int(e) if v == u else first[v]
+                heapq.heappush(pq, (nd, w))
+    return {v: (dist[v], first[v]) for v in dist}
+
+
+def build_reach_tables(
+    node_out: np.ndarray,
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    edge_len: np.ndarray,
+    radius: float,
+    max_targets: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Build (reach_to, reach_dist, reach_next, truncated_nodes); tables are
+    each [E, max_targets].
+
+    For edge e ending at node u, targets are out-edges e' of every node v with
+    d(u, v) <= radius; reach_dist = d(u, src(e')), reach_next = first edge of
+    the u→v path (or e' itself when v == u, i.e. e' directly follows e).
+    Rows are sorted by distance; -1/inf padded. One Dijkstra per *node*, shared
+    by all its incoming edges.
+    """
+    num_nodes = len(node_out)
+    num_edges = len(edge_src)
+    reach_to = np.full((num_edges, max_targets), -1, dtype=np.int32)
+    reach_dist = np.full((num_edges, max_targets), np.inf, dtype=np.float32)
+    reach_next = np.full((num_edges, max_targets), -1, dtype=np.int32)
+
+    # Per-node target rows, computed once, then broadcast to incoming edges.
+    truncated = 0
+    node_rows: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    for u in range(num_nodes):
+        reached = node_dijkstra(u, node_out, edge_dst, edge_len, radius)
+        tos: list[int] = []
+        dists: list[float] = []
+        nexts: list[int] = []
+        for v, (d, fe) in reached.items():
+            for e2 in node_out[v]:
+                if e2 < 0:
+                    break
+                tos.append(int(e2))
+                dists.append(d)
+                nexts.append(int(e2) if v == u else fe)
+        if not tos:
+            node_rows.append(
+                (np.empty(0, np.int32), np.empty(0, np.float32), np.empty(0, np.int32))
+            )
+            continue
+        order = np.lexsort((np.asarray(tos), np.asarray(dists)))
+        if len(order) > max_targets:
+            truncated += 1
+            order = order[:max_targets]
+        node_rows.append(
+            (
+                np.asarray(tos, np.int32)[order],
+                np.asarray(dists, np.float32)[order],
+                np.asarray(nexts, np.int32)[order],
+            )
+        )
+
+    for e in range(num_edges):
+        tos, dists, nexts = node_rows[int(edge_dst[e])]
+        k = len(tos)
+        reach_to[e, :k] = tos
+        reach_dist[e, :k] = dists
+        reach_next[e, :k] = nexts
+
+    return reach_to, reach_dist, reach_next, truncated
+
+
+def reach_lookup(reach_to: np.ndarray, reach_dist: np.ndarray, e1: int, e2: int) -> float:
+    """Network distance end-of-e1 → start-of-e2, inf if outside the table."""
+    row = reach_to[e1]
+    hit = np.nonzero(row == e2)[0]
+    return float(reach_dist[e1, hit[0]]) if len(hit) else float(np.inf)

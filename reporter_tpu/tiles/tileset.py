@@ -1,0 +1,140 @@
+"""TileSet — the compiled, device-ready road graph for one metro.
+
+Replaces the online role of Valhalla's GraphTile/GraphReader (SURVEY.md §2.2
+"Graph tiles"): no pointer chasing, no tile fetch — every array is flat,
+fixed-dtype, padded with sentinels, and can be staged to TPU HBM once and
+reused across every match batch.
+
+Array glossary (sizes: N nodes, E directed edges, S line segments, G OSMLR
+segments, C grid cell capacity, M reach-table width):
+
+  node_xy        f32 [N,2]   node position, tile-local meters
+  node_out       i32 [N,D]   outgoing directed-edge ids, -1 padded
+  edge_src/dst   i32 [E]     endpoint node ids
+  edge_len       f32 [E]     polyline length (m)
+  edge_way       i64 [E]     source way id (OSM way analog)
+  edge_speed     f32 [E]     free-flow speed (m/s)
+  edge_opp       i32 [E]     opposite directed edge, -1 if one-way
+  edge_osmlr     i32 [E]     OSMLR table row, -1 if unassociated
+  edge_osmlr_off f32 [E]     meters from OSMLR segment start to edge start
+  osmlr_id       i64 [G]     stable OSMLR segment id
+  osmlr_len      f32 [G]     full segment length (m)
+  seg_a/seg_b    f32 [S,2]   line-segment endpoints (edge shapes decomposed)
+  seg_edge       i32 [S]     owning directed edge
+  seg_off        f32 [S]     distance along edge at seg_a
+  seg_len        f32 [S]     |seg_b - seg_a|
+  grid           i32 [ncells,C]  line-segment ids per spatial cell, -1 padded
+  reach_to       i32 [E,M]   nearby reachable target edges, -1 padded
+  reach_dist     f32 [E,M]   network distance end-of-e → start-of-target (m)
+  reach_next     i32 [E,M]   first edge of that path (next-hop, for host walk)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, NamedTuple
+
+import numpy as np
+
+_ARRAY_FIELDS = (
+    "node_xy", "node_out",
+    "edge_src", "edge_dst", "edge_len", "edge_way", "edge_speed", "edge_opp",
+    "edge_osmlr", "edge_osmlr_off",
+    "osmlr_id", "osmlr_len",
+    "seg_a", "seg_b", "seg_edge", "seg_off", "seg_len",
+    "grid",
+    "reach_to", "reach_dist", "reach_next",
+)
+
+
+class TileMeta(NamedTuple):
+    """Static (trace-time-constant) grid/projection metadata."""
+
+    grid_origin: tuple[float, float]   # xy of cell (0, 0) lower-left corner
+    cell_size: float
+    grid_dims: tuple[int, int]         # (gw, gh); grid array is [gw*gh, C]
+    origin_lonlat: tuple[float, float]
+
+
+@dataclass
+class TileSet:
+    name: str
+    meta: TileMeta
+    node_xy: np.ndarray
+    node_out: np.ndarray
+    edge_src: np.ndarray
+    edge_dst: np.ndarray
+    edge_len: np.ndarray
+    edge_way: np.ndarray
+    edge_speed: np.ndarray
+    edge_opp: np.ndarray
+    edge_osmlr: np.ndarray
+    edge_osmlr_off: np.ndarray
+    osmlr_id: np.ndarray
+    osmlr_len: np.ndarray
+    seg_a: np.ndarray
+    seg_b: np.ndarray
+    seg_edge: np.ndarray
+    seg_off: np.ndarray
+    seg_len: np.ndarray
+    grid: np.ndarray
+    reach_to: np.ndarray
+    reach_dist: np.ndarray
+    reach_next: np.ndarray
+    stats: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def num_edges(self) -> int:
+        return int(len(self.edge_len))
+
+    @property
+    def num_nodes(self) -> int:
+        return int(len(self.node_xy))
+
+    # ---- persistence ----------------------------------------------------
+
+    def save(self, path: str) -> None:
+        import json
+
+        if not path.endswith(".npz"):
+            path += ".npz"  # savez appends it; normalize so load(path) matches
+        payload = {f: getattr(self, f) for f in _ARRAY_FIELDS}
+        payload["_meta"] = np.frombuffer(
+            json.dumps({"name": self.name, "meta": list(self.meta), "stats": self.stats}).encode(),
+            dtype=np.uint8,
+        )
+        np.savez_compressed(path, **payload)
+
+    @classmethod
+    def load(cls, path: str) -> "TileSet":
+        import json
+
+        if not path.endswith(".npz"):
+            path += ".npz"
+        with np.load(path) as z:
+            raw = json.loads(bytes(z["_meta"]).decode())
+            arrays = {f: z[f] for f in _ARRAY_FIELDS}
+        go, cs, gd, ol = raw["meta"]
+        meta = TileMeta(tuple(go), float(cs), tuple(gd), tuple(ol))
+        return cls(name=raw["name"], meta=meta, stats=raw.get("stats", {}), **arrays)
+
+    # ---- device staging --------------------------------------------------
+
+    def device_tables(self) -> dict[str, Any]:
+        """The subset of arrays the on-device matcher kernels consume, as a
+        plain dict pytree of jnp arrays (HBM-resident after first use)."""
+        import jax.numpy as jnp
+
+        return {
+            "seg_a": jnp.asarray(self.seg_a),
+            "seg_b": jnp.asarray(self.seg_b),
+            "seg_edge": jnp.asarray(self.seg_edge),
+            "seg_off": jnp.asarray(self.seg_off),
+            "grid": jnp.asarray(self.grid),
+            "edge_len": jnp.asarray(self.edge_len),
+            "reach_to": jnp.asarray(self.reach_to),
+            "reach_dist": jnp.asarray(self.reach_dist),
+        }
+
+    def hbm_bytes(self) -> int:
+        return int(sum(getattr(self, f).nbytes for f in _ARRAY_FIELDS))

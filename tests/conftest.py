@@ -1,0 +1,43 @@
+"""Test harness config.
+
+Multi-device tests run on a virtual 8-device CPU mesh (SURVEY.md §4:
+"multi-device tests without a cluster") — flags must be set before jax is
+first imported, hence the env mutation at module import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import CompilerParams
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.tiles.compiler import compile_network
+
+
+@pytest.fixture(scope="session")
+def tiny_city():
+    return generate_city("tiny")
+
+
+@pytest.fixture(scope="session")
+def tiny_tiles(tiny_city):
+    return compile_network(tiny_city, CompilerParams(reach_radius=500.0))
+
+
+@pytest.fixture(scope="session")
+def sf_tiles():
+    """A mid-size city for accuracy/throughput-shape tests."""
+    return compile_network(generate_city("sf"), CompilerParams())
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,61 @@
+import numpy as np
+
+from reporter_tpu.geometry import (
+    great_circle_m,
+    lonlat_to_xy,
+    point_segment_project,
+    polyline_length,
+    xy_to_lonlat,
+)
+
+
+def test_projection_roundtrip():
+    origin = np.array([-122.4194, 37.7749])
+    rng = np.random.default_rng(0)
+    lonlat = origin + rng.uniform(-0.05, 0.05, size=(100, 2))
+    xy = lonlat_to_xy(lonlat, origin)
+    back = xy_to_lonlat(xy, origin)
+    np.testing.assert_allclose(back, lonlat, atol=1e-9)
+
+
+def test_projection_matches_great_circle_locally():
+    origin = np.array([-122.4194, 37.7749])
+    a = np.array([-122.42, 37.775])
+    b = np.array([-122.41, 37.78])
+    xy = lonlat_to_xy(np.stack([a, b]), origin)
+    d_proj = np.linalg.norm(xy[0] - xy[1])
+    d_gc = great_circle_m(a, b)
+    assert abs(d_proj - d_gc) / d_gc < 1e-3  # sub-meter at ~1 km
+
+
+def test_point_segment_project_basics():
+    a = np.array([0.0, 0.0])
+    b = np.array([10.0, 0.0])
+    # interior projection
+    d, t, p = point_segment_project(np.array([5.0, 3.0]), a, b)
+    assert np.isclose(d, 3.0) and np.isclose(t, 0.5)
+    np.testing.assert_allclose(p, [5.0, 0.0])
+    # clamped to endpoint
+    d, t, p = point_segment_project(np.array([-4.0, 3.0]), a, b)
+    assert np.isclose(d, 5.0) and t == 0.0
+    # degenerate segment
+    d, t, p = point_segment_project(np.array([1.0, 1.0]), a, a)
+    assert np.isclose(d, np.sqrt(2.0))
+
+
+def test_point_segment_project_broadcasts():
+    rng = np.random.default_rng(1)
+    p = rng.normal(size=(7, 1, 2))
+    a = rng.normal(size=(1, 5, 2))
+    b = rng.normal(size=(1, 5, 2))
+    d, t, proj = point_segment_project(p, a, b)
+    assert d.shape == (7, 5) and proj.shape == (7, 5, 2)
+    # brute check one entry
+    d0, _, _ = point_segment_project(p[3, 0], a[0, 2], b[0, 2])
+    assert np.isclose(d[3, 2], d0)
+
+
+def test_polyline_length():
+    pts = np.array([[0.0, 0.0], [3.0, 4.0], [3.0, 10.0]])
+    assert np.isclose(polyline_length(pts), 11.0)
+    assert polyline_length(pts[:1]) == 0.0

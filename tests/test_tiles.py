@@ -1,0 +1,202 @@
+"""Tile compiler invariants + reachability correctness vs brute Dijkstra."""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.geometry import point_segment_project
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_probe
+from reporter_tpu.tiles.reach import node_dijkstra, reach_lookup
+
+
+def test_city_generation_deterministic():
+    a = generate_city("tiny")
+    b = generate_city("tiny")
+    np.testing.assert_array_equal(a.node_lonlat, b.node_lonlat)
+    assert len(a.ways) == len(b.ways)
+    assert all(x.nodes == y.nodes for x, y in zip(a.ways, b.ways))
+
+
+def test_compiler_basic_invariants(tiny_tiles):
+    ts = tiny_tiles
+    E = ts.num_edges
+    assert E > 0
+    assert (ts.edge_len > 0).all()
+    assert (ts.edge_src >= 0).all() and (ts.edge_src < ts.num_nodes).all()
+    assert (ts.edge_dst >= 0).all() and (ts.edge_dst < ts.num_nodes).all()
+    # opposite-edge involution
+    has_opp = ts.edge_opp >= 0
+    idx = np.nonzero(has_opp)[0]
+    assert (ts.edge_opp[ts.edge_opp[idx]] == idx).all()
+    assert (ts.edge_src[ts.edge_opp[idx]] == ts.edge_dst[idx]).all()
+    # line segments partition edges
+    np.testing.assert_allclose(
+        np.bincount(ts.seg_edge, weights=ts.seg_len, minlength=E),
+        ts.edge_len, rtol=1e-4)
+    # node_out lists exactly the out-edges
+    for u in range(0, ts.num_nodes, 7):
+        outs = sorted(int(e) for e in ts.node_out[u] if e >= 0)
+        assert outs == sorted(np.nonzero(ts.edge_src == u)[0].tolist())
+
+
+def test_osmlr_association(tiny_tiles):
+    ts = tiny_tiles
+    assoc = ts.edge_osmlr >= 0
+    assert assoc.all(), "every drivable edge should belong to an OSMLR segment"
+    assert len(np.unique(ts.osmlr_id)) == len(ts.osmlr_id), "ids must be unique"
+    # per-segment: edge offsets + lengths reconstruct the segment length
+    for row in range(0, len(ts.osmlr_id), 5):
+        edges = np.nonzero(ts.edge_osmlr == row)[0]
+        assert len(edges)
+        order = np.argsort(ts.edge_osmlr_off[edges])
+        edges = edges[order]
+        off = 0.0
+        for e in edges:
+            assert np.isclose(ts.edge_osmlr_off[e], off, atol=1e-3)
+            off += float(ts.edge_len[e])
+        assert np.isclose(ts.osmlr_len[row], off, atol=1e-2)
+        # consecutive edges are graph-connected
+        for e1, e2 in zip(edges[:-1], edges[1:]):
+            assert ts.edge_dst[e1] == ts.edge_src[e2]
+
+
+def test_grid_covers_radius(tiny_tiles, rng):
+    """Every line segment within `radius` of a query point must appear in the
+    3×3 cell gather (the correctness contract of the kNN grid)."""
+    ts = tiny_tiles
+    radius = 50.0
+    assert ts.meta.cell_size >= radius
+    gw, gh = ts.meta.grid_dims
+    ox, oy = ts.meta.grid_origin
+    for _ in range(50):
+        p = ts.node_xy[rng.integers(ts.num_nodes)] + rng.normal(0, 30, 2)
+        d, _, _ = point_segment_project(p[None, :], ts.seg_a, ts.seg_b)
+        want = set(np.nonzero(d <= radius)[0].tolist())
+        cx = int(np.floor((p[0] - ox) / ts.meta.cell_size))
+        cy = int(np.floor((p[1] - oy) / ts.meta.cell_size))
+        got = set()
+        for dx in (-1, 0, 1):
+            for dy in (-1, 0, 1):
+                x, y = cx + dx, cy + dy
+                if 0 <= x < gw and 0 <= y < gh:
+                    got.update(int(s) for s in ts.grid[x * gh + y] if s >= 0)
+        missing = want - got
+        assert not missing, f"grid missed segments {missing} near {p}"
+
+
+def test_reach_tables_match_brute_dijkstra(tiny_tiles, rng):
+    ts = tiny_tiles
+    for e1 in rng.integers(0, ts.num_edges, size=20):
+        e1 = int(e1)
+        u = int(ts.edge_dst[e1])
+        reached = node_dijkstra(u, ts.node_out, ts.edge_dst, ts.edge_len, 500.0)
+        row = ts.reach_to[e1]
+        # row distances must agree with brute node distances
+        for slot, e2 in enumerate(row):
+            if e2 < 0:
+                continue
+            v = int(ts.edge_src[e2])
+            assert v in reached
+            assert np.isclose(ts.reach_dist[e1, slot], reached[v][0], atol=1e-3)
+        # adjacency (dist 0) always present
+        for e2 in ts.node_out[u]:
+            if e2 >= 0:
+                assert reach_lookup(ts.reach_to, ts.reach_dist, e1, int(e2)) == 0.0
+
+
+def test_reach_next_hop_walk(tiny_tiles, rng):
+    """next-hop pointers reconstruct a path whose length equals reach_dist."""
+    ts = tiny_tiles
+    checked = 0
+    for e1 in rng.integers(0, ts.num_edges, size=30):
+        e1 = int(e1)
+        for slot in (1, 3, 7, 15):
+            if slot >= ts.reach_to.shape[1] or ts.reach_to[e1, slot] < 0:
+                continue
+            e2 = int(ts.reach_to[e1, slot])
+            want = float(ts.reach_dist[e1, slot])
+            cur, total, hops = e1, 0.0, 0
+            while int(ts.edge_dst[cur]) != int(ts.edge_src[e2]) and hops < 64:
+                row = ts.reach_to[cur]
+                hit = np.nonzero(row == e2)[0]
+                assert len(hit), "intermediate edge lost the target"
+                nxt = int(ts.reach_next[cur, hit[0]])
+                total += float(ts.edge_len[nxt])
+                cur = nxt
+                hops += 1
+            assert hops < 64
+            assert np.isclose(total, want, atol=1e-2)
+            checked += 1
+    assert checked > 10
+
+
+def test_tileset_save_load_roundtrip(tiny_tiles, tmp_path):
+    p = str(tmp_path / "tiny.npz")
+    tiny_tiles.save(p)
+    from reporter_tpu.tiles.tileset import TileSet
+
+    back = TileSet.load(p)
+    assert back.name == tiny_tiles.name
+    assert back.meta == tiny_tiles.meta
+    np.testing.assert_array_equal(back.edge_src, tiny_tiles.edge_src)
+    np.testing.assert_allclose(back.reach_dist, tiny_tiles.reach_dist)
+
+
+def test_probe_synthesis_ground_truth(tiny_tiles):
+    ts = tiny_tiles
+    probe = synthesize_probe(ts, seed=3, num_points=60, gps_sigma=4.0)
+    assert probe.lonlat.shape == (60, 2)
+    assert (np.diff(probe.times) > 0).all()
+    # ground-truth edges form a connected drive
+    pe = probe.path_edges
+    assert (ts.edge_dst[pe[:-1]] == ts.edge_src[pe[1:]]).all()
+    # every sampled true position is on its edge (offset within length)
+    assert (probe.true_offsets >= -1e-3).all()
+    assert (probe.true_offsets <= ts.edge_len[probe.true_edges] + 1e-2).all()
+    # noisy points are near the true edge geometry
+    from reporter_tpu.geometry import point_segment_project
+
+    for t in range(0, 60, 10):
+        mask = ts.seg_edge == probe.true_edges[t]
+        d, _, _ = point_segment_project(
+            probe.xy[t][None, :], ts.seg_a[mask], ts.seg_b[mask])
+        assert d.min() < 25.0
+
+
+def test_osm_xml_parser_roundtrip():
+    xml = """<?xml version='1.0'?>
+    <osm>
+      <node id='1' lat='37.700' lon='-122.400'/>
+      <node id='2' lat='37.701' lon='-122.400'/>
+      <node id='3' lat='37.702' lon='-122.401'/>
+      <node id='9' lat='37.800' lon='-122.500'/>
+      <way id='100'>
+        <nd ref='1'/><nd ref='2'/><nd ref='3'/>
+        <tag k='highway' v='residential'/>
+        <tag k='name' v='Test St'/>
+      </way>
+      <way id='101'>
+        <nd ref='3'/><nd ref='2'/>
+        <tag k='highway' v='primary'/>
+        <tag k='oneway' v='yes'/>
+        <tag k='maxspeed' v='40 mph'/>
+      </way>
+      <way id='102'>
+        <nd ref='1'/><nd ref='9'/>
+        <tag k='highway' v='footway'/>
+      </way>
+    </osm>"""
+    from reporter_tpu.netgen.osm_xml import parse_osm_xml
+    from reporter_tpu.tiles.compiler import compile_network
+    from reporter_tpu.config import CompilerParams
+
+    net = parse_osm_xml(xml, name="fixture")
+    assert len(net.ways) == 2  # footway dropped
+    assert net.num_nodes == 3  # node 9 only used by the footway
+    w101 = [w for w in net.ways if w.way_id == 101][0]
+    assert w101.oneway and abs(w101.speed_mps - 40 * 0.44704) < 1e-6
+
+    ts = compile_network(net, CompilerParams(cell_size=64, reach_radius=400))
+    # way 100 two-way (4 directed edges), way 101 one-way (1 edge)
+    assert ts.num_edges == 5
+    assert (ts.edge_osmlr >= 0).all()
