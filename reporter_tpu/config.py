@@ -30,6 +30,10 @@ class MatcherParams:
     breakage_distance: float = 2000.0  # consecutive points farther apart break the HMM chain
     max_route_distance_factor: float = 5.0  # route dist > factor*gc ⇒ transition disallowed
     interpolation_distance: float = 10.0    # points closer than this are interpolated, not matched
+    backward_slack: float = 10.0   # same-edge backward jitter tolerated as zero-cost (m);
+                                   # GPS noise shifts projections backwards between samples —
+                                   # Meili absorbs this via input interpolation, we absorb it
+                                   # in the transition model (ops/hmm.route_distance)
 
     def replace(self, **kw: Any) -> "MatcherParams":
         return dataclasses.replace(self, **kw)

@@ -59,6 +59,9 @@ def parse_osm_xml(source: str, name: str = "osm") -> RoadNetwork:
             continue
         refs = [int(nd.get("ref")) for nd in w.findall("nd")]
         refs = [r for r in refs if r in node_pos]
+        # Real extracts contain duplicate consecutive refs; they would become
+        # zero-length edges, which the compiler forbids (edge_len > 0).
+        refs = [r for i, r in enumerate(refs) if i == 0 or r != refs[i - 1]]
         if len(refs) >= 2:
             raw_ways.append((int(w.get("id")), refs, tags))
 

@@ -95,13 +95,14 @@ def synthesize_probe(
     speed_mps: float | None = None,
     gps_sigma: float = 5.0,
     uuid: str | None = None,
+    shape_cache: "_EdgeShapeCache | None" = None,
 ) -> Probe:
     """Drive a random path and sample noisy GPS points along it."""
     rng = np.random.default_rng(seed)
     speed = float(speed_mps if speed_mps is not None else rng.uniform(7.0, 16.0))
     need = speed * dt * (num_points + 2)
     path = random_walk_edges(ts, rng, need)
-    cache = _EdgeShapeCache(ts)
+    cache = shape_cache if shape_cache is not None else _EdgeShapeCache(ts)
 
     cum = np.concatenate([[0.0], np.cumsum(ts.edge_len[path].astype(np.float64))])
     xs, true_e, true_off = [], [], []
@@ -130,8 +131,10 @@ def synthesize_probe(
 
 def synthesize_fleet(ts: TileSet, n: int, *, num_points: int = 120,
                      seed: int = 0, gps_sigma: float = 5.0) -> list[Probe]:
+    cache = _EdgeShapeCache(ts)  # segment sort is per-TileSet, share it
     return [
         synthesize_probe(ts, seed=seed * 1_000_003 + i, num_points=num_points,
-                         gps_sigma=gps_sigma, uuid=f"veh-{seed}-{i}")
+                         gps_sigma=gps_sigma, uuid=f"veh-{seed}-{i}",
+                         shape_cache=cache)
         for i in range(n)
     ]

@@ -1,0 +1,18 @@
+"""Device-side matcher kernels (JAX).
+
+TPU-native replacement for the online math inside Valhalla/Meili
+(SURVEY.md §2.2): candidate search → `candidates`, emission/transition +
+Viterbi → `hmm`, fused per-trace pipeline → `match`.
+"""
+
+from reporter_tpu.ops.candidates import CandidateSet, find_candidates
+from reporter_tpu.ops.hmm import viterbi_decode
+from reporter_tpu.ops.match import match_batch, match_trace
+
+__all__ = [
+    "CandidateSet",
+    "find_candidates",
+    "viterbi_decode",
+    "match_batch",
+    "match_trace",
+]

@@ -1,0 +1,119 @@
+"""Candidate search: vmapped point→polyline kNN over the spatial grid.
+
+Replaces Meili's CandidateGridQuery (SURVEY.md §2.2 "Candidate search" —
+valhalla/meili/candidate_search, UNVERIFIED): instead of a per-point hash-grid
+walk with pointer chasing, every query gathers a fixed 3×3 neighborhood of
+grid cells (cell_size >= search_radius guarantees coverage, see
+config.Config.validate), computes point→segment distances for all 9·C
+registered line segments at once on the VPU, and selects the K nearest
+*distinct edges* with a fixed-K argmin scan. All shapes static, fully
+vmappable over points and traces.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from reporter_tpu.tiles.tileset import TileMeta
+
+BIG = jnp.float32(1e30)   # "infinity" that survives subtraction without NaNs
+
+
+class CandidateSet(NamedTuple):
+    """Top-K candidate edges per trace point (fixed shapes, -1/BIG padded)."""
+
+    edge: jnp.ndarray    # i32 [T, K] candidate directed-edge id, -1 invalid
+    offset: jnp.ndarray  # f32 [T, K] distance along edge of the projection (m)
+    dist: jnp.ndarray    # f32 [T, K] euclidean point→edge distance (m)
+    valid: jnp.ndarray   # bool [T, K]
+
+
+def _point_segment_dist(p, a, b):
+    """Device mirror of geometry.point_segment_project (distance + t)."""
+    ab = b - a
+    denom = jnp.maximum(jnp.sum(ab * ab, axis=-1), 1e-12)
+    t = jnp.clip(jnp.sum((p - a) * ab, axis=-1) / denom, 0.0, 1.0)
+    proj = a + t[..., None] * ab
+    d = jnp.sqrt(jnp.sum((p - proj) ** 2, axis=-1))
+    return d, t
+
+
+def gather_cell_segments(pt, grid, meta: TileMeta):
+    """Segment ids registered in the 3×3 cell neighborhood of ``pt``.
+
+    Returns i32 [9*C]; -1 entries are padding or out-of-bounds cells.
+    """
+    gw, gh = meta.grid_dims
+    ox, oy = meta.grid_origin
+    cx = jnp.floor((pt[0] - ox) / meta.cell_size).astype(jnp.int32)
+    cy = jnp.floor((pt[1] - oy) / meta.cell_size).astype(jnp.int32)
+    dx = jnp.array([-1, -1, -1, 0, 0, 0, 1, 1, 1], jnp.int32)
+    dy = jnp.array([-1, 0, 1, -1, 0, 1, -1, 0, 1], jnp.int32)
+    xs = cx + dx
+    ys = cy + dy
+    in_bounds = (xs >= 0) & (xs < gw) & (ys >= 0) & (ys < gh)
+    cells = jnp.clip(xs, 0, gw - 1) * gh + jnp.clip(ys, 0, gh - 1)
+    segs = grid[cells]                                   # [9, C]
+    segs = jnp.where(in_bounds[:, None], segs, -1)
+    return segs.reshape(-1)
+
+
+def _topk_distinct_edges(seg_edges, dists, ts, k: int):
+    """K nearest distinct edges from per-segment distances.
+
+    seg_edges i32 [S9], dists f32 [S9] (BIG = invalid), ts f32 [S9] projection
+    parameter. K sequential argmin steps; after picking an edge every segment
+    of that edge is masked, so each edge appears at most once (Meili keeps one
+    candidate per edge — the closest projection).
+    """
+
+    def step(d, _):
+        i = jnp.argmin(d)
+        best = d[i]
+        e = seg_edges[i]
+        picked_valid = best < BIG
+        d = jnp.where(seg_edges == e, BIG, d)
+        return d, (jnp.where(picked_valid, e, -1), best, jnp.where(picked_valid, i, 0),
+                   picked_valid)
+
+    _, (edges, best_d, idx, ok) = jax.lax.scan(step, dists, None, length=k)
+    return edges, best_d, idx, ts[idx], ok
+
+
+def find_candidates(pt, tables, meta: TileMeta, search_radius: float,
+                    max_candidates: int):
+    """Candidates for ONE point. vmap over T (and again over batch) upstream.
+
+    tables: dict from TileSet.device_tables().
+    Returns (edge [K], offset [K], dist [K], valid [K]).
+    """
+    segs = gather_cell_segments(pt, tables["grid"], meta)        # [9C]
+    safe = jnp.maximum(segs, 0)
+    a = tables["seg_a"][safe]
+    b = tables["seg_b"][safe]
+    d, t = _point_segment_dist(pt[None, :], a, b)
+    seg_valid = (segs >= 0) & (d <= search_radius)
+    d = jnp.where(seg_valid, d, BIG)
+    seg_edge = jnp.where(segs >= 0, tables["seg_edge"][safe], -1)
+
+    edges, best_d, idx, t_at, ok = _topk_distinct_edges(
+        seg_edge, d, t, max_candidates)
+    off = tables["seg_off"][safe[idx]] + t_at * jnp.linalg.norm(
+        (b - a)[idx], axis=-1)
+    return CandidateSet(
+        edge=edges.astype(jnp.int32),
+        offset=jnp.where(ok, off, 0.0).astype(jnp.float32),
+        dist=jnp.where(ok, best_d, BIG).astype(jnp.float32),
+        valid=ok,
+    )
+
+
+def find_candidates_trace(points, tables, meta: TileMeta, search_radius: float,
+                          max_candidates: int) -> CandidateSet:
+    """[T, 2] points → CandidateSet with [T, K] fields."""
+    return jax.vmap(
+        lambda p: find_candidates(p, tables, meta, search_radius, max_candidates)
+    )(points)

@@ -1,0 +1,179 @@
+"""HMM emission/transition costs and Viterbi decode as a `lax.scan`.
+
+Replaces Meili's ViterbiSearch + per-pair Dijkstra routing (SURVEY.md §2.2
+"HMM Viterbi decode" / "Inter-candidate routing", valhalla/meili — UNVERIFIED
+paths): the data-dependent label-set Dijkstra of the reference's hot loop is
+replaced by a gather into offline reach tables (tiles/reach.py), so one
+Viterbi time-step is pure dense arithmetic over a [K, K] transition block —
+scan-friendly, vmappable over a batch of traces, no host round-trips.
+
+Cost model (negative log-likelihood up to constants, matching Meili's):
+  emission(c)      = dist(point, c)^2 / (2 * sigma_z^2)
+  transition(c→c') = |route_dist(c, c') − gc_dist| / beta
+with transitions disallowed when no route exists within the reach radius or
+the route detour exceeds ``max_route_distance_factor``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from reporter_tpu.ops.candidates import BIG, CandidateSet
+
+
+class ViterbiResult(NamedTuple):
+    choice: jnp.ndarray       # i32 [T] chosen candidate slot per point, -1 unmatched
+    edge: jnp.ndarray         # i32 [T] chosen edge id, -1 unmatched
+    offset: jnp.ndarray       # f32 [T] offset along chosen edge (m)
+    chain_start: jnp.ndarray  # bool [T] True where a new HMM chain begins
+    matched: jnp.ndarray      # bool [T]
+
+
+def route_distance(e1, off1, e2, off2, tables, backward_slack: float = 10.0):
+    """Network distance from candidate (e1, off1) to candidate (e2, off2).
+
+    Broadcasts over leading dims. Uses the reach tables: end-of-e1→start-of-e2
+    plus the remainders on both end edges; the same-edge forward case is a
+    plain offset difference. Same-edge projections that move *backwards* by
+    less than ``backward_slack`` (GPS jitter between close samples) count as
+    zero forward progress instead of a full graph loop. BIG when unreachable
+    within the reach radius.
+    """
+    edge_len = tables["edge_len"]
+    reach_to = tables["reach_to"]      # [E, M]
+    reach_dist = tables["reach_dist"]  # [E, M]
+
+    e1s = jnp.maximum(e1, 0)
+    e2s = jnp.maximum(e2, 0)
+    row_to = reach_to[e1s]             # [..., M]
+    row_d = reach_dist[e1s]
+    hit = row_to == e2s[..., None]
+    gap = jnp.min(jnp.where(hit, row_d, BIG), axis=-1)
+    cross = (edge_len[e1s] - off1) + gap + off2
+
+    same = (e1 == e2) & (off2 >= off1 - backward_slack)
+    direct = jnp.maximum(off2 - off1, 0.0)
+    route = jnp.where(same, jnp.minimum(direct, cross), cross)
+    return jnp.where((e1 >= 0) & (e2 >= 0), route, BIG)
+
+
+def transition_costs(cands_t: CandidateSet, cands_u: CandidateSet, gc, tables,
+                     beta: float, max_route_factor: float,
+                     backward_slack: float = 10.0):
+    """[K, K] transition cost block from point t's candidates to point u's.
+
+    gc: scalar straight-line distance between the two measurements.
+    """
+    e1, o1 = cands_t.edge, cands_t.offset
+    e2, o2 = cands_u.edge, cands_u.offset
+    route = route_distance(e1[:, None], o1[:, None], e2[None, :], o2[None, :],
+                           tables, backward_slack)
+    cost = jnp.abs(route - gc) / beta
+    # Detour guard: route much longer than the crow flies ⇒ disallowed
+    # (Meili's max_route_distance_factor). The +10 m floor keeps near-zero gc
+    # pairs (stopped vehicle) from disallowing everything.
+    allowed = (route < BIG) & (route <= max_route_factor * gc + 10.0)
+    allowed &= cands_t.valid[:, None] & cands_u.valid[None, :]
+    return jnp.where(allowed, cost, BIG)
+
+
+def emission_costs(cands: CandidateSet, sigma_z: float):
+    """[T, K] emission cost; BIG for invalid candidates."""
+    c = cands.dist ** 2 / (2.0 * sigma_z ** 2)
+    return jnp.where(cands.valid, c, BIG)
+
+
+def viterbi_decode(cands: CandidateSet, points, valid_pt, tables,
+                   sigma_z: float, beta: float, max_route_factor: float,
+                   breakage_distance: float,
+                   backward_slack: float = 10.0) -> ViterbiResult:
+    """Viterbi over the candidate lattice of ONE trace.
+
+    points: f32 [T, 2] (for gc distances); valid_pt: bool [T] padding mask.
+    Chain breakage: when consecutive points are farther apart than
+    ``breakage_distance`` or no transition is allowed, the chain restarts at
+    the new point, mirroring Meili's broken-path behavior. Inactive points
+    (padding, or no candidate in radius) pass the carry through untouched with
+    identity backpointers, so chains connect across them.
+    """
+    T, K = cands.edge.shape
+    em = emission_costs(cands, sigma_z)                     # [T, K]
+    active = valid_pt & jnp.any(cands.valid, axis=1)        # [T]
+    identity_bp = jnp.arange(K, dtype=jnp.int32)
+
+    def slot_view(t_idx):
+        return CandidateSet(edge=cands.edge[t_idx], offset=cands.offset[t_idx],
+                            dist=cands.dist[t_idx], valid=cands.valid[t_idx])
+
+    def step(carry, inp):
+        score, prev_pt, prev_any, prev_idx = carry
+        em_t, pt, act_t, t_idx = inp
+
+        gc = jnp.sqrt(jnp.sum((pt - prev_pt) ** 2))
+        trans = transition_costs(slot_view(prev_idx), slot_view(t_idx), gc,
+                                 tables, beta, max_route_factor,
+                                 backward_slack)                   # [K, K]
+        trans = jnp.where(gc <= breakage_distance, trans, BIG)
+
+        via = score[:, None] + trans
+        best_prev = jnp.argmin(via, axis=0).astype(jnp.int32)       # [K]
+        best_cost = jnp.min(via, axis=0)
+        connected = best_cost < BIG
+
+        broken = ~jnp.any(connected) | ~prev_any
+        new_score = jnp.where(broken, em_t,
+                              jnp.where(connected, best_cost + em_t, BIG))
+        backptr = jnp.where(broken | ~connected, -1, best_prev)
+
+        score_out = jnp.where(act_t, new_score, score)
+        new_carry = (score_out,
+                     jnp.where(act_t, pt, prev_pt),
+                     act_t | prev_any,
+                     jnp.where(act_t, t_idx, prev_idx))
+        emit = (score_out,
+                jnp.where(act_t, backptr, identity_bp),
+                act_t & broken)
+        return new_carry, emit
+
+    init = (jnp.full((K,), BIG, jnp.float32), points[0], jnp.bool_(False),
+            jnp.int32(0))
+    xs = (em, points, active, jnp.arange(T, dtype=jnp.int32))
+    _, (scores, backptrs, started) = jax.lax.scan(step, init, xs)
+
+    # ---- backtrack (reverse scan) ---------------------------------------
+    # carry = (slot chosen at the level just above, propagated down through
+    # identity backpointers at inactive levels; started flag of that level).
+    # A level is a chain terminal when the level above started a new chain
+    # (or there is no level above): re-seed from its own score argmin — at
+    # inactive levels the passed-through score is exactly the final score of
+    # the last active point below, so re-seeding there is correct too.
+    def back(carry, inp):
+        nxt_choice, nxt_started = carry
+        score_t, bp_next, act_t, started_t = inp
+        prop = jnp.where(nxt_choice >= 0,
+                         bp_next[jnp.maximum(nxt_choice, 0)], -1)
+        own = jnp.argmin(score_t).astype(jnp.int32)
+        own = jnp.where(score_t[own] < BIG, own, -1)
+        terminal = nxt_started | (nxt_choice < 0)
+        choice_t = jnp.where(terminal, own, prop)
+        out = jnp.where(act_t, choice_t, -1)
+        return (choice_t, started_t), out
+
+    bp_above = jnp.concatenate([backptrs[1:], jnp.full((1, K), -1, jnp.int32)])
+    rev = (scores[::-1], bp_above[::-1], active[::-1], started[::-1])
+    _, choices_rev = jax.lax.scan(back, (jnp.int32(-1), jnp.bool_(True)), rev)
+    choice = choices_rev[::-1]
+
+    safe = jnp.maximum(choice, 0)
+    matched = choice >= 0
+    t_ar = jnp.arange(T)
+    return ViterbiResult(
+        choice=choice.astype(jnp.int32),
+        edge=jnp.where(matched, cands.edge[t_ar, safe], -1).astype(jnp.int32),
+        offset=jnp.where(matched, cands.offset[t_ar, safe], 0.0),
+        chain_start=started,
+        matched=matched,
+    )

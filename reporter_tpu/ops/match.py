@@ -1,0 +1,62 @@
+"""Fused per-trace match pipeline and its batched (vmapped) form.
+
+This is the device program that replaces the region between
+``segment_matcher.Match(`` and the edge walk in the reference's call stack
+(SURVEY.md §3.5): candidates → emission/transition → Viterbi, all under one
+`jit`, vmapped across a batch of padded traces. Host code (matcher/) turns
+the per-point (edge, offset) output into OSMLR segment reports.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from reporter_tpu.config import MatcherParams
+from reporter_tpu.ops.candidates import find_candidates_trace
+from reporter_tpu.ops.hmm import viterbi_decode
+from reporter_tpu.tiles.tileset import TileMeta
+
+
+class MatchOutput(NamedTuple):
+    """Per-point match result (fixed [.., T] shapes; -1 = unmatched)."""
+
+    edge: jnp.ndarray         # i32 [.., T]
+    offset: jnp.ndarray       # f32 [.., T]
+    chain_start: jnp.ndarray  # bool [.., T]
+    matched: jnp.ndarray      # bool [.., T]
+
+
+def match_trace(points, valid_pt, tables, meta: TileMeta,
+                params: MatcherParams) -> MatchOutput:
+    """Match ONE padded trace: points f32 [T, 2], valid_pt bool [T]."""
+    if params.search_radius > meta.cell_size:
+        # Trace-time check (both are static): the 3×3 grid gather only covers
+        # one cell ring, so a radius beyond cell_size silently drops roads.
+        raise ValueError(
+            f"search_radius ({params.search_radius}) exceeds tile cell_size "
+            f"({meta.cell_size}); recompile tiles with cell_size >= radius")
+    cands = find_candidates_trace(
+        points, tables, meta, params.search_radius, params.max_candidates)
+    vit = viterbi_decode(
+        cands, points, valid_pt, tables,
+        params.sigma_z, params.beta, params.max_route_distance_factor,
+        params.breakage_distance, params.backward_slack)
+    return MatchOutput(edge=vit.edge, offset=vit.offset,
+                       chain_start=vit.chain_start, matched=vit.matched)
+
+
+@functools.partial(jax.jit, static_argnames=("meta", "params"))
+def match_batch(points, valid_pt, tables: dict[str, Any], meta: TileMeta,
+                params: MatcherParams) -> MatchOutput:
+    """Match a batch: points f32 [B, T, 2], valid_pt bool [B, T].
+
+    meta and params are hashable statics — one compilation per (T, K, tile
+    geometry, param set), then every batch reuses the executable
+    (SURVEY.md §7.5 "jit persistence").
+    """
+    return jax.vmap(lambda p, v: match_trace(p, v, tables, meta, params))(
+        points, valid_pt)

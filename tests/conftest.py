@@ -7,12 +7,19 @@ first imported, hence the env mutation at module import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-set (not setdefault): the image's sitecustomize exports
+# JAX_PLATFORMS=axon and calls jax.config.update("jax_platforms", ...) at
+# interpreter start, so both the env var AND the config must be overridden.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
