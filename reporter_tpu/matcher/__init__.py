@@ -1,0 +1,12 @@
+"""segment_matcher — the backend boundary (SURVEY.md §2.2 row 1).
+
+`SegmentMatcher.match(trace) → {"segments": [...], "mode": ...}` mirrors the
+reference binding's `SegmentMatcher.Match(trace_json)`; `matcher_backend`
+selects the batched TPU kernels ("jax") or the in-repo Meili stand-in oracle
+("reference_cpu").
+"""
+
+from reporter_tpu.matcher.api import MatchedPoint, SegmentMatcher
+from reporter_tpu.matcher.segments import SegmentRecord, build_segments
+
+__all__ = ["SegmentMatcher", "MatchedPoint", "SegmentRecord", "build_segments"]

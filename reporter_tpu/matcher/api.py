@@ -1,0 +1,218 @@
+"""SegmentMatcher — public matcher API with the backend boundary.
+
+Mirrors the reference's `segment_matcher` binding surface (SURVEY.md §2.2
+row 1, BASELINE.md north star): ``match(trace_json) → {"segments": [...],
+"mode": ...}``, with ``matcher_backend`` selecting:
+
+  "jax"           — batched TPU kernels (ops/), reach-table routing;
+  "reference_cpu" — the in-repo Meili stand-in (cpu_reference.py), exact
+                    Dijkstra routing; the accuracy oracle.
+
+`match_many` is the throughput path: traces are padded into a small set of
+length buckets so the jit'd kernel compiles once per bucket
+(SURVEY.md §7.5) and a whole bucket crosses the host↔device boundary as one
+batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from reporter_tpu.config import Config, MatcherParams
+from reporter_tpu.geometry import lonlat_to_xy
+from reporter_tpu.matcher import cpu_reference
+from reporter_tpu.matcher.segments import (
+    MatchedChain,
+    SegmentRecord,
+    build_segments,
+    reach_route_fn,
+)
+from reporter_tpu.tiles.tileset import TileSet
+
+_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
+
+
+@dataclass
+class Trace:
+    """Normalized input trace (host-side)."""
+
+    uuid: str
+    xy: np.ndarray       # [T, 2] float32 tile-local meters
+    times: np.ndarray    # [T] float64 seconds
+
+    @classmethod
+    def from_json(cls, payload: dict, ts: TileSet) -> "Trace":
+        pts = payload.get("trace", [])
+        lonlat = np.array([[p["lon"], p["lat"]] for p in pts], np.float64)
+        times = np.array([p.get("time", i) for i, p in enumerate(pts)], np.float64)
+        if len(lonlat) == 0:
+            lonlat = np.zeros((0, 2))
+        xy = lonlat_to_xy(lonlat, np.asarray(ts.meta.origin_lonlat))
+        return cls(uuid=str(payload.get("uuid", "")), xy=xy.astype(np.float32),
+                   times=times)
+
+
+@dataclass
+class MatchedPoint:
+    """Per-point match output (diagnostics / tests)."""
+
+    edge: int
+    offset: float
+    chain_start: bool
+
+
+def _dijkstra_route_fn(ts: TileSet, bound: float):
+    def route(e1: int, e2: int):
+        if e1 == e2:
+            return []
+        reached = cpu_reference.edge_dijkstra(ts, e1, bound)
+        if e2 not in reached:
+            return None
+        return cpu_reference.walk_prev(reached, e2)
+
+    return route
+
+
+class SegmentMatcher:
+    """The backend boundary (reference: SegmentMatcher.Match, SURVEY §3.1)."""
+
+    def __init__(self, tileset: TileSet, config: Config | None = None):
+        self.ts = tileset
+        self.config = (config or Config()).validate()
+        self.params: MatcherParams = self.config.matcher
+        backend = self.config.matcher_backend
+        if backend == "jax":
+            self._tables = tileset.device_tables()
+            self._route_fn = reach_route_fn(tileset)
+        elif backend == "reference_cpu":
+            self._tables = None
+            # Segment-build routing must reach every transition the Viterbi
+            # pass could have accepted, so reuse its worst-case bound.
+            self._route_fn = _dijkstra_route_fn(
+                tileset, bound=cpu_reference.viterbi_bound(
+                    self.params.breakage_distance, self.params))
+        else:  # pragma: no cover - Config.validate rejects earlier
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+
+    # ---- single-trace API (reference parity) ----------------------------
+
+    def match(self, trace_json: dict) -> dict:
+        """Reference-shaped entry: trace JSON in, segments JSON out."""
+        trace = Trace.from_json(trace_json, self.ts)
+        records = self.match_trace(trace)
+        return {
+            "mode": self.config.service.mode,
+            "segments": [r.to_json() for r in records],
+        }
+
+    def match_trace(self, trace: Trace) -> list[SegmentRecord]:
+        return self.match_many([trace])[0]
+
+    # ---- batched API (the TPU throughput path) --------------------------
+
+    def match_many(self, traces: Sequence[Trace]) -> list[list[SegmentRecord]]:
+        if self.backend == "reference_cpu":
+            return [self._match_cpu(t) for t in traces]
+        return self._match_jax_many(traces)
+
+    def matched_points(self, trace: Trace) -> list[MatchedPoint]:
+        """Per-point decode (no segment association) — test/diagnostic hook."""
+        trip = self._decode_many([trace])[0]
+        return [MatchedPoint(int(e), float(o), bool(s))
+                for e, o, s in zip(*trip)]
+
+    # ---- internals -------------------------------------------------------
+
+    def _match_cpu(self, trace: Trace) -> list[SegmentRecord]:
+        pts = cpu_reference.match_trace_cpu(self.ts, trace.xy.astype(np.float64),
+                                            self.params)
+        chains = _to_chains(pts, trace.times)
+        return build_segments(self.ts, chains, self._route_fn,
+                              self.params.backward_slack)
+
+    def _decode_many(self, traces: Sequence[Trace]):
+        """JAX decode for a list of traces → per-trace (edges, offsets,
+        chain_starts) numpy triples, bucketed by padded length."""
+        import jax.numpy as jnp
+
+        from reporter_tpu.ops.match import match_batch
+
+        max_b = _BUCKETS[-1]
+        # Traces beyond the largest bucket are decoded in consecutive chunks
+        # (each chunk is an independent HMM; at most the segment spanning a
+        # chunk boundary is reported partial). (trace index, chunk offset).
+        work: list[tuple[int, int, np.ndarray]] = []
+        for i, t in enumerate(traces):
+            if len(t.xy) <= max_b:
+                work.append((i, 0, t.xy))
+            else:
+                for lo in range(0, len(t.xy), max_b):
+                    work.append((i, lo, t.xy[lo:lo + max_b]))
+
+        pieces: dict[tuple[int, int], Any] = {}
+        by_bucket: dict[int, list[int]] = {}
+        for w, (_, _, xy) in enumerate(work):
+            by_bucket.setdefault(_bucket_len(len(xy)), []).append(w)
+        for b, ws in sorted(by_bucket.items()):
+            B = len(ws)
+            pts = np.zeros((B, b, 2), np.float32)
+            valid = np.zeros((B, b), bool)
+            for r, w in enumerate(ws):
+                xy = work[w][2]
+                pts[r, :len(xy)] = xy
+                valid[r, :len(xy)] = True
+            res = match_batch(jnp.asarray(pts), jnp.asarray(valid),
+                              self._tables, self.ts.meta, self.params)
+            edges = np.asarray(res.edge)
+            offs = np.asarray(res.offset)
+            starts = np.asarray(res.chain_start)
+            for r, w in enumerate(ws):
+                i, lo, xy = work[w]
+                T = len(xy)
+                pieces[(i, lo)] = (edges[r, :T], offs[r, :T], starts[r, :T])
+
+        out: list[Any] = []
+        for i, t in enumerate(traces):
+            chunks = [pieces[k] for k in sorted(pieces) if k[0] == i]
+            out.append(tuple(np.concatenate(parts) for parts in zip(*chunks)))
+        return out
+
+    def _match_jax_many(self, traces: Sequence[Trace]) -> list[list[SegmentRecord]]:
+        decoded = self._decode_many(traces)
+        results = []
+        for trace, (edges, offs, starts) in zip(traces, decoded):
+            pts = [(int(e), float(o), bool(s))
+                   for e, o, s in zip(edges, offs, starts)]
+            chains = _to_chains(pts, trace.times)
+            results.append(build_segments(self.ts, chains, self._route_fn,
+                                          self.params.backward_slack))
+        return results
+
+
+def _bucket_len(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return _BUCKETS[-1]
+
+
+def _to_chains(pts: list[tuple[int, float, bool]], times: np.ndarray,
+               ) -> list[MatchedChain]:
+    """Group per-point (edge, offset, chain_start) into MatchedChains,
+    dropping unmatched points."""
+    chains: list[MatchedChain] = []
+    cur: MatchedChain | None = None
+    for t, (e, off, start) in enumerate(pts):
+        if e < 0:
+            continue
+        if cur is None or start:
+            cur = MatchedChain(edges=[], offsets=[], times=[])
+            chains.append(cur)
+        cur.edges.append(int(e))
+        cur.offsets.append(float(off))
+        cur.times.append(float(times[t]))
+    return chains

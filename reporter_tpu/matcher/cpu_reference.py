@@ -1,0 +1,193 @@
+"""Pure-NumPy HMM map matcher — the in-repo Meili stand-in oracle.
+
+The real reference matcher is Valhalla/Meili (C++); neither Valhalla nor the
+reference repo is available in this environment (SURVEY.md caveat), so this
+module pins the numeric behavior instead: same emission/transition model as
+Meili (SURVEY.md §2.2 "HMM Viterbi decode"), with *exact* bounded Dijkstra
+between candidates (meili/routing analog) rather than the TPU backend's
+precomputed reach tables. Segment-ID disagreement between this and the jax
+backend is the BASELINE.md "<5% vs Meili" proxy metric.
+
+Deliberately simple and slow; used for golden tests and accuracy audits.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+
+import numpy as np
+
+from reporter_tpu.config import MatcherParams
+from reporter_tpu.geometry import point_segment_project
+from reporter_tpu.tiles.tileset import TileSet
+
+
+@dataclass
+class _Cand:
+    edge: int
+    offset: float
+    dist: float
+
+
+def find_candidates_cpu(ts: TileSet, pt: np.ndarray,
+                        params: MatcherParams) -> list[_Cand]:
+    """Brute-force point→edge candidates (closest projection per edge, top-K)."""
+    d, t, _ = point_segment_project(pt[None, :], ts.seg_a, ts.seg_b)
+    best: dict[int, _Cand] = {}
+    for s in np.argsort(d, kind="stable"):
+        if d[s] > params.search_radius or len(best) >= params.max_candidates:
+            break
+        e = int(ts.seg_edge[s])
+        if e not in best:
+            off = float(ts.seg_off[s]) + float(t[s]) * float(ts.seg_len[s])
+            best[e] = _Cand(edge=e, offset=off, dist=float(d[s]))
+    return list(best.values())
+
+
+def edge_dijkstra(ts: TileSet, e_from: int, bound: float,
+                  ) -> dict[int, tuple[float, int]]:
+    """Bounded Dijkstra over edges: distance from END of ``e_from`` to the
+    START of every edge within ``bound`` meters.
+
+    Returns {edge: (dist, prev_edge)}; prev_edge = -1 for direct successors.
+    The meili/routing label-set analog (exact, unlike the reach tables).
+    """
+    out: dict[int, tuple[float, int]] = {}
+    u0 = int(ts.edge_dst[e_from])
+    dist: dict[int, float] = {u0: 0.0}
+    prev_edge: dict[int, int] = {u0: -1}
+    pq: list[tuple[float, int]] = [(0.0, u0)]
+    while pq:
+        d, u = heapq.heappop(pq)
+        if d > dist.get(u, np.inf):
+            continue
+        for e in ts.node_out[u]:
+            if e < 0:
+                break
+            e = int(e)
+            out.setdefault(e, (d, prev_edge[u]))
+            nd = d + float(ts.edge_len[e])
+            w = int(ts.edge_dst[e])
+            if nd <= bound and nd < dist.get(w, np.inf):
+                dist[w] = nd
+                prev_edge[w] = e
+                heapq.heappush(pq, (nd, w))
+    return out
+
+
+def walk_prev(reached: dict[int, tuple[float, int]], e2: int) -> list[int]:
+    """Intermediate edges (exclusive) on the path to ``e2`` from a Dijkstra
+    result, via prev-edge backpointers."""
+    chain: list[int] = []
+    e = e2
+    while True:
+        _, pe = reached[e]
+        if pe < 0:
+            break
+        chain.append(pe)
+        e = pe
+    chain.reverse()
+    return chain
+
+
+def viterbi_bound(gc: float, params: MatcherParams) -> float:
+    """Dijkstra bound that covers every route the detour guard can accept."""
+    return params.max_route_distance_factor * gc + 10.0 + 2000.0
+
+
+def route_between(ts: TileSet, e1: int, o1: float, e2: int, o2: float,
+                  bound: float, backward_slack: float,
+                  ) -> tuple[float, list[int]]:
+    """(route distance, intermediate edges e1→e2 exclusive). inf if none."""
+    if e1 == e2 and o2 >= o1 - backward_slack:
+        return max(o2 - o1, 0.0), []
+    reached = edge_dijkstra(ts, e1, bound)
+    if e2 not in reached:
+        return float("inf"), []
+    gap, _ = reached[e2]
+    dist = (float(ts.edge_len[e1]) - o1) + gap + o2
+    return dist, walk_prev(reached, e2)
+
+
+def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
+                    ) -> list[tuple[int, float, bool]]:
+    """Match one trace; returns per-point (edge, offset, chain_start),
+    edge = -1 for unmatched points. One forward Viterbi pass with exact
+    routing, then one backpointer backtrack per chain."""
+    T = len(xy)
+    cands = [find_candidates_cpu(ts, xy[t], params) for t in range(T)]
+    results: list[tuple[int, float, bool]] = [(-1, 0.0, False)] * T
+    INF = float("inf")
+
+    def emit(c: _Cand) -> float:
+        return c.dist ** 2 / (2.0 * params.sigma_z ** 2)
+
+    # Forward pass over active points (those with candidates).
+    act = [t for t in range(T) if cands[t]]
+    if not act:
+        return results
+    scores: dict[int, list[float]] = {}
+    bps: dict[int, list[int]] = {}
+    chain_started: dict[int, bool] = {}
+    prev_t = -1
+    for t in act:
+        if prev_t < 0:
+            scores[t] = [emit(c) for c in cands[t]]
+            bps[t] = [-1] * len(cands[t])
+            chain_started[t] = True
+            prev_t = t
+            continue
+        gc = float(np.linalg.norm(xy[t] - xy[prev_t]))
+        ns = [INF] * len(cands[t])
+        bp = [-1] * len(cands[t])
+        if gc <= params.breakage_distance:
+            bound = viterbi_bound(gc, params)
+            for j, cj in enumerate(cands[prev_t]):
+                if scores[prev_t][j] == INF:
+                    continue
+                reached = edge_dijkstra(ts, cj.edge, bound)
+                for k, ck in enumerate(cands[t]):
+                    if (cj.edge == ck.edge
+                            and ck.offset >= cj.offset - params.backward_slack):
+                        route = max(ck.offset - cj.offset, 0.0)
+                    elif ck.edge in reached:
+                        route = ((float(ts.edge_len[cj.edge]) - cj.offset)
+                                 + reached[ck.edge][0] + ck.offset)
+                    else:
+                        continue
+                    if route > params.max_route_distance_factor * gc + 10.0:
+                        continue
+                    cost = scores[prev_t][j] + abs(route - gc) / params.beta
+                    if cost < ns[k]:
+                        ns[k] = cost
+                        bp[k] = j
+        if all(s == INF for s in ns):
+            scores[t] = [emit(c) for c in cands[t]]
+            bps[t] = [-1] * len(cands[t])
+            chain_started[t] = True
+        else:
+            scores[t] = [s + emit(c) if s < INF else INF
+                         for s, c in zip(ns, cands[t])]
+            bps[t] = bp
+            chain_started[t] = False
+        prev_t = t
+
+    # Backtrack chain by chain from the last active point.
+    i = len(act) - 1
+    while i >= 0:
+        start = i
+        while not chain_started[act[start]]:
+            start -= 1
+        chain_ts = act[start:i + 1]
+        best = int(np.argmin(scores[chain_ts[-1]]))
+        if scores[chain_ts[-1]][best] < INF:
+            k = best
+            for tt in reversed(chain_ts):
+                c = cands[tt][k]
+                results[tt] = (c.edge, c.offset, tt == chain_ts[0])
+                k = bps[tt][k]
+                if k < 0 and tt != chain_ts[0]:
+                    break  # defensive: should only hit -1 at the chain head
+        i = start - 1
+    return results

@@ -1,0 +1,211 @@
+"""Edge-walk + OSMLR association: matched points → segment records.
+
+Replaces the tail of the reference's match call (SURVEY.md §3.1 "edge walk +
+OSMLR association lookup"): the Viterbi output (per-point edge/offset) is
+expanded to the full driven edge path, path distances are mapped to times by
+linear interpolation between GPS timestamps, and maximal runs of edges that
+share an OSMLR row become one record each. Record schema mirrors the
+reference binding's output (SURVEY.md §2.2 row 1): segment_id, way_ids,
+start_time, end_time, length, internal, queue_length.
+
+Shared by both backends — they differ only in HMM decode + routing, which is
+exactly what the <5% disagreement target compares.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+import numpy as np
+
+from reporter_tpu.tiles.tileset import TileSet
+
+# route_fn(e1, e2) → intermediate edge ids strictly between e1 and e2 on the
+# matched path, or None when e2 is unreachable (forces a path break).
+RouteFn = Callable[[int, int], "list[int] | None"]
+
+
+@dataclass
+class SegmentRecord:
+    """One (possibly partial) OSMLR segment traversal."""
+
+    segment_id: int          # stable OSMLR id (osmlr_id[row])
+    way_ids: list[int]       # source way ids along the traversal, in order
+    start_time: float        # -1.0 ⇒ entered before this trace (partial)
+    end_time: float          # -1.0 ⇒ exit not observed yet (partial)
+    length: float            # meters of the segment covered by this traversal
+    internal: bool           # True for unassociated connector edges
+    queue_length: float = 0.0  # reference schema field; 0 (no signal-queue model)
+
+    @property
+    def complete(self) -> bool:
+        return self.start_time >= 0.0 and self.end_time >= 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "segment_id": int(self.segment_id),
+            "way_ids": [int(w) for w in self.way_ids],
+            "start_time": float(self.start_time),
+            "end_time": float(self.end_time),
+            "length": float(self.length),
+            "internal": bool(self.internal),
+            "queue_length": float(self.queue_length),
+        }
+
+
+@dataclass
+class MatchedChain:
+    """One breakage-free run of matched points (host-side)."""
+
+    edges: list[int]         # per matched point
+    offsets: list[float]
+    times: list[float]
+
+
+def reach_route_fn(ts: TileSet) -> RouteFn:
+    """RouteFn that walks the precomputed reach_next tables (jax backend)."""
+
+    def route(e1: int, e2: int) -> list[int] | None:
+        if e1 == e2:
+            return []
+        chain: list[int] = []
+        e = e1
+        gap = np.inf
+        while True:
+            row = ts.reach_to[e]
+            hit = np.nonzero(row == e2)[0]
+            if not len(hit):
+                return None
+            new_gap = float(ts.reach_dist[e, hit[0]])
+            if new_gap >= gap:  # no progress ⇒ inconsistent tables; bail out
+                return None
+            gap = new_gap
+            nxt = int(ts.reach_next[e, hit[0]])
+            if nxt == e2:
+                return chain
+            if nxt < 0:
+                return None
+            chain.append(nxt)
+            e = nxt
+
+    return route
+
+
+def _chain_to_path(ts: TileSet, chain: MatchedChain, route_fn: RouteFn,
+                   backward_slack: float):
+    """Expand a matched chain to (edge path, per-point path distance).
+
+    Path distance d is measured from the start of the first edge; point i sits
+    at d = (sum of lengths of path edges before its edge) + offset_i.
+    A routing failure splits the chain — yields multiple (path, pts) tuples.
+    """
+    out = []
+    path: list[int] = [chain.edges[0]]
+    cum: list[float] = [0.0]          # path-distance at start of path[i]
+    pts: list[tuple[float, float]] = [(chain.offsets[0], chain.times[0])]
+
+    def flush():
+        nonlocal path, cum, pts
+        if path and pts:
+            out.append((path, pts))
+        path, cum, pts = [], [], []
+
+    for i in range(1, len(chain.edges)):
+        e_prev, e_cur = chain.edges[i - 1], chain.edges[i]
+        off, t = chain.offsets[i], chain.times[i]
+        if e_cur == e_prev and off >= chain.offsets[i - 1] - backward_slack:
+            d = cum[-1] + max(off, pts[-1][0] - cum[-1])  # monotone clamp
+            pts.append((d, t))
+            continue
+        mid = route_fn(e_prev, e_cur)
+        if mid is None:
+            flush()
+            path = [e_cur]
+            cum = [0.0]
+            pts = [(off, t)]
+            continue
+        for m in [*mid, e_cur]:
+            cum.append(cum[-1] + float(ts.edge_len[path[-1]]))
+            path.append(m)
+        pts.append((cum[-1] + off, t))
+    flush()
+    return out
+
+
+def _time_at(pts: list[tuple[float, float]], d: float) -> float:
+    """Linear time interpolation at path distance d; -1.0 outside the span."""
+    if not pts or d < pts[0][0] - 1e-6 or d > pts[-1][0] + 1e-6:
+        return -1.0
+    ds = [p[0] for p in pts]
+    i = int(np.searchsorted(ds, d))
+    i = max(1, min(i, len(pts) - 1))
+    d0, t0 = pts[i - 1]
+    d1, t1 = pts[i]
+    if d1 <= d0 + 1e-9:
+        return float(t0)
+    w = (d - d0) / (d1 - d0)
+    return float(t0 + w * (t1 - t0))
+
+
+def build_segments(ts: TileSet, chains: Iterable[MatchedChain],
+                   route_fn: RouteFn, backward_slack: float = 10.0,
+                   ) -> list[SegmentRecord]:
+    """OSMLR segment records for all chains of one trace, in drive order."""
+    records: list[SegmentRecord] = []
+    for chain in chains:
+        if not chain.edges:
+            continue
+        for path, pts in _chain_to_path(ts, chain, route_fn, backward_slack):
+            records.extend(_path_to_records(ts, path, pts))
+    return records
+
+
+def _path_to_records(ts: TileSet, path: list[int],
+                     pts: list[tuple[float, float]]) -> list[SegmentRecord]:
+    # cum[i] = path distance at start of path[i]
+    cum = np.concatenate([[0.0], np.cumsum(ts.edge_len[path].astype(np.float64))])
+    observed_lo, observed_hi = pts[0][0], pts[-1][0]
+
+    records: list[SegmentRecord] = []
+    i = 0
+    while i < len(path):
+        row = int(ts.edge_osmlr[path[i]])
+        j = i
+        # maximal run of edges on the same OSMLR row with contiguous offsets
+        while (j + 1 < len(path)
+               and int(ts.edge_osmlr[path[j + 1]]) == row
+               and (row < 0 or abs(
+                   float(ts.edge_osmlr_off[path[j + 1]])
+                   - (float(ts.edge_osmlr_off[path[j]])
+                      + float(ts.edge_len[path[j]]))) < 1.0)):
+            j += 1
+        d_lo, d_hi = float(cum[i]), float(cum[j + 1])
+        # clip to the observed span: beyond it there is no time basis at all
+        c_lo, c_hi = max(d_lo, observed_lo), min(d_hi, observed_hi)
+        if c_hi > c_lo + 1e-6:
+            way_ids: list[int] = []
+            for e in path[i:j + 1]:
+                w = int(ts.edge_way[e])
+                if not way_ids or way_ids[-1] != w:
+                    way_ids.append(w)
+            if row < 0:
+                records.append(SegmentRecord(
+                    segment_id=-1, way_ids=way_ids,
+                    start_time=_time_at(pts, c_lo), end_time=_time_at(pts, c_hi),
+                    length=c_hi - c_lo, internal=True))
+            else:
+                o_start = float(ts.edge_osmlr_off[path[i]])
+                seg_len = float(ts.osmlr_len[row])
+                # full traversal needs the segment's own [0, seg_len] covered
+                covered_lo = o_start + (c_lo - d_lo)
+                covered_hi = o_start + (c_hi - d_lo)
+                starts_at_origin = covered_lo <= 1.0
+                ends_at_tail = covered_hi >= seg_len - 1.0
+                records.append(SegmentRecord(
+                    segment_id=int(ts.osmlr_id[row]), way_ids=way_ids,
+                    start_time=_time_at(pts, c_lo) if starts_at_origin else -1.0,
+                    end_time=_time_at(pts, c_hi) if ends_at_tail else -1.0,
+                    length=covered_hi - covered_lo, internal=False))
+        i = j + 1
+    return records

@@ -1,0 +1,141 @@
+"""SegmentMatcher tests: backend agreement (the BASELINE "<5% vs Meili"
+proxy), output schema parity, and segment association correctness."""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import CompilerParams, Config
+from reporter_tpu.matcher import SegmentMatcher
+from reporter_tpu.matcher.api import Trace
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_fleet, synthesize_probe
+from reporter_tpu.tiles.compiler import compile_network
+
+SCHEMA_KEYS = {"segment_id", "way_ids", "start_time", "end_time", "length",
+               "internal", "queue_length"}
+
+
+def _edit_distance(a: list, b: list) -> int:
+    """Levenshtein over segment-ID sequences (the disagreement unit)."""
+    dp = list(range(len(b) + 1))
+    for i, x in enumerate(a, 1):
+        prev, dp[0] = dp[0], i
+        for j, y in enumerate(b, 1):
+            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                     prev + (x != y))
+    return dp[len(b)]
+
+
+@pytest.fixture(scope="module")
+def short_seg_tiles():
+    """Short OSMLR segments (250 m) so 60-point traces complete several."""
+    return compile_network(
+        generate_city("tiny"),
+        CompilerParams(reach_radius=500.0, osmlr_max_length=250.0))
+
+
+@pytest.fixture(scope="module")
+def matchers(short_seg_tiles):
+    return (SegmentMatcher(short_seg_tiles, Config(matcher_backend="jax")),
+            SegmentMatcher(short_seg_tiles,
+                           Config(matcher_backend="reference_cpu")))
+
+
+class TestSchema:
+    def test_reference_output_shape(self, matchers, short_seg_tiles):
+        mj, _ = matchers
+        p = synthesize_probe(short_seg_tiles, seed=1, num_points=60)
+        out = mj.match(p.to_report_json())
+        assert set(out.keys()) == {"mode", "segments"}
+        assert out["segments"], "a 60-point drive must touch some segment"
+        for s in out["segments"]:
+            assert set(s.keys()) == SCHEMA_KEYS
+            assert s["length"] > 0
+
+    def test_empty_trace(self, matchers):
+        mj, mc = matchers
+        for m in (mj, mc):
+            out = m.match({"uuid": "x", "trace": []})
+            assert out["segments"] == []
+
+
+class TestBackendAgreement:
+    def test_segment_disagreement_under_5pct(self, matchers, short_seg_tiles):
+        """Complete-segment sequences from the jax backend vs the exact-
+        Dijkstra CPU oracle; BASELINE target <5% disagreement."""
+        mj, mc = matchers
+        probes = synthesize_fleet(short_seg_tiles, 20, num_points=60, seed=7)
+        traces = [Trace.from_json(p.to_report_json(), short_seg_tiles)
+                  for p in probes]
+        res_j = mj.match_many(traces)
+        res_c = [mc.match_trace(t) for t in traces]
+        diff = total = 0
+        for rj, rc in zip(res_j, res_c):
+            ids_j = [r.segment_id for r in rj if r.complete]
+            ids_c = [r.segment_id for r in rc if r.complete]
+            total += max(len(ids_j), len(ids_c), 1)
+            diff += _edit_distance(ids_j, ids_c)
+        assert total > 20, "fleet should produce complete segments"
+        assert diff / total < 0.05, f"disagreement {diff}/{total}"
+
+    def test_complete_segments_have_times(self, matchers, short_seg_tiles):
+        mj, _ = matchers
+        p = synthesize_probe(short_seg_tiles, seed=4, num_points=120)
+        recs = mj.match_trace(Trace.from_json(p.to_report_json(),
+                                              short_seg_tiles))
+        complete = [r for r in recs if r.complete]
+        assert complete
+        for r in complete:
+            assert 0 <= r.start_time < r.end_time
+            assert r.length == pytest.approx(
+                float(short_seg_tiles.osmlr_len[
+                    np.nonzero(short_seg_tiles.osmlr_id == r.segment_id)[0][0]]),
+                abs=2.0)
+
+    def test_true_path_segments_recovered(self, matchers, short_seg_tiles):
+        """Complete segments reported must be on the ground-truth drive."""
+        mj, _ = matchers
+        ts = short_seg_tiles
+        for seed in (2, 5, 8):
+            p = synthesize_probe(ts, seed=seed, num_points=120)
+            recs = mj.match_trace(Trace.from_json(p.to_report_json(), ts))
+            true_rows = set(int(r) for r in ts.edge_osmlr[p.true_edges])
+            true_rows |= {int(ts.edge_osmlr[ts.edge_opp[e]])
+                          for e in p.true_edges if ts.edge_opp[e] >= 0}
+            true_ids = {int(ts.osmlr_id[r]) for r in true_rows if r >= 0}
+            got = [r.segment_id for r in recs if r.complete]
+            on_path = sum(g in true_ids for g in got)
+            assert on_path >= 0.9 * len(got)
+
+
+class TestLongTraces:
+    def test_chunked_decode_no_data_loss(self, short_seg_tiles, monkeypatch):
+        """Traces beyond the largest bucket decode in chunks, not truncate."""
+        import reporter_tpu.matcher.api as api_mod
+        monkeypatch.setattr(api_mod, "_BUCKETS", (16, 32))
+        ts = short_seg_tiles
+        m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+        p = synthesize_probe(ts, seed=3, num_points=70)
+        tr = Trace.from_json(p.to_report_json(), ts)
+        edges, offs, starts = m._decode_many([tr])[0]
+        assert len(edges) == 70
+        assert (edges >= 0).mean() > 0.9  # matched across all chunks
+        recs = m.match_trace(tr)
+        assert recs
+
+
+class TestTimes:
+    def test_times_monotone_and_in_span(self, matchers, short_seg_tiles):
+        mj, _ = matchers
+        p = synthesize_probe(short_seg_tiles, seed=6, num_points=90)
+        recs = mj.match_trace(Trace.from_json(p.to_report_json(),
+                                              short_seg_tiles))
+        t_lo, t_hi = p.times[0], p.times[-1]
+        last_end = -1.0
+        for r in recs:
+            if not r.complete:
+                continue
+            assert t_lo <= r.start_time <= t_hi
+            assert t_lo <= r.end_time <= t_hi
+            assert r.start_time >= last_end - 1.0  # drive order
+            last_end = r.end_time

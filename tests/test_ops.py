@@ -7,6 +7,7 @@ import pytest
 
 from reporter_tpu.config import MatcherParams
 from reporter_tpu.geometry import point_segment_project
+from reporter_tpu.matcher.cpu_reference import find_candidates_cpu
 from reporter_tpu.netgen.traces import synthesize_probe
 from reporter_tpu.ops.candidates import BIG, find_candidates
 from reporter_tpu.ops.hmm import route_distance
@@ -18,18 +19,10 @@ K = 8
 
 
 def oracle_candidates(ts, pt):
-    """Brute force: distance to every line segment, best per edge, top-K."""
-    d, t, _ = point_segment_project(pt[None, :], ts.seg_a, ts.seg_b)
-    best: dict[int, tuple[float, float]] = {}
-    for s in np.argsort(d, kind="stable"):
-        if d[s] > RADIUS:
-            break
-        e = int(ts.seg_edge[s])
-        if e not in best:
-            off = float(ts.seg_off[s]) + float(t[s]) * float(ts.seg_len[s])
-            best[e] = (float(d[s]), off)
-    ranked = sorted(best.items(), key=lambda kv: kv[1][0])[:K]
-    return {e: dv for e, dv in ranked}
+    """CPU-oracle candidates (cpu_reference is the single source of truth)."""
+    cands = find_candidates_cpu(
+        ts, pt, MatcherParams(search_radius=RADIUS, max_candidates=K))
+    return {c.edge: (c.dist, c.offset) for c in cands}
 
 
 class TestCandidates:
