@@ -1,0 +1,21 @@
+"""Service layer — the reference's L6/L4 surface (SURVEY.md §1, §3.1).
+
+The reference serves ``POST /report`` from a Flask app and publishes reports
+to the Open Traffic Datastore. Flask is not available in this environment, so
+the app is a plain WSGI callable (``make_app``) served by a stdlib threaded
+HTTP server (``serve``) — same endpoint, same JSON contract, zero deps.
+"""
+
+from reporter_tpu.service.app import ReporterApp, make_app
+from reporter_tpu.service.cache import PartialTraceCache
+from reporter_tpu.service.datastore import DatastorePublisher
+from reporter_tpu.service.reports import build_reports, filter_segments
+
+__all__ = [
+    "ReporterApp",
+    "make_app",
+    "PartialTraceCache",
+    "DatastorePublisher",
+    "build_reports",
+    "filter_segments",
+]

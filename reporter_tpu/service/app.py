@@ -1,0 +1,221 @@
+"""The report service — WSGI app + request pipeline.
+
+Behavior parity with the reference's Flask service (SURVEY.md §3.1):
+
+  POST /report {"uuid", "trace": [{lat, lon, time}…]}
+    ├─ validate; merge with per-uuid cached partial trace
+    ├─ SegmentMatcher.match_many (jax backend: batched device decode)
+    ├─ filter fully-traversed segments; update uuid cache with pending tail
+    ├─ build reports [{id, next_id, t0, t1, length, queue_length}]
+    └─ POST to DATASTORE_URL (when configured)
+
+TPU-first addition: ``POST /report_many {"traces": [<report payload>…]}``
+matches a whole fleet in one device batch — the HTTP-visible face of the
+throughput path (SURVEY.md §7.5).
+
+Flask is unavailable in this image, so the app is a bare WSGI callable —
+servable by any WSGI server and by the stdlib runner in service/server.py.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+from reporter_tpu.config import Config
+from reporter_tpu.matcher.api import SegmentMatcher, Trace
+from reporter_tpu.service.cache import PartialTraceCache
+from reporter_tpu.service.datastore import DatastorePublisher, Transport
+from reporter_tpu.service.reports import (
+    Report,
+    build_reports,
+    latest_complete_time,
+)
+from reporter_tpu.tiles.tileset import TileSet
+
+log = logging.getLogger("reporter_tpu.service")
+
+
+class BadRequest(ValueError):
+    pass
+
+
+def _validate_payload(payload: Any) -> tuple[str, list[dict]]:
+    if not isinstance(payload, dict):
+        raise BadRequest("payload must be a JSON object")
+    uuid = payload.get("uuid")
+    if not isinstance(uuid, str) or not uuid:
+        raise BadRequest("missing or invalid 'uuid'")
+    pts = payload.get("trace")
+    if not isinstance(pts, list) or not pts:
+        raise BadRequest("missing or empty 'trace'")
+    for p in pts:
+        if not isinstance(p, dict) or "lat" not in p or "lon" not in p:
+            raise BadRequest("trace points need 'lat' and 'lon'")
+    # Points without explicit time get index seconds (reference tolerates
+    # timeless fixtures the same way).
+    out = []
+    for i, p in enumerate(pts):
+        out.append({"lat": float(p["lat"]), "lon": float(p["lon"]),
+                    "time": float(p.get("time", i))})
+    out.sort(key=lambda p: p["time"])
+    return uuid, out
+
+
+class ReporterApp:
+    """Request pipeline around a SegmentMatcher (any backend)."""
+
+    def __init__(self, tileset: TileSet, config: Config | None = None,
+                 transport: Transport | None = None):
+        self.config = (config or Config()).validate()
+        svc = self.config.service
+        self.matcher = SegmentMatcher(tileset, self.config)
+        self.cache = PartialTraceCache(ttl=svc.cache_ttl,
+                                       max_uuids=svc.cache_max_uuids)
+        self.publisher = DatastorePublisher(url=svc.datastore_url,
+                                            mode=svc.mode,
+                                            transport=transport)
+        self.min_segment_length = svc.min_segment_length
+        self._lock = threading.Lock()     # match_many is not re-entrant per app
+        self.stats = {"requests": 0, "traces": 0, "points": 0,
+                      "reports": 0, "errors": 0, "match_seconds": 0.0}
+
+    # ---- core pipeline ---------------------------------------------------
+
+    def report_one(self, payload: dict) -> dict:
+        return self.report_many([payload])[0]
+
+    def report_many(self, payloads: Iterable[dict]) -> list[dict]:
+        """Validate → merge cache → batched match → filter/publish/retain.
+
+        The whole merge→match→retain pipeline runs under one lock so
+        concurrent requests for the same uuid can't lose cached tail points
+        (merge/retain is a read-modify-write on the cache entry).
+        """
+        with self._lock:
+            return self._report_many_locked(payloads)
+
+    def _report_many_locked(self, payloads: Iterable[dict]) -> list[dict]:
+        items = []
+        in_batch: dict[str, list[dict]] = {}   # uuid → merged-so-far points
+        for payload in payloads:
+            uuid, pts = _validate_payload(payload)
+            prior = in_batch.get(uuid)
+            if prior is not None:
+                # Duplicate uuid within one batch: later items see earlier
+                # items' points, exactly as if they had arrived sequentially.
+                seen = {p["time"] for p in prior}
+                pts = prior + [p for p in pts if p["time"] not in seen]
+                pts.sort(key=lambda p: p["time"])
+            merged = self.cache.merge(uuid, pts)
+            in_batch[uuid] = merged
+            items.append((uuid, merged))
+
+        traces = [
+            Trace.from_json({"uuid": u, "trace": pts}, self.matcher.ts)
+            for u, pts in items
+        ]
+        t0 = time.perf_counter()
+        per_trace = self.matcher.match_many(traces)
+        dt = time.perf_counter() - t0
+
+        out = []
+        all_reports: list[Report] = []
+        for (uuid, merged), records in zip(items, per_trace):
+            reports = build_reports(records, self.min_segment_length)
+            all_reports.extend(reports)
+            done = latest_complete_time(records)
+            if done is None:
+                # Nothing completed: whole merged trace may still be mid-segment.
+                self.cache.retain(uuid, merged, merged[0]["time"])
+            else:
+                self.cache.retain(uuid, merged, done)
+            out.append({
+                "mode": self.config.service.mode,
+                "segments": [r.to_json() for r in records],
+                "reports": [r.to_json() for r in reports],
+            })
+            self.stats["traces"] += 1
+            self.stats["points"] += len(merged)
+            self.stats["reports"] += len(reports)
+        self.stats["match_seconds"] += dt
+        self.publisher.publish(all_reports)
+        return out
+
+    def health(self) -> dict:
+        return {
+            "status": "ok",
+            "backend": self.matcher.backend,
+            "tileset": self.matcher.ts.name,
+            "edges": self.matcher.ts.num_edges,
+            "cached_uuids": len(self.cache),
+            "published": self.publisher.published,
+            "dropped": self.publisher.dropped,
+            **self.stats,
+        }
+
+    # ---- WSGI ------------------------------------------------------------
+
+    def __call__(self, environ: dict, start_response: Callable):
+        method = environ.get("REQUEST_METHOD", "GET")
+        path = environ.get("PATH_INFO", "/")
+        try:
+            if path == "/health" and method == "GET":
+                return _respond(start_response, 200, self.health())
+            if path == "/report" and method == "POST":
+                body = _read_json(environ)
+                self.stats["requests"] += 1
+                return _respond(start_response, 200, self.report_one(body))
+            if path == "/report_many" and method == "POST":
+                body = _read_json(environ)
+                traces = body.get("traces") if isinstance(body, dict) else None
+                if not isinstance(traces, list):
+                    raise BadRequest("payload must be {'traces': [...]}")
+                self.stats["requests"] += 1
+                results = self.report_many(traces)
+                return _respond(start_response, 200, {"results": results})
+            if path in ("/report", "/report_many"):
+                return _respond(start_response, 405,
+                                {"error": f"{method} not allowed"})
+            return _respond(start_response, 404, {"error": "not found"})
+        except BadRequest as exc:
+            self.stats["errors"] += 1
+            return _respond(start_response, 400, {"error": str(exc)})
+        except Exception:                                 # pragma: no cover
+            self.stats["errors"] += 1
+            log.exception("unhandled error serving %s %s", method, path)
+            return _respond(start_response, 500, {"error": "internal error"})
+
+
+def _read_json(environ: dict) -> Any:
+    try:
+        length = int(environ.get("CONTENT_LENGTH") or 0)
+    except ValueError:
+        length = 0
+    raw = environ["wsgi.input"].read(length) if length else b""
+    if not raw:
+        raise BadRequest("empty body")
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError as exc:
+        raise BadRequest(f"invalid JSON: {exc}") from exc
+
+
+def _respond(start_response: Callable, status: int, payload: dict):
+    body = json.dumps(payload).encode()
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              405: "Method Not Allowed", 500: "Internal Server Error"}
+    start_response(f"{status} {reason.get(status, '')}".strip(), [
+        ("Content-Type", "application/json"),
+        ("Content-Length", str(len(body))),
+    ])
+    return [body]
+
+
+def make_app(tileset: TileSet, config: Config | None = None,
+             transport: Transport | None = None) -> ReporterApp:
+    """Construct the WSGI app (reference: service init, SURVEY.md §3.2)."""
+    return ReporterApp(tileset, config, transport)

@@ -1,0 +1,76 @@
+"""Datastore publisher.
+
+The reference POSTs ``{"mode", "reports": [...]}`` to ``DATASTORE_URL``
+(SURVEY.md §2.1 "Datastore publisher", §3.1 network boundary). Implemented on
+urllib so there are no third-party deps; the transport is injectable so tests
+and the streaming pipeline can capture payloads without a network.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.error
+import urllib.request
+from typing import Callable
+
+from reporter_tpu.service.reports import Report
+
+log = logging.getLogger("reporter_tpu.datastore")
+
+# transport(url, payload_bytes) → HTTP status code
+Transport = Callable[[str, bytes], int]
+
+
+def _urllib_transport(url: str, body: bytes) -> int:
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=10.0) as resp:
+        return int(resp.status)
+
+
+class DatastorePublisher:
+    """Publishes report batches; counts outcomes for observability.
+
+    With an empty URL, publishing is a logged no-op (the reference's local /
+    dev mode): reports are still returned to the caller, nothing leaves the
+    process.
+    """
+
+    def __init__(self, url: str = "", mode: str = "auto",
+                 transport: Transport | None = None):
+        self.url = url
+        self.mode = mode
+        self._transport = transport or _urllib_transport
+        self.published = 0          # reports successfully POSTed
+        self.dropped = 0            # reports lost to transport errors
+        self.requests = 0           # POST attempts
+
+    def publish(self, reports: list[Report]) -> bool:
+        """POST one batch. True on success (or no-op); False on failure."""
+        if not reports:
+            return True
+        if not self.url:
+            log.debug("datastore disabled; dropping %d reports on the floor",
+                      len(reports))
+            return True
+        payload = json.dumps({
+            "mode": self.mode,
+            "reports": [r.to_json() for r in reports],
+        }).encode()
+        self.requests += 1
+        try:
+            status = self._transport(self.url, payload)
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            log.warning("datastore POST failed: %s (%d reports dropped)",
+                        exc, len(reports))
+            self.dropped += len(reports)
+            return False
+        if 200 <= status < 300:
+            self.published += len(reports)
+            return True
+        log.warning("datastore POST returned %d (%d reports dropped)",
+                    status, len(reports))
+        self.dropped += len(reports)
+        return False

@@ -1,0 +1,69 @@
+"""Stdlib threaded HTTP runner for the WSGI app + service entry point.
+
+Stands in for the reference's Flask/WSGI server start (SURVEY.md §3.2): load
+config, compile or load tiles, construct the matcher once (device tables
+staged to HBM), serve threaded on PORT.
+
+Run:  python -m reporter_tpu.service.server --tiles path/to/tiles.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
+
+from socketserver import ThreadingMixIn
+
+from reporter_tpu.config import Config
+from reporter_tpu.service.app import ReporterApp, make_app
+from reporter_tpu.tiles.tileset import TileSet
+
+
+class ThreadedWSGIServer(ThreadingMixIn, WSGIServer):
+    daemon_threads = True
+
+
+class _QuietHandler(WSGIRequestHandler):
+    def log_message(self, fmt, *args):      # route through logging, not stderr
+        logging.getLogger("reporter_tpu.http").info(fmt, *args)
+
+
+def serve(app: ReporterApp, host: str = "0.0.0.0", port: int | None = None):
+    """Serve forever (threaded). Returns the server for tests to shut down."""
+    port = app.config.service.port if port is None else port
+    server = make_server(host, port, app, server_class=ThreadedWSGIServer,
+                         handler_class=_QuietHandler)
+    return server
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="reporter_tpu report service")
+    ap.add_argument("--tiles", required=False,
+                    help="compiled TileSet .npz (default: synthetic 'sf')")
+    ap.add_argument("--config", help="JSON config path")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    config = Config.load(args.config)
+    if args.tiles:
+        ts = TileSet.load(args.tiles)
+    else:
+        from reporter_tpu.netgen.synthetic import generate_city
+        from reporter_tpu.tiles.compiler import compile_network
+
+        logging.info("no --tiles given; compiling synthetic 'sf'")
+        ts = compile_network(generate_city("sf"), config.compiler)
+    app = make_app(ts, config)
+    server = serve(app, args.host, args.port)
+    logging.info("serving %s (%d edges, backend=%s) on :%d",
+                 ts.name, ts.num_edges, app.matcher.backend,
+                 server.server_address[1])
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,250 @@
+"""Service-layer tests: /report behavior parity with SURVEY.md §3.1.
+
+The reference's tests POST canned traces to a running service and assert the
+reported segments (SURVEY.md §4); these do the same through the WSGI
+interface (no sockets), plus unit tests of the cache and report builder.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import CompilerParams, Config, ServiceConfig
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.tiles.compiler import compile_network
+from reporter_tpu.netgen.traces import synthesize_probe
+from reporter_tpu.service.app import make_app
+from reporter_tpu.service.cache import PartialTraceCache
+from reporter_tpu.service.reports import Report, build_reports
+from reporter_tpu.matcher.segments import SegmentRecord
+
+
+def wsgi_call(app, method, path, payload=None):
+    body = json.dumps(payload).encode() if payload is not None else b""
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "CONTENT_LENGTH": str(len(body)),
+        "wsgi.input": io.BytesIO(body),
+    }
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = int(status.split()[0])
+
+    chunks = app(environ, start_response)
+    data = b"".join(chunks)
+    return captured["status"], (json.loads(data) if data else None)
+
+
+@pytest.fixture(scope="module")
+def svc_tiles():
+    """Short OSMLR segments (~200 m): full traversals are common, so the
+    fully-traversed-only report filter has something to let through."""
+    return compile_network(
+        generate_city("tiny"),
+        CompilerParams(reach_radius=500.0, osmlr_max_length=200.0))
+
+
+@pytest.fixture(scope="module")
+def app(svc_tiles):
+    published = []
+
+    def transport(url, body):
+        published.append(json.loads(body))
+        return 200
+
+    cfg = Config(service=ServiceConfig(datastore_url="http://datastore.test/"))
+    a = make_app(svc_tiles, cfg, transport=transport)
+    a.test_published = published
+    return a
+
+
+def _probe_payload(ts, seed=5, num_points=120):
+    return synthesize_probe(ts, seed=seed, num_points=num_points,
+                            gps_sigma=3.0).to_report_json()
+
+
+class TestEndpoints:
+    def test_health(self, app):
+        status, body = wsgi_call(app, "GET", "/health")
+        assert status == 200
+        assert body["status"] == "ok"
+        assert body["edges"] == app.matcher.ts.num_edges
+
+    def test_report_roundtrip(self, app, svc_tiles):
+        payload = _probe_payload(svc_tiles, seed=11)
+        status, body = wsgi_call(app, "POST", "/report", payload)
+        assert status == 200
+        assert body["mode"] == "auto"
+        assert len(body["segments"]) > 0
+        assert len(body["reports"]) > 0
+        for r in body["reports"]:
+            assert r["t1"] > r["t0"]
+            assert r["length"] > 0
+            assert r["id"] >= 0
+
+    def test_reports_published_to_datastore(self, app, svc_tiles):
+        before = app.publisher.published
+        payload = _probe_payload(svc_tiles, seed=12)
+        _, body = wsgi_call(app, "POST", "/report", payload)
+        assert app.publisher.published == before + len(body["reports"])
+        last = app.test_published[-1]
+        assert last["mode"] == "auto"
+        assert {"id", "next_id", "t0", "t1", "length", "queue_length"} <= set(
+            last["reports"][0])
+
+    def test_next_segment_chaining(self, app, svc_tiles):
+        payload = _probe_payload(svc_tiles, seed=13, num_points=200)
+        _, body = wsgi_call(app, "POST", "/report", payload)
+        reports = body["reports"]
+        if len(reports) >= 2:
+            # At least one consecutive pair should be chained.
+            assert any(r["next_id"] is not None for r in reports[:-1])
+            for a, b in zip(reports, reports[1:]):
+                if a["next_id"] is not None:
+                    assert a["next_id"] == b["id"]
+
+    def test_report_many_batches(self, app, svc_tiles):
+        payloads = [_probe_payload(svc_tiles, seed=20 + i) for i in range(3)]
+        status, body = wsgi_call(app, "POST", "/report_many",
+                                 {"traces": payloads})
+        assert status == 200
+        assert len(body["results"]) == 3
+        assert all(len(r["segments"]) > 0 for r in body["results"])
+
+    @pytest.mark.parametrize("method,path,payload,want", [
+        ("POST", "/report", None, 400),                       # empty body
+        ("POST", "/report", {"trace": [{"lat": 0, "lon": 0}]}, 400),  # no uuid
+        ("POST", "/report", {"uuid": "v", "trace": []}, 400),  # empty trace
+        ("POST", "/report", {"uuid": "v", "trace": [{"lat": 1}]}, 400),
+        ("GET", "/report", None, 405),
+        ("POST", "/nope", {"x": 1}, 404),
+    ])
+    def test_bad_requests(self, app, method, path, payload, want):
+        status, _ = wsgi_call(app, method, path, payload)
+        assert status == want
+
+
+class TestCacheContinuation:
+    def test_split_trace_completes_segments(self, svc_tiles):
+        """A traversal split across two /report calls is completed by the
+        per-uuid cache (the reference's partial-trace behavior)."""
+        cfg = Config()
+        app_split = make_app(svc_tiles, cfg)
+        app_whole = make_app(svc_tiles, cfg)
+
+        payload = _probe_payload(svc_tiles, seed=31, num_points=160)
+        pts = payload["trace"]
+        half = len(pts) // 2
+
+        whole = wsgi_call(app_whole, "POST", "/report", payload)[1]
+        first = wsgi_call(app_split, "POST", "/report",
+                          {"uuid": "v", "trace": pts[:half]})[1]
+        second = wsgi_call(app_split, "POST", "/report",
+                           {"uuid": "v", "trace": pts[half:]})[1]
+
+        ids_whole = [r["id"] for r in whole["reports"]]
+        ids_split = [r["id"] for r in first["reports"]] + [
+            r["id"] for r in second["reports"]]
+        # The split run must recover the segments a whole-trace run reports
+        # (duplicates possible at the seam; missing segments are the failure).
+        assert set(ids_whole) <= set(ids_split)
+
+    def test_duplicate_uuid_in_one_batch(self, svc_tiles):
+        """Two halves of one trace under the same uuid inside a single
+        /report_many batch behave as if they arrived sequentially."""
+        app = make_app(svc_tiles, Config())
+        payload = _probe_payload(svc_tiles, seed=31, num_points=160)
+        pts = payload["trace"]
+        half = len(pts) // 2
+        whole = app.report_one(payload)
+        app2 = make_app(svc_tiles, Config())
+        results = app2.report_many([
+            {"uuid": "v", "trace": pts[:half]},
+            {"uuid": "v", "trace": pts[half:]},
+        ])
+        ids_whole = {r["id"] for r in whole["reports"]}
+        ids_batch = {r["id"] for res in results for r in res["reports"]}
+        assert ids_whole <= ids_batch
+
+    def test_cache_is_dropped_after_completion(self, svc_tiles):
+        app = make_app(svc_tiles, Config())
+        payload = _probe_payload(svc_tiles, seed=32)
+        wsgi_call(app, "POST", "/report", payload)
+        # Tail at or after the last complete segment is retained, bounded.
+        assert len(app.cache) <= 1
+
+
+class TestPartialTraceCache:
+    def test_merge_dedupes_and_sorts(self):
+        c = PartialTraceCache(ttl=60)
+        c.retain("v", [{"lat": 0, "lon": 0, "time": 1.0},
+                       {"lat": 0, "lon": 0, "time": 2.0}], from_time=0.0)
+        merged = c.merge("v", [{"lat": 0, "lon": 0, "time": 2.0},
+                               {"lat": 0, "lon": 0, "time": 3.0}])
+        assert [p["time"] for p in merged] == [1.0, 2.0, 3.0]
+
+    def test_ttl_eviction_with_fake_clock(self):
+        now = [0.0]
+        c = PartialTraceCache(ttl=10.0, clock=lambda: now[0])
+        c.retain("v", [{"lat": 0, "lon": 0, "time": 1.0}], from_time=0.0)
+        assert len(c) == 1
+        now[0] = 11.0
+        assert c.merge("v", []) == []          # evicted on access
+        assert len(c) == 0
+
+    def test_lru_bound(self):
+        c = PartialTraceCache(ttl=1e9, max_uuids=2)
+        for i in range(4):
+            c.retain(f"v{i}", [{"lat": 0, "lon": 0, "time": 1.0}], 0.0)
+        assert len(c) == 2
+        assert c.merge("v3", []) != []
+        assert c.merge("v0", []) == []
+
+
+class TestReportBuilder:
+    def _rec(self, sid, t0, t1, internal=False, length=100.0):
+        return SegmentRecord(segment_id=sid, way_ids=[1], start_time=t0,
+                             end_time=t1, length=length, internal=internal)
+
+    def test_filters_partial_and_internal(self):
+        recs = [
+            self._rec(1, 0.0, 10.0),
+            self._rec(2, 10.0, -1.0),          # exit unobserved → dropped
+            self._rec(-1, 3.0, 4.0, internal=True),
+            self._rec(3, -1.0, 20.0),          # entry unobserved → dropped
+        ]
+        reports = build_reports(recs)
+        assert [r.segment_id for r in reports] == [1]
+
+    def test_min_length(self):
+        recs = [self._rec(1, 0.0, 10.0, length=5.0)]
+        assert build_reports(recs, min_length=10.0) == []
+        assert len(build_reports(recs, min_length=1.0)) == 1
+
+    def test_chaining_across_internal_connector(self):
+        """Internal connector edges must NOT break the segment pair — that is
+        what the internal flag exists for (turn channels between segments)."""
+        recs = [self._rec(1, 0.0, 10.0),
+                self._rec(-1, 10.0, 12.0, internal=True),
+                self._rec(2, 12.0, 20.0)]
+        reports = build_reports(recs)
+        assert reports[0].next_segment_id == 2
+
+    def test_partial_record_breaks_chain(self):
+        recs = [self._rec(1, 0.0, 10.0),
+                self._rec(2, 10.0, -1.0),          # in-progress, unobserved exit
+                self._rec(3, 10.0, 20.0)]
+        reports = build_reports(recs)
+        assert reports[0].next_segment_id is None
+
+    def test_chaining_requires_contiguity(self):
+        recs = [self._rec(1, 0.0, 10.0), self._rec(2, 10.0, 20.0),
+                self._rec(3, 25.0, 30.0)]     # gap 20→25 breaks the chain
+        reports = build_reports(recs)
+        assert reports[0].next_segment_id == 2
+        assert reports[1].next_segment_id is None
+        assert reports[2].next_segment_id is None
