@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Benchmark harness (driver hook): BASELINE.md config 2.
+
+Matches 1k batched 120-point vehicle traces against one metro tile ("sf",
+synthetic — no OSM extracts in this environment) with the jax backend, and a
+sample of the same traces with the in-repo CPU reference matcher (the Meili
+stand-in, BASELINE config 1's anchor).
+
+Prints ONE JSON line:
+  {"metric": "probes_per_sec_e2e", "value": ..., "unit": "probes/s",
+   "vs_baseline": <jax throughput / cpu-reference throughput>, ...detail}
+
+"e2e" = the full SegmentMatcher.match_many path: host batching, device
+decode, segment association, report-ready records — the same work the
+reference's segment_matcher.Match does per trace.
+"""
+
+import json
+import sys
+import time
+
+
+def _time_best(fn, repeats: int) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    t_setup = time.perf_counter()
+    import jax
+
+    from reporter_tpu.config import CompilerParams, Config
+    from reporter_tpu.matcher.api import SegmentMatcher, Trace
+    from reporter_tpu.netgen.synthetic import generate_city
+    from reporter_tpu.netgen.traces import synthesize_fleet
+    from reporter_tpu.tiles.compiler import compile_network
+
+    n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    n_points = 120
+    n_cpu = min(20, n_traces)
+
+    ts = compile_network(generate_city("sf"), CompilerParams())
+    fleet = synthesize_fleet(ts, n_traces, num_points=n_points, seed=7)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype("float32"), times=p.times)
+              for p in fleet]
+
+    jax_matcher = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    jax_matcher.match_many(traces[:8])              # compile + stage HBM
+    dt_jax = _time_best(lambda: jax_matcher.match_many(traces), repeats=3)
+
+    # Device-decode-only throughput (the kernel itself, no host walk).
+    dt_decode = _time_best(lambda: jax_matcher._decode_many(traces), repeats=3)
+
+    cpu_matcher = SegmentMatcher(ts, Config(matcher_backend="reference_cpu"))
+    dt_cpu = _time_best(lambda: cpu_matcher.match_many(traces[:n_cpu]),
+                        repeats=1)
+
+    probes = n_traces * n_points
+    jax_pps = probes / dt_jax
+    cpu_pps = (n_cpu * n_points) / dt_cpu
+    print(json.dumps({
+        "metric": "probes_per_sec_e2e",
+        "value": round(jax_pps, 1),
+        "unit": "probes/s",
+        "vs_baseline": round(jax_pps / cpu_pps, 2),
+        "detail": {
+            "config": f"{n_traces}x{n_points}pt traces, tile={ts.name}",
+            "device": str(jax.devices()[0]).split(":")[0],
+            "decode_only_probes_per_sec": round(probes / dt_decode, 1),
+            "cpu_reference_probes_per_sec": round(cpu_pps, 1),
+            "batch_seconds": round(dt_jax, 3),
+            "setup_seconds": round(time.perf_counter() - t_setup, 1),
+            "tile_stats": ts.stats,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

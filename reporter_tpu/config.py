@@ -34,6 +34,8 @@ class MatcherParams:
                                    # GPS noise shifts projections backwards between samples —
                                    # Meili absorbs this via input interpolation, we absorb it
                                    # in the transition model (ops/hmm.route_distance)
+    max_device_batch: int = 4096   # traces per device dispatch; bounds HBM for
+                                   # candidate-search intermediates (B·T·9C floats)
 
     def replace(self, **kw: Any) -> "MatcherParams":
         return dataclasses.replace(self, **kw)

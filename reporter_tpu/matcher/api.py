@@ -157,7 +157,11 @@ class SegmentMatcher:
         by_bucket: dict[int, list[int]] = {}
         for w, (_, _, xy) in enumerate(work):
             by_bucket.setdefault(_bucket_len(len(xy)), []).append(w)
-        for b, ws in sorted(by_bucket.items()):
+        chunk = max(1, self.params.max_device_batch)
+        sliced = [(b, ws[i:i + chunk])
+                  for b, ws in sorted(by_bucket.items())
+                  for i in range(0, len(ws), chunk)]
+        for b, ws in sliced:
             B = len(ws)
             pts = np.zeros((B, b, 2), np.float32)
             valid = np.zeros((B, b), bool)

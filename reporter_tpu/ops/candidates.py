@@ -31,14 +31,21 @@ class CandidateSet(NamedTuple):
     valid: jnp.ndarray   # bool [T, K]
 
 
-def _point_segment_dist(p, a, b):
-    """Device mirror of geometry.point_segment_project (distance + t)."""
-    ab = b - a
-    denom = jnp.maximum(jnp.sum(ab * ab, axis=-1), 1e-12)
-    t = jnp.clip(jnp.sum((p - a) * ab, axis=-1) / denom, 0.0, 1.0)
-    proj = a + t[..., None] * ab
-    d = jnp.sqrt(jnp.sum((p - proj) ** 2, axis=-1))
-    return d, t
+def _point_segment_dist(px, py, ax, ay, bx, by):
+    """Device mirror of geometry.point_segment_project (distance + t).
+
+    Componentwise (structure-of-arrays) on purpose: stacking xy into a
+    trailing size-2 axis would tile terribly on TPU (lane dim padded 2→128);
+    with flat [n] operands everything rides the VPU at full width.
+    """
+    abx = bx - ax
+    aby = by - ay
+    denom = jnp.maximum(abx * abx + aby * aby, 1e-12)
+    t = jnp.clip(((px - ax) * abx + (py - ay) * aby) / denom, 0.0, 1.0)
+    dx = px - (ax + t * abx)
+    dy = py - (ay + t * aby)
+    d = jnp.sqrt(dx * dx + dy * dy)
+    return d, t, jnp.sqrt(denom)
 
 
 def gather_cell_segments(pt, grid, meta: TileMeta):
@@ -92,17 +99,18 @@ def find_candidates(pt, tables, meta: TileMeta, search_radius: float,
     """
     segs = gather_cell_segments(pt, tables["grid"], meta)        # [9C]
     safe = jnp.maximum(segs, 0)
-    a = tables["seg_a"][safe]
-    b = tables["seg_b"][safe]
-    d, t = _point_segment_dist(pt[None, :], a, b)
+    ax = tables["seg_ax"][safe]
+    ay = tables["seg_ay"][safe]
+    bx = tables["seg_bx"][safe]
+    by = tables["seg_by"][safe]
+    d, t, seg_norm = _point_segment_dist(pt[0], pt[1], ax, ay, bx, by)
     seg_valid = (segs >= 0) & (d <= search_radius)
     d = jnp.where(seg_valid, d, BIG)
     seg_edge = jnp.where(segs >= 0, tables["seg_edge"][safe], -1)
 
     edges, best_d, idx, t_at, ok = _topk_distinct_edges(
         seg_edge, d, t, max_candidates)
-    off = tables["seg_off"][safe[idx]] + t_at * jnp.linalg.norm(
-        (b - a)[idx], axis=-1)
+    off = tables["seg_off"][safe[idx]] + t_at * seg_norm[idx]
     return CandidateSet(
         edge=edges.astype(jnp.int32),
         offset=jnp.where(ok, off, 0.0).astype(jnp.float32),

@@ -125,9 +125,15 @@ class TileSet:
         plain dict pytree of jnp arrays (HBM-resident after first use)."""
         import jax.numpy as jnp
 
+        # Segment endpoints go to device as structure-of-arrays: a gathered
+        # [n, 2] array would be tiled T(8,128) on TPU, padding the size-2 lane
+        # dimension to 128 (64× memory blowup at batch scale); four flat [S]
+        # vectors gather into [n] with no padding.
         return {
-            "seg_a": jnp.asarray(self.seg_a),
-            "seg_b": jnp.asarray(self.seg_b),
+            "seg_ax": jnp.asarray(self.seg_a[:, 0]),
+            "seg_ay": jnp.asarray(self.seg_a[:, 1]),
+            "seg_bx": jnp.asarray(self.seg_b[:, 0]),
+            "seg_by": jnp.asarray(self.seg_b[:, 1]),
             "seg_edge": jnp.asarray(self.seg_edge),
             "seg_off": jnp.asarray(self.seg_off),
             "grid": jnp.asarray(self.grid),
