@@ -12,7 +12,7 @@ vmappable over points and traces.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +20,28 @@ import jax.numpy as jnp
 from reporter_tpu.tiles.tileset import TileMeta
 
 BIG = jnp.float32(1e30)   # "infinity" that survives subtraction without NaNs
+
+
+class GridMeta(NamedTuple):
+    """Grid geometry as scalars — static Python floats for the single-metro
+    path, or traced jnp scalars when each shard of a sharded mesh carries a
+    different metro's grid (parallel/multimetro.py). ``cell_size`` must stay
+    static either way: the 3×3-gather coverage check against search_radius
+    happens at trace time."""
+
+    ox: Any          # grid origin x (cell (0,0) lower-left)
+    oy: Any          # grid origin y
+    cell_size: float
+    gw: Any          # grid width in cells
+    gh: Any          # grid height in cells
+
+
+def as_grid_meta(meta: "TileMeta | GridMeta") -> GridMeta:
+    if isinstance(meta, GridMeta):
+        return meta
+    return GridMeta(ox=meta.grid_origin[0], oy=meta.grid_origin[1],
+                    cell_size=meta.cell_size,
+                    gw=meta.grid_dims[0], gh=meta.grid_dims[1])
 
 
 class CandidateSet(NamedTuple):
@@ -48,15 +70,19 @@ def _point_segment_dist(px, py, ax, ay, bx, by):
     return d, t, jnp.sqrt(denom)
 
 
-def gather_cell_segments(pt, grid, meta: TileMeta):
+def gather_cell_segments(pt, grid, meta: "TileMeta | GridMeta"):
     """Segment ids registered in the 3×3 cell neighborhood of ``pt``.
 
     Returns i32 [9*C]; -1 entries are padding or out-of-bounds cells.
+    Out-of-range cell rows of a *padded* grid (multimetro stacking pads every
+    metro's grid to the same cell count) are never touched: indices are
+    clipped to the metro's own gw/gh and masked by in_bounds.
     """
-    gw, gh = meta.grid_dims
-    ox, oy = meta.grid_origin
-    cx = jnp.floor((pt[0] - ox) / meta.cell_size).astype(jnp.int32)
-    cy = jnp.floor((pt[1] - oy) / meta.cell_size).astype(jnp.int32)
+    gm = as_grid_meta(meta)
+    gw, gh = gm.gw, gm.gh
+    ox, oy = gm.ox, gm.oy
+    cx = jnp.floor((pt[0] - ox) / gm.cell_size).astype(jnp.int32)
+    cy = jnp.floor((pt[1] - oy) / gm.cell_size).astype(jnp.int32)
     dx = jnp.array([-1, -1, -1, 0, 0, 0, 1, 1, 1], jnp.int32)
     dy = jnp.array([-1, 0, 1, -1, 0, 1, -1, 0, 1], jnp.int32)
     xs = cx + dx
@@ -90,8 +116,8 @@ def _topk_distinct_edges(seg_edges, dists, ts, k: int):
     return edges, best_d, idx, ts[idx], ok
 
 
-def find_candidates(pt, tables, meta: TileMeta, search_radius: float,
-                    max_candidates: int):
+def find_candidates(pt, tables, meta: "TileMeta | GridMeta",
+                    search_radius: float, max_candidates: int):
     """Candidates for ONE point. vmap over T (and again over batch) upstream.
 
     tables: dict from TileSet.device_tables().
@@ -119,7 +145,8 @@ def find_candidates(pt, tables, meta: TileMeta, search_radius: float,
     )
 
 
-def find_candidates_trace(points, tables, meta: TileMeta, search_radius: float,
+def find_candidates_trace(points, tables, meta: "TileMeta | GridMeta",
+                          search_radius: float,
                           max_candidates: int) -> CandidateSet:
     """[T, 2] points → CandidateSet with [T, K] fields."""
     return jax.vmap(

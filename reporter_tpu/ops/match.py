@@ -30,9 +30,13 @@ class MatchOutput(NamedTuple):
     matched: jnp.ndarray      # bool [.., T]
 
 
-def match_trace(points, valid_pt, tables, meta: TileMeta,
+def match_trace(points, valid_pt, tables, meta,
                 params: MatcherParams) -> MatchOutput:
-    """Match ONE padded trace: points f32 [T, 2], valid_pt bool [T]."""
+    """Match ONE padded trace: points f32 [T, 2], valid_pt bool [T].
+
+    meta: TileMeta (static) or ops.candidates.GridMeta (scalars, possibly
+    traced — the multimetro sharded path).
+    """
     if params.search_radius > meta.cell_size:
         # Trace-time check (both are static): the 3×3 grid gather only covers
         # one cell ring, so a radius beyond cell_size silently drops roads.

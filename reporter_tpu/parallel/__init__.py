@@ -1,0 +1,36 @@
+"""Scale-out: device meshes, sharded matchers, multi-metro dispatch.
+
+The reference scales out with Kafka partitions × consumer-group workers and a
+thread pool in the HTTP service (SURVEY.md §2.3). The TPU-native mapping:
+
+  data parallelism   → batch axis sharded over the mesh's "dp" axis
+                       (BASELINE configs 2–3)
+  sharded-state (EP) → each shard of the "tile" axis holds a different
+                       metro's tile arrays; probes are dispatched to their
+                       metro's shard, MoE-style (BASELINE config 4)
+  collectives        → XLA psum over ICI for cross-shard aggregation
+                       (per-segment histograms), not NCCL/MPI
+
+No NCCL/Kafka translation: shardings are declared with jax.sharding and XLA
+inserts the collectives.
+"""
+
+from reporter_tpu.parallel.mesh import make_mesh
+from reporter_tpu.parallel.dp import make_dp_matcher
+from reporter_tpu.parallel.multimetro import (
+    MetroBatch,
+    StackedTiles,
+    dispatch_traces,
+    make_multimetro_matcher,
+    stack_tilesets,
+)
+
+__all__ = [
+    "make_mesh",
+    "make_dp_matcher",
+    "MetroBatch",
+    "StackedTiles",
+    "dispatch_traces",
+    "make_multimetro_matcher",
+    "stack_tilesets",
+]

@@ -1,0 +1,37 @@
+"""Data-parallel matcher: batch axis sharded over the mesh (config 3).
+
+Tile arrays are replicated to every device once (they are read-only); each
+batch dispatch shards traces across "dp" × "tile" as one flat data axis — no
+cross-device communication in the forward match at all, which is exactly why
+DP is the first-choice scaling axis for this workload (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from reporter_tpu.config import MatcherParams
+from reporter_tpu.ops.match import MatchOutput, match_trace
+from reporter_tpu.tiles.tileset import TileSet
+
+
+def make_dp_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams):
+    """Returns fn(points [B,T,2], valid [B,T]) → MatchOutput, batch sharded
+    over every mesh axis. B must be divisible by the mesh's device count
+    (pad with valid=False rows on host)."""
+    axes = tuple(mesh.axis_names)              # ("tile", "dp") or ("dp",)
+    tables = jax.device_put(ts.device_tables(),
+                            NamedSharding(mesh, P()))      # replicated
+    batch_sh = NamedSharding(mesh, P(axes))    # shard B over all axes
+    meta = ts.meta
+
+    @functools.partial(jax.jit, in_shardings=(batch_sh, batch_sh),
+                       out_shardings=batch_sh)
+    def step(points, valid) -> MatchOutput:
+        return jax.vmap(lambda p, v: match_trace(p, v, tables, meta, params))(
+            points, valid)
+
+    return step

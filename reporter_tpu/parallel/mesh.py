@@ -1,0 +1,39 @@
+"""Mesh construction helpers.
+
+One place decides how physical devices become logical axes:
+
+  ("dp",)          — pure data parallelism (BASELINE config 3)
+  ("tile", "dp")   — metro shards × data parallelism (BASELINE config 4)
+
+On a real v5e-8 slice the axes ride ICI; under
+``--xla_force_host_platform_device_count=N`` the same code runs on virtual
+CPU devices (SURVEY.md §4), which is how tests and the driver's multichip
+dry-run validate shardings without 8 chips.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(tile: int = 1, dp: int | None = None,
+              devices=None) -> Mesh:
+    """Build a ("tile", "dp") mesh over ``tile * dp`` devices.
+
+    dp=None uses all remaining devices. tile=1 degenerates to data-parallel
+    only (the "tile" axis still exists, size 1, so downstream sharding specs
+    are uniform).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if dp is None:
+        if len(devices) % tile:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by tile={tile}")
+        dp = len(devices) // tile
+    need = tile * dp
+    if need > len(devices):
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(tile, dp)
+    return Mesh(arr, ("tile", "dp"))

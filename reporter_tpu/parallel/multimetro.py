@@ -1,0 +1,199 @@
+"""Multi-metro tile sharding (BASELINE config 4: SF + NYC + LA on one mesh).
+
+The reference's analog is sharded-by-key state: each Kafka partition's worker
+owns its vehicles (SURVEY.md §2.3 "EP"). Here each shard of the mesh's
+"tile" axis owns whole metros: every metro's tile arrays are padded to a
+common shape, stacked on a leading metro axis, and sharded over "tile";
+probes are dispatched to their metro's shard on host (the MoE-style router).
+Inside shard_map each shard matches only its own metros' probes — zero
+cross-shard traffic in the matcher — and a per-segment observation histogram
+is psum'd over the "dp" axis (the ICI collective; SURVEY.md §2.3
+"Collective/comm backend").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from reporter_tpu.config import MatcherParams
+from reporter_tpu.ops.candidates import GridMeta
+from reporter_tpu.ops.match import MatchOutput, match_trace
+from reporter_tpu.tiles.tileset import TileSet
+
+_PAD_VALUES: dict[str, Any] = {
+    "grid": -1,              # missing cell entries = no segment
+    "reach_to": -1,          # no reachable target
+    "reach_dist": np.float32(np.inf),
+    "seg_edge": -1,
+    "edge_osmlr": -1,
+    # coordinates / lengths / offsets: zero is safe, padded ids above make
+    # sure padded rows are never selected as real candidates
+}
+
+
+class StackedTiles(NamedTuple):
+    """All metros' device tables, shape-padded and stacked on axis 0."""
+
+    tables: dict[str, jnp.ndarray]   # each [M, ...]
+    names: tuple[str, ...]
+    cell_size: float
+    num_osmlr: tuple[int, ...]       # real OSMLR row count per metro
+    osmlr_pad: int                   # padded G (histogram width)
+
+
+def _pad_to(arr: np.ndarray, shape: tuple[int, ...], fill) -> np.ndarray:
+    out = np.full(shape, fill, dtype=arr.dtype)
+    out[tuple(slice(0, s) for s in arr.shape)] = arr
+    return out
+
+
+def stack_tilesets(tilesets: Sequence[TileSet]) -> StackedTiles:
+    """Pad every metro's device tables to common shapes and stack them.
+
+    Requires a uniform compiler cell_size (it is a static kernel parameter);
+    grid origin/dims vary per metro and ride along as traced scalars.
+    """
+    cell_sizes = {ts.meta.cell_size for ts in tilesets}
+    if len(cell_sizes) != 1:
+        raise ValueError(f"metros compiled with differing cell_size: {cell_sizes}")
+
+    host_tables = []
+    for ts in tilesets:
+        t = {k: np.asarray(v) for k, v in ts.device_tables().items()}
+        t["grid_ox"] = np.float32(ts.meta.grid_origin[0])
+        t["grid_oy"] = np.float32(ts.meta.grid_origin[1])
+        t["grid_gw"] = np.int32(ts.meta.grid_dims[0])
+        t["grid_gh"] = np.int32(ts.meta.grid_dims[1])
+        host_tables.append(t)
+
+    keys = host_tables[0].keys()
+    stacked: dict[str, jnp.ndarray] = {}
+    for k in keys:
+        arrs = [t[k] for t in host_tables]
+        shape = tuple(max(a.shape[d] for a in arrs)
+                      for d in range(arrs[0].ndim))
+        fill = _PAD_VALUES.get(k, 0)
+        stacked[k] = jnp.asarray(np.stack(
+            [_pad_to(a, shape, fill) for a in arrs]))
+
+    num_osmlr = tuple(len(ts.osmlr_id) for ts in tilesets)
+    return StackedTiles(
+        tables=stacked,
+        names=tuple(ts.name for ts in tilesets),
+        cell_size=float(cell_sizes.pop()),
+        num_osmlr=num_osmlr,
+        osmlr_pad=max(num_osmlr),
+    )
+
+
+def make_multimetro_matcher(mesh: Mesh, stacked: StackedTiles,
+                            params: MatcherParams):
+    """Build the sharded step: fn(points [M,B,T,2], valid [M,B,T]) →
+    (MatchOutput [M,B,T], hist [M, G]).
+
+    M (metro count) must be divisible by the mesh's "tile" axis; B by "dp".
+    ``hist`` counts matched-point observations per OSMLR row, summed over the
+    whole "dp" axis on device (psum over ICI) — the seed of the streaming
+    speed-histogram path (BASELINE config 5).
+    """
+    if params.search_radius > stacked.cell_size:
+        raise ValueError(
+            f"search_radius ({params.search_radius}) exceeds cell_size "
+            f"({stacked.cell_size})")
+    n_tile = mesh.shape["tile"]
+    if len(stacked.names) % n_tile:
+        raise ValueError(
+            f"{len(stacked.names)} metros not divisible by tile axis {n_tile}")
+
+    cell_size = stacked.cell_size
+    gmax = stacked.osmlr_pad
+    tables = jax.device_put(
+        stacked.tables,
+        NamedSharding(mesh, P("tile")))     # metro axis sharded, rest local
+
+    def per_metro(pts, val, tbl):
+        gm = GridMeta(ox=tbl["grid_ox"], oy=tbl["grid_oy"],
+                      cell_size=cell_size, gw=tbl["grid_gw"],
+                      gh=tbl["grid_gh"])
+        out = jax.vmap(lambda p, v: match_trace(p, v, tbl, gm, params))(
+            pts, val)
+        rows = jnp.where(out.matched,
+                         tbl["edge_osmlr"][jnp.maximum(out.edge, 0)], -1)
+        ok = (rows >= 0).reshape(-1)
+        hist = jnp.zeros((gmax,), jnp.int32).at[
+            jnp.maximum(rows, 0).reshape(-1)].add(ok.astype(jnp.int32))
+        return out, hist
+
+    def local_step(points, valid, tbl):
+        # points [m_local, b_local, T, 2]; tbl leaves [m_local, ...]
+        out, hist = jax.vmap(per_metro)(points, valid, tbl)
+        hist = jax.lax.psum(hist, "dp")     # full counts on every dp shard
+        return out, hist
+
+    tbl_specs = jax.tree.map(lambda _: P("tile"), tables)
+    # check_vma off: the Viterbi scan seeds its carry from constants, which
+    # the varying-manual-axes checker rejects inside shard_map even though
+    # the computation is per-shard correct (constants are trivially varying).
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("tile", "dp"), P("tile", "dp"), tbl_specs),
+        out_specs=(P("tile", "dp"), P("tile")),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(points, valid):
+        return sharded(points, valid, tables)
+
+    return step
+
+
+class MetroBatch(NamedTuple):
+    """Host-side dispatch result: device inputs + scatter-back indices."""
+
+    points: np.ndarray               # f32 [M, B, T, 2]
+    valid: np.ndarray                # bool [M, B, T]
+    # [metro][slot] → (caller job idx, chunk start within the job, length);
+    # over-bucket jobs occupy several consecutive slots (chunked like
+    # matcher.api._decode_many — each chunk is an independent HMM).
+    index: list[list[tuple[int, int, int]]]
+
+
+def dispatch_traces(names: Sequence[str],
+                    jobs: Sequence[tuple[str, np.ndarray]],
+                    dp: int, bucket: int) -> MetroBatch:
+    """Route (metro, points[T,2]) jobs into padded [M, B, T] device arrays.
+
+    Jobs longer than ``bucket`` are split into consecutive chunks (one slot
+    each). B is the max per-metro slot count, rounded up to
+    dp × next-power-of-two so repeat dispatches reuse a small set of compiled
+    shapes instead of recompiling per load level; T pads to ``bucket``.
+    """
+    by_metro: dict[str, list[tuple[int, int, int]]] = {n: [] for n in names}
+    for j, (metro, xy) in enumerate(jobs):
+        if metro not in by_metro:
+            raise KeyError(f"unknown metro {metro!r}; have {list(names)}")
+        for lo in range(0, max(len(xy), 1), bucket):
+            by_metro[metro].append((j, lo, min(bucket, len(xy) - lo)))
+
+    load = max((len(v) for v in by_metro.values()), default=1)
+    B = dp * (1 << max(0, (load + dp - 1) // dp - 1).bit_length())
+    M = len(names)
+    points = np.zeros((M, B, bucket, 2), np.float32)
+    valid = np.zeros((M, B, bucket), bool)
+    index: list[list[tuple[int, int, int]]] = []
+    for m, name in enumerate(names):
+        slots = []
+        for slot, (j, lo, t) in enumerate(by_metro[name]):
+            xy = jobs[j][1]
+            points[m, slot, :t] = xy[lo:lo + t]
+            valid[m, slot, :t] = True
+            slots.append((j, lo, t))
+        index.append(slots)
+    return MetroBatch(points=points, valid=valid, index=index)

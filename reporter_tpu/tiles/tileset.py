@@ -138,6 +138,7 @@ class TileSet:
             "seg_off": jnp.asarray(self.seg_off),
             "grid": jnp.asarray(self.grid),
             "edge_len": jnp.asarray(self.edge_len),
+            "edge_osmlr": jnp.asarray(self.edge_osmlr),
             "reach_to": jnp.asarray(self.reach_to),
             "reach_dist": jnp.asarray(self.reach_dist),
         }

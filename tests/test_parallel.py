@@ -1,0 +1,164 @@
+"""Sharding tests on the virtual 8-device CPU mesh (SURVEY.md §4:
+"multi-device tests without a cluster")."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from reporter_tpu.config import CompilerParams, MatcherParams
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.ops.match import match_batch
+from reporter_tpu.parallel import (
+    dispatch_traces,
+    make_dp_matcher,
+    make_mesh,
+    make_multimetro_matcher,
+    stack_tilesets,
+)
+from reporter_tpu.tiles.compiler import compile_network
+
+PARAMS = MatcherParams()
+
+
+@pytest.fixture(scope="module")
+def metro_a():
+    return compile_network(generate_city("tiny"), CompilerParams(reach_radius=500.0))
+
+
+@pytest.fixture(scope="module")
+def metro_b():
+    return compile_network(generate_city("tiny", seed=42),
+                           CompilerParams(reach_radius=500.0))
+
+
+def _batch(ts, n, T=64, seed=0):
+    fleet = synthesize_fleet(ts, n, num_points=T, seed=seed, gps_sigma=3.0)
+    pts = np.stack([p.xy for p in fleet]).astype(np.float32)
+    valid = np.ones((n, T), bool)
+    return pts, valid
+
+
+class TestMesh:
+    def test_devices_available(self):
+        assert len(jax.devices()) == 8
+
+    def test_shapes(self):
+        m = make_mesh(tile=2)
+        assert dict(m.shape) == {"tile": 2, "dp": 4}
+        m = make_mesh()
+        assert dict(m.shape) == {"tile": 1, "dp": 8}
+
+    def test_bad_split(self):
+        with pytest.raises(ValueError):
+            make_mesh(tile=3)
+
+
+class TestDataParallel:
+    def test_matches_single_device(self, metro_a):
+        ts = metro_a
+        pts, valid = _batch(ts, 16)
+        want = match_batch(jnp.asarray(pts), jnp.asarray(valid),
+                           ts.device_tables(), ts.meta, PARAMS)
+        mesh = make_mesh()
+        step = make_dp_matcher(mesh, ts, PARAMS)
+        got = step(jnp.asarray(pts), jnp.asarray(valid))
+        np.testing.assert_array_equal(np.asarray(got.edge), np.asarray(want.edge))
+        np.testing.assert_allclose(np.asarray(got.offset),
+                                   np.asarray(want.offset), atol=1e-3)
+
+    def test_output_is_sharded(self, metro_a):
+        pts, valid = _batch(metro_a, 16)
+        mesh = make_mesh()
+        step = make_dp_matcher(mesh, metro_a, PARAMS)
+        got = step(jnp.asarray(pts), jnp.asarray(valid))
+        assert len(got.edge.sharding.device_set) == 8
+
+
+class TestMultiMetro:
+    def test_per_metro_outputs_match_single(self, metro_a, metro_b):
+        stacked = stack_tilesets([metro_a, metro_b])
+        mesh = make_mesh(tile=2)          # 2 metros × dp=4
+        step = make_multimetro_matcher(mesh, stacked, PARAMS)
+
+        B, T = 8, 64
+        pts_a, val_a = _batch(metro_a, B, T=T, seed=1)
+        pts_b, val_b = _batch(metro_b, B, T=T, seed=2)
+        points = np.stack([pts_a, pts_b])
+        valid = np.stack([val_a, val_b])
+
+        out, hist = step(jnp.asarray(points), jnp.asarray(valid))
+
+        for m, ts in enumerate((metro_a, metro_b)):
+            want = match_batch(jnp.asarray(points[m]), jnp.asarray(valid[m]),
+                               ts.device_tables(), ts.meta, PARAMS)
+            np.testing.assert_array_equal(np.asarray(out.edge[m]),
+                                          np.asarray(want.edge))
+            np.testing.assert_allclose(np.asarray(out.offset[m]),
+                                       np.asarray(want.offset), atol=1e-3)
+
+    def test_histogram_counts_match_output(self, metro_a, metro_b):
+        stacked = stack_tilesets([metro_a, metro_b])
+        mesh = make_mesh(tile=2)
+        step = make_multimetro_matcher(mesh, stacked, PARAMS)
+        B, T = 8, 64
+        pts_a, val_a = _batch(metro_a, B, T=T, seed=3)
+        pts_b, val_b = _batch(metro_b, B, T=T, seed=4)
+        out, hist = step(jnp.asarray(np.stack([pts_a, pts_b])),
+                         jnp.asarray(np.stack([val_a, val_b])))
+        hist = np.asarray(hist)
+
+        for m, ts in enumerate((metro_a, metro_b)):
+            edges = np.asarray(out.edge[m])
+            matched = np.asarray(out.matched[m])
+            rows = ts.edge_osmlr[np.maximum(edges, 0)]
+            rows = rows[matched & (edges >= 0)]
+            rows = rows[rows >= 0]
+            want = np.bincount(rows, minlength=stacked.osmlr_pad)
+            np.testing.assert_array_equal(hist[m], want)
+            # padded rows beyond this metro's real G stay empty
+            assert hist[m, stacked.num_osmlr[m]:].sum() == 0
+
+    def test_metro_count_must_divide(self, metro_a, metro_b):
+        stacked = stack_tilesets([metro_a, metro_b])
+        with pytest.raises(ValueError):
+            make_multimetro_matcher(make_mesh(tile=4), stacked, PARAMS)
+
+
+class TestDispatch:
+    def test_routing_and_padding(self):
+        names = ("a", "b")
+        jobs = [("a", np.ones((10, 2), np.float32)),
+                ("b", np.ones((5, 2), np.float32)),
+                ("a", np.ones((7, 2), np.float32))]
+        mb = dispatch_traces(names, jobs, dp=4, bucket=16)
+        assert mb.points.shape == (2, 4, 16, 2)
+        assert mb.index[0] == [(0, 0, 10), (2, 0, 7)]
+        assert mb.index[1] == [(1, 0, 5)]
+        assert mb.valid[0, 0, :10].all() and not mb.valid[0, 0, 10:].any()
+        assert not mb.valid[1, 1:].any()
+
+    def test_long_traces_are_chunked_not_truncated(self):
+        xy = np.arange(40, dtype=np.float32).reshape(20, 2)
+        mb = dispatch_traces(("a",), [("a", xy)], dp=1, bucket=8)
+        assert mb.index[0] == [(0, 0, 8), (0, 8, 8), (0, 16, 4)]
+        # every input point lands in exactly one valid slot
+        total_valid = int(mb.valid.sum())
+        assert total_valid == 20
+        np.testing.assert_array_equal(mb.points[0, 2, :4], xy[16:])
+
+    def test_batch_shape_is_quantized(self):
+        """B rounds to dp×2^k so repeat dispatches reuse compiled shapes."""
+        def B_for(n_jobs):
+            jobs = [("a", np.ones((4, 2), np.float32))] * n_jobs
+            return dispatch_traces(("a",), jobs, dp=4, bucket=8).points.shape[1]
+        assert B_for(3) == 4
+        assert B_for(5) == 8
+        assert B_for(9) == 16
+        assert B_for(13) == 16
+
+    def test_unknown_metro_raises(self):
+        with pytest.raises(KeyError):
+            dispatch_traces(("a",), [("zz", np.ones((2, 2), np.float32))],
+                            dp=1, bucket=8)
