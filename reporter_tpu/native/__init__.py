@@ -1,0 +1,12 @@
+"""Native (C++) tile-compiler kernels, built on demand with g++.
+
+See reach.cc for what lives here and why. Import surface:
+
+    from reporter_tpu.native import lib        # ctypes CDLL or None
+"""
+
+from reporter_tpu.native.build import load_native_lib
+
+lib = load_native_lib()
+
+__all__ = ["lib", "load_native_lib"]

@@ -1,0 +1,91 @@
+"""Build + load the native library.
+
+No pybind11 in this image, so the binding is plain ctypes over an
+``extern "C"`` surface. The .so is compiled next to the sources on first
+import (and rebuilt whenever reach.cc is newer), so a source checkout works
+without a packaging step — the moral equivalent of the reference's
+Docker-image build of Valhalla (SURVEY.md §2.1 "Packaging").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+
+log = logging.getLogger("reporter_tpu.native")
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ("reach.cc",)
+_LIB_NAME = "_libreporter.so"
+
+
+def _needs_build(lib_path: str) -> bool:
+    if not os.path.exists(lib_path):
+        return True
+    lib_mtime = os.path.getmtime(lib_path)
+    return any(
+        os.path.getmtime(os.path.join(_SRC_DIR, s)) > lib_mtime
+        for s in _SOURCES)
+
+
+def build_native_lib(force: bool = False) -> str | None:
+    """Compile the shared library; returns its path or None on failure."""
+    lib_path = os.path.join(_SRC_DIR, _LIB_NAME)
+    if not force and not _needs_build(lib_path):
+        return lib_path
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    # Build to a temp name then rename: atomic w.r.t. concurrent importers.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
+           *srcs, "-lpthread"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            log.warning("native build failed (falling back to Python):\n%s",
+                        proc.stderr[-2000:])
+            os.unlink(tmp)
+            return None
+        os.replace(tmp, lib_path)
+        return lib_path
+    except (OSError, subprocess.SubprocessError) as exc:
+        log.warning("native build unavailable: %s", exc)
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return None
+
+
+def load_native_lib() -> "ctypes.CDLL | None":
+    """Build if needed, load, and declare signatures. None ⇒ use Python."""
+    if os.environ.get("REPORTER_TPU_NO_NATIVE"):
+        return None
+    lib_path = build_native_lib()
+    if lib_path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError as exc:
+        log.warning("failed to load %s: %s", lib_path, exc)
+        return None
+
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.reporter_build_reach.restype = ctypes.c_int64
+    lib.reporter_build_reach.argtypes = [
+        i32p, ctypes.c_int64, ctypes.c_int64,        # node_out, N, deg
+        i32p, f32p, ctypes.c_int64,                  # edge_dst, edge_len, E
+        ctypes.c_double, ctypes.c_int32,             # radius, max_targets
+        ctypes.c_int32,                              # n_threads
+        i32p, f32p, i32p,                            # outputs
+    ]
+    lib.reporter_build_grid.restype = ctypes.c_int64
+    lib.reporter_build_grid.argtypes = [
+        f32p, f32p, f32p, f32p, ctypes.c_int64,      # ax, ay, bx, by, S
+        ctypes.c_double, ctypes.c_double,            # lox, loy
+        ctypes.c_double, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        i32p, i32p,                                  # grid, counts
+    ]
+    return lib

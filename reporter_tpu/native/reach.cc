@@ -1,0 +1,199 @@
+// Native tile-compiler kernels: bounded Dijkstra reach tables + spatial grid.
+//
+// Plays the role of Valhalla's C++ offline pipeline (SURVEY.md §2.2 "Tile
+// build pipeline", §3.4): the per-node bounded Dijkstra that builds the
+// reach tables is the dominant cost of tile compilation for real metros, so
+// it runs here as multithreaded C++ instead of Python. Bit-for-bit parity
+// with the Python reference (reporter_tpu/tiles/reach.py) is part of the
+// contract and is what tests/test_native.py asserts:
+//   - distances accumulate in double, stored as float (same as numpy path)
+//   - the heap pops (dist, node) in tuple order, matching Python's heapq
+//   - targets sort by (dist, edge id), matching np.lexsort((tos, dists))
+//
+// Build: g++ -O3 -shared -fPIC -o _libreporter.so reach.cc -lpthread
+// (driven by reporter_tpu/native/build.py; no external deps).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Target {
+  double dist;
+  int32_t to;
+  int32_t next;
+};
+
+// Single-source bounded Dijkstra from node u; appends one Target per
+// out-edge of every reached node (u itself included at dist 0).
+void node_targets(int32_t u,
+                  const int32_t* node_out, int64_t num_nodes, int64_t deg,
+                  const int32_t* edge_dst, const float* edge_len,
+                  double radius,
+                  // scratch, epoch-stamped so no per-call clearing:
+                  std::vector<double>& dist, std::vector<int32_t>& first,
+                  std::vector<int32_t>& stamp, int32_t epoch,
+                  std::vector<Target>& out) {
+  using QItem = std::pair<double, int32_t>;  // (dist, node) — heapq order
+  std::priority_queue<QItem, std::vector<QItem>, std::greater<QItem>> pq;
+
+  auto get_dist = [&](int32_t v) {
+    return stamp[v] == epoch ? dist[v]
+                             : std::numeric_limits<double>::infinity();
+  };
+
+  dist[u] = 0.0;
+  first[u] = -1;
+  stamp[u] = epoch;
+  pq.push({0.0, u});
+  std::vector<int32_t> reached;
+  std::vector<char> done(0);
+  reached.push_back(u);
+
+  while (!pq.empty()) {
+    auto [d, v] = pq.top();
+    pq.pop();
+    if (d > get_dist(v)) continue;  // stale entry
+    const int32_t* row = node_out + int64_t(v) * deg;
+    for (int64_t i = 0; i < deg; ++i) {
+      int32_t e = row[i];
+      if (e < 0) break;
+      int32_t w = edge_dst[e];
+      double nd = d + double(edge_len[e]);
+      if (nd <= radius && nd < get_dist(w)) {
+        if (stamp[w] != epoch) {
+          stamp[w] = epoch;
+          reached.push_back(w);
+        }
+        dist[w] = nd;
+        first[w] = (v == u) ? e : first[v];
+        pq.push({nd, w});
+      }
+    }
+  }
+
+  for (int32_t v : reached) {
+    const int32_t* row = node_out + int64_t(v) * deg;
+    for (int64_t i = 0; i < deg; ++i) {
+      int32_t e2 = row[i];
+      if (e2 < 0) break;
+      out.push_back({dist[v], e2, (v == u) ? e2 : first[v]});
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Outputs: reach_to/reach_next i32 [E, max_targets] (-1 pad),
+// reach_dist f32 [E, max_targets] (+inf pad). Returns the number of nodes
+// whose target list was truncated (parity with the Python builder).
+int64_t reporter_build_reach(const int32_t* node_out, int64_t num_nodes,
+                             int64_t deg, const int32_t* edge_dst,
+                             const float* edge_len, int64_t num_edges,
+                             double radius, int32_t max_targets,
+                             int32_t n_threads, int32_t* reach_to,
+                             float* reach_dist, int32_t* reach_next) {
+  // Per-node rows, then broadcast to incoming edges (dst-node lookup).
+  std::vector<int32_t> row_to(size_t(num_nodes) * max_targets, -1);
+  std::vector<float> row_dist(size_t(num_nodes) * max_targets,
+                              std::numeric_limits<float>::infinity());
+  std::vector<int32_t> row_next(size_t(num_nodes) * max_targets, -1);
+
+  std::atomic<int64_t> truncated{0};
+  std::atomic<int64_t> next_node{0};
+  if (n_threads <= 0) {
+    n_threads = int32_t(std::thread::hardware_concurrency());
+    if (n_threads <= 0) n_threads = 4;
+  }
+
+  auto worker = [&]() {
+    std::vector<double> dist(num_nodes);
+    std::vector<int32_t> first(num_nodes);
+    std::vector<int32_t> stamp(num_nodes, -1);
+    std::vector<Target> targets;
+    int32_t epoch = 0;
+    for (;;) {
+      int64_t u = next_node.fetch_add(1);
+      if (u >= num_nodes) break;
+      targets.clear();
+      node_targets(int32_t(u), node_out, num_nodes, deg, edge_dst, edge_len,
+                   radius, dist, first, stamp, epoch++, targets);
+      std::sort(targets.begin(), targets.end(),
+                [](const Target& a, const Target& b) {
+                  if (a.dist != b.dist) return a.dist < b.dist;
+                  return a.to < b.to;
+                });
+      if (int64_t(targets.size()) > max_targets) {
+        truncated.fetch_add(1);
+        targets.resize(max_targets);
+      }
+      int32_t* rt = row_to.data() + u * max_targets;
+      float* rd = row_dist.data() + u * max_targets;
+      int32_t* rn = row_next.data() + u * max_targets;
+      for (size_t k = 0; k < targets.size(); ++k) {
+        rt[k] = targets[k].to;
+        rd[k] = float(targets[k].dist);
+        rn[k] = targets[k].next;
+      }
+    }
+  };
+
+  std::vector<std::thread> pool;
+  for (int32_t t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+
+  for (int64_t e = 0; e < num_edges; ++e) {
+    int64_t u = edge_dst[e];
+    std::copy_n(row_to.data() + u * max_targets, max_targets,
+                reach_to + e * max_targets);
+    std::copy_n(row_dist.data() + u * max_targets, max_targets,
+                reach_dist + e * max_targets);
+    std::copy_n(row_next.data() + u * max_targets, max_targets,
+                reach_next + e * max_targets);
+  }
+  return truncated.load();
+}
+
+// Spatial grid fill (parity with tiles/compiler._build_grid): register each
+// line segment in every cell its bbox overlaps. grid is i32 [gw*gh, cap]
+// pre-filled with -1. Returns the number of dropped registrations.
+int64_t reporter_build_grid(const float* ax, const float* ay, const float* bx,
+                            const float* by, int64_t num_segs, double lox,
+                            double loy, double cell, int32_t gw, int32_t gh,
+                            int32_t cap, int32_t* grid, int32_t* counts) {
+  int64_t overflow = 0;
+  for (int64_t s = 0; s < num_segs; ++s) {
+    double sx0 = std::min(ax[s], bx[s]), sx1 = std::max(ax[s], bx[s]);
+    double sy0 = std::min(ay[s], by[s]), sy1 = std::max(ay[s], by[s]);
+    int64_t cx0 = std::clamp(int64_t(std::floor((sx0 - lox) / cell)),
+                             int64_t(0), int64_t(gw - 1));
+    int64_t cx1 = std::clamp(int64_t(std::floor((sx1 - lox) / cell)),
+                             int64_t(0), int64_t(gw - 1));
+    int64_t cy0 = std::clamp(int64_t(std::floor((sy0 - loy) / cell)),
+                             int64_t(0), int64_t(gh - 1));
+    int64_t cy1 = std::clamp(int64_t(std::floor((sy1 - loy) / cell)),
+                             int64_t(0), int64_t(gh - 1));
+    for (int64_t cx = cx0; cx <= cx1; ++cx) {
+      for (int64_t cy = cy0; cy <= cy1; ++cy) {
+        int64_t c = cx * gh + cy;
+        if (counts[c] < cap) {
+          grid[c * cap + counts[c]] = int32_t(s);
+          counts[c] += 1;
+        } else {
+          ++overflow;
+        }
+      }
+    }
+  }
+  return overflow;
+}
+
+}  // extern "C"

@@ -138,7 +138,8 @@ def _decompose_segments(shapes: list[np.ndarray]):
             np.asarray(seg_off, np.float32), seg_len, edge_len)
 
 
-def _build_grid(seg_a: np.ndarray, seg_b: np.ndarray, cell_size: float, capacity: int):
+def _build_grid(seg_a: np.ndarray, seg_b: np.ndarray, cell_size: float,
+                capacity: int, use_native: bool = False):
     """Padded uniform grid over line segments.
 
     A segment is registered in every cell its bbox overlaps; with
@@ -148,6 +149,17 @@ def _build_grid(seg_a: np.ndarray, seg_b: np.ndarray, cell_size: float, capacity
     hi = np.maximum(seg_a, seg_b).max(axis=0) + 1.0
     gw = max(1, int(np.ceil((hi[0] - lo[0]) / cell_size)))
     gh = max(1, int(np.ceil((hi[1] - lo[1]) / cell_size)))
+    if use_native:
+        try:
+            from reporter_tpu.tiles.native import build_grid_native
+
+            out = build_grid_native(seg_a, seg_b, lo, cell_size, gw, gh,
+                                    capacity)
+            if out is not None:
+                grid, overflow = out
+                return grid, (gw, gh), lo.astype(np.float64), overflow
+        except ImportError:
+            pass
     grid = np.full((gw * gh, capacity), -1, dtype=np.int32)
     counts = np.zeros(gw * gh, dtype=np.int32)
     overflow = 0
@@ -202,7 +214,8 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None) -> T
         net, edge_len, fwd_of_leg, rev_of_leg, params.osmlr_max_length)
 
     grid, grid_dims, grid_origin, overflow = _build_grid(
-        seg_a, seg_b, params.cell_size, params.cell_capacity)
+        seg_a, seg_b, params.cell_size, params.cell_capacity,
+        use_native=params.use_native)
 
     node_out = _build_node_out(net.num_nodes, edge_src)
 

@@ -1,0 +1,73 @@
+"""numpy-facing wrappers over the native (C++) tile-compiler kernels.
+
+The tile compiler calls these when ``CompilerParams.use_native`` is set;
+each returns None when the native library is unavailable so the caller can
+fall back to the pure-Python builders (tiles/reach.py, compiler._build_grid).
+Output parity with those builders is exact and tested (tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+
+def _as_c(arr: np.ndarray, dtype) -> np.ndarray:
+    return np.ascontiguousarray(arr, dtype=dtype)
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def build_reach_native(node_out: np.ndarray, edge_src: np.ndarray,
+                       edge_dst: np.ndarray, edge_len: np.ndarray,
+                       radius: float, max_targets: int,
+                       ) -> "tuple[np.ndarray, np.ndarray, np.ndarray, int] | None":
+    """Native twin of tiles.reach.build_reach_tables (same signature/output)."""
+    from reporter_tpu.native import lib
+
+    if lib is None:
+        return None
+    num_nodes, deg = node_out.shape
+    num_edges = len(edge_dst)
+    node_out = _as_c(node_out, np.int32)
+    edge_dst = _as_c(edge_dst, np.int32)
+    edge_len = _as_c(edge_len, np.float32)
+    reach_to = np.full((num_edges, max_targets), -1, dtype=np.int32)
+    reach_dist = np.full((num_edges, max_targets), np.inf, dtype=np.float32)
+    reach_next = np.full((num_edges, max_targets), -1, dtype=np.int32)
+    n_threads = int(os.environ.get("REPORTER_TPU_NATIVE_THREADS", "0"))
+    truncated = lib.reporter_build_reach(
+        _ptr(node_out, ctypes.c_int32), num_nodes, deg,
+        _ptr(edge_dst, ctypes.c_int32), _ptr(edge_len, ctypes.c_float),
+        num_edges, float(radius), int(max_targets), n_threads,
+        _ptr(reach_to, ctypes.c_int32), _ptr(reach_dist, ctypes.c_float),
+        _ptr(reach_next, ctypes.c_int32))
+    return reach_to, reach_dist, reach_next, int(truncated)
+
+
+def build_grid_native(seg_a: np.ndarray, seg_b: np.ndarray,
+                      lo: np.ndarray, cell_size: float,
+                      gw: int, gh: int, capacity: int,
+                      ) -> "tuple[np.ndarray, int] | None":
+    """Native twin of the grid-fill loop in tiles.compiler._build_grid."""
+    from reporter_tpu.native import lib
+
+    if lib is None:
+        return None
+    ax = _as_c(seg_a[:, 0], np.float32)
+    ay = _as_c(seg_a[:, 1], np.float32)
+    bx = _as_c(seg_b[:, 0], np.float32)
+    by = _as_c(seg_b[:, 1], np.float32)
+    grid = np.full((gw * gh, capacity), -1, dtype=np.int32)
+    counts = np.zeros(gw * gh, dtype=np.int32)
+    overflow = lib.reporter_build_grid(
+        _ptr(ax, ctypes.c_float), _ptr(ay, ctypes.c_float),
+        _ptr(bx, ctypes.c_float), _ptr(by, ctypes.c_float), len(ax),
+        float(lo[0]), float(lo[1]), float(cell_size),
+        int(gw), int(gh), int(capacity),
+        _ptr(grid, ctypes.c_int32), _ptr(counts, ctypes.c_int32))
+    return grid, int(overflow)

@@ -1,0 +1,77 @@
+"""Native C++ builder parity vs the pure-Python reference builders.
+
+The analog of the reference's native/C++ test coverage living in Valhalla
+(SURVEY.md §4): here the contract is exact output equality, so the Python
+builders remain the executable spec.
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import CompilerParams
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.tiles.compiler import _build_grid, compile_network
+from reporter_tpu.tiles.native import build_grid_native, build_reach_native
+from reporter_tpu.tiles.reach import build_reach_tables
+
+pytestmark = pytest.mark.skipif(
+    __import__("reporter_tpu.native", fromlist=["lib"]).lib is None,
+    reason="native library unavailable (no g++?)")
+
+
+@pytest.fixture(scope="module")
+def city_tiles():
+    # Python builders for ground truth
+    return compile_network(
+        generate_city("tiny", seed=11),
+        CompilerParams(reach_radius=500.0, use_native=False))
+
+
+class TestReachParity:
+    @pytest.mark.parametrize("radius,max_targets", [
+        (300.0, 16), (500.0, 32), (800.0, 8)])
+    def test_exact_equality(self, city_tiles, radius, max_targets):
+        ts = city_tiles
+        want = build_reach_tables(ts.node_out, ts.edge_src, ts.edge_dst,
+                                  ts.edge_len, radius, max_targets)
+        got = build_reach_native(ts.node_out, ts.edge_src, ts.edge_dst,
+                                 ts.edge_len, radius, max_targets)
+        assert got is not None
+        np.testing.assert_array_equal(got[0], want[0])     # reach_to
+        np.testing.assert_array_equal(got[1], want[1])     # reach_dist (f32)
+        np.testing.assert_array_equal(got[2], want[2])     # reach_next
+        assert got[3] == want[3]                           # truncated count
+
+    def test_single_thread_deterministic(self, city_tiles, monkeypatch):
+        ts = city_tiles
+        a = build_reach_native(ts.node_out, ts.edge_src, ts.edge_dst,
+                               ts.edge_len, 500.0, 32)
+        monkeypatch.setenv("REPORTER_TPU_NATIVE_THREADS", "1")
+        b = build_reach_native(ts.node_out, ts.edge_src, ts.edge_dst,
+                               ts.edge_len, 500.0, 32)
+        for x, y in zip(a[:3], b[:3]):
+            np.testing.assert_array_equal(x, y)
+
+
+class TestGridParity:
+    def test_exact_equality(self, city_tiles):
+        ts = city_tiles
+        for cell, cap in ((64.0, 32), (100.0, 8), (48.0, 4)):
+            want_grid, dims, lo, want_ovf = _build_grid(
+                ts.seg_a, ts.seg_b, cell, cap, use_native=False)
+            got = build_grid_native(ts.seg_a, ts.seg_b, lo, cell,
+                                    dims[0], dims[1], cap)
+            assert got is not None
+            np.testing.assert_array_equal(got[0], want_grid)
+            assert got[1] == want_ovf
+
+
+class TestCompilerIntegration:
+    def test_native_and_python_tilesets_agree(self):
+        net = generate_city("tiny", seed=12)
+        py = compile_network(net, CompilerParams(use_native=False))
+        cc = compile_network(net, CompilerParams(use_native=True))
+        np.testing.assert_array_equal(py.reach_to, cc.reach_to)
+        np.testing.assert_array_equal(py.reach_dist, cc.reach_dist)
+        np.testing.assert_array_equal(py.reach_next, cc.reach_next)
+        np.testing.assert_array_equal(py.grid, cc.grid)
