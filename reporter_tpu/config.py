@@ -87,12 +87,27 @@ class ServiceConfig:
 
 
 @dataclass(frozen=True)
+class StreamingConfig:
+    """Streaming-ingest wiring (the reference's Kafka layer analog,
+    SURVEY.md §3.3): partition counts and the matcher worker's flush policy
+    ("when enough points/time elapsed: Match(buffered trace)")."""
+
+    num_partitions: int = 4        # uuid-hash partitions (Kafka partition analog)
+    poll_max_records: int = 4096   # records consumed per partition per step
+    flush_min_points: int = 16     # buffered points per uuid that trigger a match
+    flush_max_age: float = 30.0    # seconds a buffer may age before forced flush
+    speed_bins: tuple[float, ...] = (0., 2.5, 5., 7.5, 10., 12.5, 15., 17.5,
+                                     20., 25., 30., 40.)  # m/s histogram edges
+
+
+@dataclass(frozen=True)
 class Config:
     """Top-level structured config (the valhalla.json analog)."""
 
     matcher: MatcherParams = field(default_factory=MatcherParams)
     compiler: CompilerParams = field(default_factory=CompilerParams)
     service: ServiceConfig = field(default_factory=ServiceConfig)
+    streaming: StreamingConfig = field(default_factory=StreamingConfig)
     matcher_backend: str = "jax"   # {"jax", "reference_cpu"} — the backend boundary
 
     def validate(self) -> "Config":
@@ -106,6 +121,16 @@ class Config:
                 "3x3 grid gather to cover the search radius")
         if self.matcher_backend not in ("jax", "reference_cpu"):
             raise ValueError(f"unknown matcher_backend {self.matcher_backend!r}")
+        s = self.streaming
+        if s.num_partitions < 1 or s.poll_max_records < 1 or s.flush_min_points < 1:
+            raise ValueError(
+                "streaming num_partitions / poll_max_records / "
+                "flush_min_points must all be >= 1")
+        if s.flush_max_age <= 0:
+            raise ValueError("streaming.flush_max_age must be > 0")
+        if (len(s.speed_bins) < 1
+                or list(s.speed_bins) != sorted(set(s.speed_bins))):
+            raise ValueError("streaming.speed_bins must be strictly ascending")
         return self
 
     def to_json(self) -> str:
@@ -114,10 +139,14 @@ class Config:
     @classmethod
     def from_json(cls, text: str) -> "Config":
         raw = json.loads(text)
+        streaming = dict(raw.get("streaming", {}))
+        if "speed_bins" in streaming:
+            streaming["speed_bins"] = tuple(streaming["speed_bins"])
         return cls(
             matcher=MatcherParams(**raw.get("matcher", {})),
             compiler=CompilerParams(**raw.get("compiler", {})),
             service=ServiceConfig(**raw.get("service", {})),
+            streaming=StreamingConfig(**streaming),
             matcher_backend=raw.get("matcher_backend", "jax"),
         )
 

@@ -87,6 +87,34 @@ class PartialTraceCache:
             self._entries.move_to_end(uuid)
             self._evict_locked()
 
+    def dump(self) -> dict[str, dict]:
+        """Snapshot {uuid: {points, age}} (checkpointing; SURVEY.md §5).
+
+        ``age`` is seconds since last touch, so a restore into a new process
+        (fresh clock) keeps the TTL privacy bound instead of resetting it.
+        """
+        now = self._clock()
+        with self._lock:
+            return {u: {"points": list(e.points), "age": now - e.wall}
+                    for u, e in self._entries.items()}
+
+    def load(self, state: dict[str, dict], extra_age: float = 0.0) -> None:
+        """Restore a dump(); entries past the TTL are discarded.
+
+        ``extra_age`` is time elapsed since the dump (e.g. outage duration
+        from a wall-clock stamp) — monotonic ages alone can't see it.
+        """
+        now = self._clock()
+        with self._lock:
+            self._entries.clear()
+            for u, rec in sorted(state.items(), key=lambda kv: -kv[1]["age"]):
+                age = float(rec["age"]) + extra_age
+                if age > self.ttl or not rec["points"]:
+                    continue
+                self._entries[u] = _Entry(points=list(rec["points"]),
+                                          wall=now - age)
+            self._evict_locked()
+
     def drop(self, uuid: str) -> None:
         with self._lock:
             self._entries.pop(uuid, None)

@@ -1,0 +1,19 @@
+"""Streaming ingest — the reference's Kafka path, TPU-hosted (SURVEY.md §3.3).
+
+Reference pipeline:  probe producer → topic "raw" → formatter worker →
+topic "formatted" (partitioned by uuid) → matcher workers (consumer group,
+per-uuid buffers) → datastore.
+
+Here the broker becomes an in-process partitioned log with replayable
+offsets (queue.IngestQueue); the matcher worker becomes StreamPipeline,
+which buffers per uuid, flushes ripe buffers through the batched device
+matcher, accumulates per-segment speed histograms in device memory, and
+checkpoints offsets + buffers + histograms for crash recovery
+(at-least-once, like the reference's consumer groups).
+"""
+
+from reporter_tpu.streaming.queue import IngestQueue
+from reporter_tpu.streaming.histogram import SpeedHistogram
+from reporter_tpu.streaming.pipeline import StreamPipeline
+
+__all__ = ["IngestQueue", "SpeedHistogram", "StreamPipeline"]

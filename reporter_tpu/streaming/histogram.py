@@ -1,0 +1,64 @@
+"""Device-resident per-segment speed histograms (BASELINE config 5).
+
+The datastore's product is per-segment speed statistics; in streaming mode
+we keep the live histogram ON DEVICE — an i32 [G, B] array updated by a
+jit'd scatter-add per flushed batch — so the accumulator scales with the
+matcher instead of becoming host-side pointer chasing. Snapshots come back
+to host only for checkpointing / publishing. Under multi-chip data
+parallelism the same array is what the multimetro step psums over "dp"
+(parallel/multimetro.py); this class is the single-chip/streaming face.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _accumulate(hist, rows, bins, ok):
+    upd = jnp.where(ok, 1, 0).astype(jnp.int32)
+    return hist.at[jnp.maximum(rows, 0), jnp.maximum(bins, 0)].add(upd)
+
+
+class SpeedHistogram:
+    """i32 [num_rows, num_bins] observation counts; bin = speed (m/s) bucket."""
+
+    def __init__(self, num_rows: int, bin_edges: tuple[float, ...]):
+        self.bin_edges = np.asarray(bin_edges, np.float64)
+        self.num_bins = len(bin_edges)          # last bin is open-ended
+        self.num_rows = int(num_rows)
+        self._hist = jnp.zeros((self.num_rows, self.num_bins), jnp.int32)
+
+    def update(self, rows: np.ndarray, speeds: np.ndarray) -> None:
+        """Add one observation per (segment row, speed m/s) pair."""
+        if len(rows) == 0:
+            return
+        rows = np.asarray(rows, np.int32)
+        bins = (np.searchsorted(self.bin_edges, np.asarray(speeds),
+                                side="right") - 1).astype(np.int32)
+        ok = (rows >= 0) & (rows < self.num_rows) & (bins >= 0)
+        # Pad to the next power of two so the jit'd scatter compiles for a
+        # handful of lengths, not one executable per batch size.
+        cap = 1 << max(0, len(rows) - 1).bit_length()
+        pad = cap - len(rows)
+        if pad:
+            rows = np.pad(rows, (0, pad))
+            bins = np.pad(bins, (0, pad))
+            ok = np.pad(ok, (0, pad))
+        self._hist = _accumulate(self._hist, jnp.asarray(rows),
+                                 jnp.asarray(bins), jnp.asarray(ok))
+
+    def snapshot(self) -> np.ndarray:
+        """Host copy [num_rows, num_bins]."""
+        return np.asarray(self._hist)
+
+    def load(self, hist: np.ndarray) -> None:
+        assert hist.shape == (self.num_rows, self.num_bins)
+        self._hist = jnp.asarray(hist.astype(np.int32))
+
+    def nonzero_rows(self) -> np.ndarray:
+        return np.nonzero(self.snapshot().sum(axis=1))[0]

@@ -1,0 +1,202 @@
+"""StreamPipeline — the matcher worker of the streaming path.
+
+Mirrors the reference's Kafka matcher worker (SURVEY.md §3.3): consume
+partitions, buffer points per uuid, and "when enough points/time elapsed"
+flush the buffered trace through the same match→filter→publish pipeline the
+HTTP service uses (ReporterApp — one code path for both ingest modes, like
+the reference's shared segment_matcher call).
+
+Recovery model (SURVEY.md §5 "Failure detection"): offsets are committed
+only up to the oldest record still sitting in a buffer, so a crash +
+restore replays exactly the unflushed tail — at-least-once, duplicates
+possible, loss impossible (the reference accepts the same semantics from
+Kafka consumer groups; we improve on its lost-cache behavior by
+checkpointing buffers and histograms too).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+import numpy as np
+
+from reporter_tpu.config import Config
+from reporter_tpu.service.app import ReporterApp
+from reporter_tpu.service.datastore import Transport
+from reporter_tpu.streaming.histogram import SpeedHistogram
+from reporter_tpu.streaming.queue import IngestQueue
+from reporter_tpu.tiles.tileset import TileSet
+
+
+class _Buffer:
+    __slots__ = ("points", "first_offset", "born")
+
+    def __init__(self, born: float):
+        self.points: list[dict] = []
+        self.first_offset: "tuple[int, int] | None" = None  # (partition, offset)
+        self.born = born
+
+
+class StreamPipeline:
+    """Single-worker streaming matcher over an IngestQueue."""
+
+    def __init__(self, tileset: TileSet, config: Config | None = None,
+                 queue: IngestQueue | None = None,
+                 transport: Transport | None = None,
+                 clock=time.monotonic):
+        self.config = (config or Config()).validate()
+        sc = self.config.streaming
+        self.queue = queue or IngestQueue(sc.num_partitions)
+        if self.queue.num_partitions != sc.num_partitions:
+            raise ValueError("queue/config partition count mismatch")
+        self.app = ReporterApp(tileset, self.config, transport=transport)
+        self.clock = clock
+        self.committed = [0] * sc.num_partitions
+        self._consumed = [0] * sc.num_partitions   # read position (ahead of committed)
+        self._buffers: dict[str, _Buffer] = {}
+        self.hist = SpeedHistogram(len(tileset.osmlr_id), sc.speed_bins)
+        self._row_of = {int(sid): i for i, sid in enumerate(tileset.osmlr_id)}
+        self.steps = 0
+        self.malformed = 0
+
+    # ---- one poll/flush cycle -------------------------------------------
+
+    def step(self, force_flush: bool = False) -> int:
+        """Consume available records, flush ripe buffers, commit offsets.
+
+        Returns the number of reports produced this step.
+        """
+        sc = self.config.streaming
+        for p in range(sc.num_partitions):
+            for off, rec in self.queue.poll(p, self._consumed[p],
+                                            sc.poll_max_records):
+                self._consume(p, off, rec)
+                self._consumed[p] = off + 1
+
+        now = self.clock()
+        ripe = [u for u, b in self._buffers.items()
+                if force_flush
+                or len(b.points) >= sc.flush_min_points
+                or (b.points and now - b.born >= sc.flush_max_age)]
+        n_reports = self._flush(ripe) if ripe else 0
+        self._commit()
+        self.steps += 1
+        return n_reports
+
+    def drain(self) -> int:
+        """Flush everything (shutdown path)."""
+        return self.step(force_flush=True)
+
+    def _consume(self, p: int, off: int, rec: dict) -> None:
+        uuid = str(rec.get("uuid", ""))
+        try:
+            # Full conversion before any state change: a poison record must
+            # be droppable, never allowed to wedge its partition.
+            lat = float(rec["lat"])
+            lon = float(rec["lon"])
+            t = float(rec["time"]) if "time" in rec else None
+        except (KeyError, TypeError, ValueError):
+            self.malformed += 1
+            return                                   # malformed: skip
+        if not uuid:
+            self.malformed += 1
+            return
+        buf = self._buffers.get(uuid)
+        if buf is None:
+            buf = self._buffers[uuid] = _Buffer(self.clock())
+        if buf.first_offset is None:
+            buf.first_offset = (p, off)
+        if t is None:
+            # Timeless producer: index seconds per trace, matching the HTTP
+            # path's convention (app._validate_payload), not the partition
+            # offset (which interleaves across uuids).
+            t = float(len(buf.points))
+        buf.points.append({"lat": lat, "lon": lon, "time": t})
+
+    def _flush(self, uuids: list[str]) -> int:
+        payloads = [{"uuid": u, "trace": self._buffers[u].points}
+                    for u in uuids]
+        # Match BEFORE dropping buffers: if the matcher or publisher raises,
+        # the points stay buffered and keep holding the commit floor down —
+        # a supervisor retrying step() re-flushes instead of losing them.
+        results = self.app.report_many(payloads)
+        for u in uuids:
+            self._buffers.pop(u, None)
+        n = 0
+        rows: list[int] = []
+        speeds: list[float] = []
+        for res in results:
+            reports = res["reports"]
+            n += len(reports)
+            for r in reports:
+                dur = r["t1"] - r["t0"]
+                if dur <= 0:
+                    continue
+                rows.append(self._row_of.get(int(r["id"]), -1))
+                speeds.append(r["length"] / dur)
+        self.hist.update(np.asarray(rows, np.int32),
+                         np.asarray(speeds, np.float64))
+        return n
+
+    def _commit(self) -> None:
+        """Advance committed offsets to the oldest still-buffered record."""
+        floor = list(self._consumed)
+        for buf in self._buffers.values():
+            if buf.first_offset is not None:
+                p, off = buf.first_offset
+                floor[p] = min(floor[p], off)
+        self.committed = floor
+
+    # ---- observability ---------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "steps": self.steps,
+            "malformed": self.malformed,
+            "lag": self.queue.lag(self.committed),
+            "buffered_uuids": len(self._buffers),
+            "buffered_points": sum(len(b.points)
+                                   for b in self._buffers.values()),
+            "published": self.app.publisher.published,
+            "hist_rows": int(len(self.hist.nonzero_rows())),
+            **self.app.stats,
+        }
+
+    # ---- checkpoint / resume (SURVEY.md §5) ------------------------------
+
+    def checkpoint(self, path: str) -> None:
+        """Snapshot offsets + uuid cache + histogram to one file.
+
+        Buffers are NOT stored: committed offsets sit at the oldest
+        unflushed record, so replaying from them reconstructs every buffer
+        exactly — the buffer is derived state, the log is the truth.
+        """
+        state = {
+            "committed": self.committed,
+            "cache": self.app.cache.dump(),
+            "saved_at": time.time(),   # wall clock: outage spans processes
+        }
+        if not path.endswith(".npz"):
+            path += ".npz"   # savez appends it; normalize so restore(path) matches
+        np.savez_compressed(
+            path,
+            state=np.frombuffer(json.dumps(state).encode(), dtype=np.uint8),
+            hist=self.hist.snapshot())
+
+    def restore(self, path: str) -> None:
+        """Reset to a checkpoint; consumption resumes at the committed
+        offsets, replaying the unflushed tail (at-least-once: records whose
+        uuid was flushed after the snapshot may produce duplicate reports,
+        never lost ones)."""
+        if not path.endswith(".npz"):
+            path += ".npz"
+        with np.load(path) as z:
+            state = json.loads(bytes(z["state"]).decode())
+            self.hist.load(z["hist"])
+        self.committed = list(state["committed"])
+        self._consumed = list(state["committed"])
+        self._buffers = {}
+        outage = max(0.0, time.time() - float(state.get("saved_at", time.time())))
+        self.app.cache.load(state["cache"], extra_age=outage)

@@ -1,0 +1,71 @@
+"""IngestQueue — partitioned, offset-addressed probe log.
+
+The Kafka-broker analog (SURVEY.md §2.3, §5 "host ingest queue with
+replayable offsets"): records are appended to uuid-hash partitions,
+consumers poll (partition, offset) ranges, and nothing is destroyed by
+consumption — replay from any retained offset is the recovery mechanism.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Any, Sequence
+
+
+def partition_of(uuid: str, num_partitions: int) -> int:
+    """Stable uuid→partition hash (crc32 — processes must agree, so no
+    Python string-hash randomization)."""
+    return zlib.crc32(uuid.encode()) % num_partitions
+
+
+class IngestQueue:
+    """Thread-safe partitioned append log with offset-based polling."""
+
+    def __init__(self, num_partitions: int = 4):
+        self.num_partitions = int(num_partitions)
+        self._parts: list[list[Any]] = [[] for _ in range(self.num_partitions)]
+        self._base: list[int] = [0] * self.num_partitions   # offset of _parts[p][0]
+        self._lock = threading.Lock()
+
+    def append(self, record: dict) -> tuple[int, int]:
+        """Producer API: route by record["uuid"], return (partition, offset)."""
+        p = partition_of(str(record.get("uuid", "")), self.num_partitions)
+        with self._lock:
+            self._parts[p].append(record)
+            return p, self._base[p] + len(self._parts[p]) - 1
+
+    def append_many(self, records: Sequence[dict]) -> None:
+        for r in records:
+            self.append(r)
+
+    def poll(self, partition: int, offset: int,
+             max_records: int) -> list[tuple[int, dict]]:
+        """Records at or after ``offset`` (as [(offset, record)…])."""
+        with self._lock:
+            base = self._base[partition]
+            if offset < base:
+                raise LookupError(
+                    f"offset {offset} below retention floor {base} "
+                    f"(partition {partition})")
+            lo = offset - base
+            chunk = self._parts[partition][lo:lo + max_records]
+            return [(offset + i, r) for i, r in enumerate(chunk)]
+
+    def end_offset(self, partition: int) -> int:
+        with self._lock:
+            return self._base[partition] + len(self._parts[partition])
+
+    def lag(self, committed: Sequence[int]) -> int:
+        """Total records past the given per-partition committed offsets."""
+        return sum(self.end_offset(p) - committed[p]
+                   for p in range(self.num_partitions))
+
+    def truncate(self, committed: Sequence[int]) -> None:
+        """Drop records below the committed offsets (retention)."""
+        with self._lock:
+            for p, off in enumerate(committed):
+                drop = max(0, off - self._base[p])
+                if drop:
+                    self._parts[p] = self._parts[p][drop:]
+                    self._base[p] += drop

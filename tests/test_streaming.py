@@ -1,0 +1,278 @@
+"""Streaming tests: fake ingest queue + deterministic clock (SURVEY.md §4)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import CompilerParams, Config, ServiceConfig, StreamingConfig
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_probe
+from reporter_tpu.service.app import make_app
+from reporter_tpu.streaming import IngestQueue, SpeedHistogram, StreamPipeline
+from reporter_tpu.streaming.queue import partition_of
+from reporter_tpu.tiles.compiler import compile_network
+
+
+@pytest.fixture(scope="module")
+def stream_tiles():
+    return compile_network(
+        generate_city("tiny"),
+        CompilerParams(reach_radius=500.0, osmlr_max_length=200.0))
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def _records(probes):
+    """Interleave probes' points into a single firehose (round-robin)."""
+    out = []
+    T = max(len(p.times) for p in probes)
+    for t in range(T):
+        for p in probes:
+            if t < len(p.times):
+                out.append({"uuid": p.uuid, "lat": float(p.lonlat[t, 1]),
+                            "lon": float(p.lonlat[t, 0]),
+                            "time": float(p.times[t])})
+    return out
+
+
+def _pipeline(tiles, **stream_kw):
+    published = []
+
+    def transport(url, body):
+        published.append(json.loads(body))
+        return 200
+
+    cfg = Config(
+        service=ServiceConfig(datastore_url="http://ds.test/"),
+        streaming=StreamingConfig(**stream_kw))
+    clock = FakeClock()
+    pipe = StreamPipeline(tiles, cfg, transport=transport, clock=clock)
+    return pipe, published, clock
+
+
+class TestQueue:
+    def test_offsets_and_poll(self):
+        q = IngestQueue(num_partitions=2)
+        recs = [{"uuid": f"v{i}", "x": i} for i in range(10)]
+        q.append_many(recs)
+        total = sum(q.end_offset(p) for p in range(2))
+        assert total == 10
+        p = partition_of("v0", 2)
+        got = q.poll(p, 0, 100)
+        assert [o for o, _ in got] == list(range(len(got)))
+        assert all(partition_of(r["uuid"], 2) == p for _, r in got)
+
+    def test_replay_is_nondestructive(self):
+        q = IngestQueue(num_partitions=1)
+        q.append_many([{"uuid": "v", "i": i} for i in range(5)])
+        a = q.poll(0, 0, 10)
+        b = q.poll(0, 0, 10)
+        assert a == b
+        assert [r["i"] for _, r in q.poll(0, 3, 10)] == [3, 4]
+
+    def test_truncate_enforces_retention(self):
+        q = IngestQueue(num_partitions=1)
+        q.append_many([{"uuid": "v", "i": i} for i in range(5)])
+        q.truncate([3])
+        with pytest.raises(LookupError):
+            q.poll(0, 2, 10)
+        assert [r["i"] for _, r in q.poll(0, 3, 10)] == [3, 4]
+
+    def test_lag(self):
+        q = IngestQueue(num_partitions=2)
+        q.append_many([{"uuid": f"v{i}"} for i in range(6)])
+        assert q.lag([0, 0]) == 6
+
+
+class TestSpeedHistogram:
+    def test_matches_numpy(self, rng):
+        edges = (0.0, 5.0, 10.0, 20.0)
+        h = SpeedHistogram(num_rows=16, bin_edges=edges)
+        rows = rng.integers(0, 16, size=100).astype(np.int32)
+        speeds = rng.uniform(0, 30, size=100)
+        h.update(rows, speeds)
+        h.update(rows[:7], speeds[:7])          # second batch accumulates
+
+        want = np.zeros((16, 4), np.int64)
+        for r, s in list(zip(rows, speeds)) + list(zip(rows[:7], speeds[:7])):
+            b = np.searchsorted(edges, s, side="right") - 1
+            want[r, b] += 1
+        np.testing.assert_array_equal(h.snapshot(), want)
+
+    def test_ignores_invalid_rows(self):
+        h = SpeedHistogram(num_rows=4, bin_edges=(0.0, 10.0))
+        h.update(np.array([-1, 99, 2], np.int32), np.array([5.0, 5.0, 5.0]))
+        assert h.snapshot().sum() == 1
+        assert h.snapshot()[2, 0] == 1
+
+
+class TestPipeline:
+    def test_firehose_end_to_end(self, stream_tiles):
+        probes = [synthesize_probe(stream_tiles, seed=40 + i, num_points=120,
+                                   gps_sigma=3.0) for i in range(4)]
+        pipe, published, clock = _pipeline(stream_tiles, flush_min_points=32)
+        pipe.queue.append_many(_records(probes))
+
+        while pipe.queue.lag(pipe.committed) > 0:
+            pipe.step()
+            clock.now += 1.0
+        pipe.drain()
+
+        got_ids = {r["id"] for batch in published for r in batch["reports"]}
+
+        # Oracle: whole traces through the HTTP app (same matcher/config).
+        app = make_app(stream_tiles, Config())
+        want_ids = set()
+        for p in probes:
+            res = app.report_one(p.to_report_json())
+            want_ids |= {r["id"] for r in res["reports"]}
+        assert want_ids <= got_ids
+
+        # Histogram saw observations with sane speeds (probes drive 7-16 m/s).
+        rows = pipe.hist.nonzero_rows()
+        assert len(rows) > 0
+        assert pipe.stats()["lag"] == 0
+
+    def test_age_based_flush(self, stream_tiles):
+        probe = synthesize_probe(stream_tiles, seed=50, num_points=10)
+        pipe, published, clock = _pipeline(
+            stream_tiles, flush_min_points=1000, flush_max_age=5.0)
+        pipe.queue.append_many(_records([probe]))
+        pipe.step()
+        assert pipe.stats()["buffered_points"] == 10   # below min_points
+        clock.now += 10.0
+        pipe.step()                                    # age forces the flush
+        assert pipe.stats()["buffered_points"] == 0
+
+    def test_committed_held_back_by_buffer(self, stream_tiles):
+        probe = synthesize_probe(stream_tiles, seed=51, num_points=10)
+        pipe, _, clock = _pipeline(stream_tiles, flush_min_points=1000,
+                                   num_partitions=1)
+        pipe.queue.append_many(_records([probe]))
+        pipe.step()
+        # All consumed, nothing flushed: commit floor stays at the buffer head.
+        assert pipe.committed == [0]
+        assert pipe.queue.lag(pipe.committed) == 10
+
+    def test_crash_recovery_loses_nothing(self, stream_tiles, tmp_path):
+        probes = [synthesize_probe(stream_tiles, seed=60 + i, num_points=120,
+                                   gps_sigma=3.0) for i in range(2)]
+        recs = _records(probes)
+        ckpt = str(tmp_path / "pipe.npz")
+
+        # Run A: consume ~half, checkpoint, consume a bit more, then "crash".
+        pipe_a, pub_a, clock_a = _pipeline(stream_tiles, flush_min_points=32)
+        pipe_a.queue.append_many(recs[:len(recs) // 2])
+        pipe_a.step()
+        pipe_a.checkpoint(ckpt)
+        n_at_ckpt = len(pub_a)   # reports already durable in the datastore
+        pipe_a.queue.append_many(recs[len(recs) // 2:])
+        pipe_a.step()            # post-snapshot progress may be re-done by B
+
+        # Run B: fresh process, same durable log, restore + replay.
+        pipe_b, pub_b, clock_b = _pipeline(stream_tiles, flush_min_points=32)
+        pipe_b.queue.append_many(recs)       # the log outlives the worker
+        pipe_b.restore(ckpt)
+        while pipe_b.queue.lag(pipe_b.committed) > 0:
+            pipe_b.step()
+            clock_b.now += 1.0
+        pipe_b.drain()
+
+        # No loss: run B must cover everything a never-crashed run reports.
+        pipe_c, pub_c, clock_c = _pipeline(stream_tiles, flush_min_points=32)
+        pipe_c.queue.append_many(recs)
+        while pipe_c.queue.lag(pipe_c.committed) > 0:
+            pipe_c.step()
+            clock_c.now += 1.0
+        pipe_c.drain()
+
+        ids_a = {r["id"] for b in pub_a[:n_at_ckpt] for r in b["reports"]}
+        ids_b = {r["id"] for b in pub_b for r in b["reports"]}
+        ids_c = {r["id"] for b in pub_c for r in b["reports"]}
+        # Durable-before-crash ∪ replayed-after-restore covers a crash-free run.
+        assert ids_c <= ids_a | ids_b
+
+    def test_poison_record_does_not_stall_partition(self, stream_tiles):
+        pipe, _, clock = _pipeline(stream_tiles, num_partitions=1,
+                                   flush_min_points=1000)
+        pipe.queue.append_many([
+            {"uuid": "v", "lat": None, "lon": 1.0},          # poison
+            {"uuid": "v", "lat": "nope", "lon": 1.0},        # poison
+            {"uuid": "v", "lat": 37.77, "lon": -122.45, "time": 1.0},
+        ])
+        pipe.step()
+        assert pipe.malformed == 2
+        assert pipe.stats()["buffered_points"] == 1
+        assert pipe.queue.lag(pipe._consumed) == 0           # moved past poison
+
+    def test_flush_failure_keeps_buffers_and_commit_floor(self, stream_tiles):
+        probe = synthesize_probe(stream_tiles, seed=80, num_points=20)
+        pipe, _, clock = _pipeline(stream_tiles, num_partitions=1,
+                                   flush_min_points=4)
+        pipe.queue.append_many(_records([probe]))
+
+        boom = RuntimeError("transient device error")
+        orig = pipe.app.report_many
+        pipe.app.report_many = lambda p: (_ for _ in ()).throw(boom)
+        with pytest.raises(RuntimeError):
+            pipe.step()
+        # Nothing lost: points still buffered, commit floor still at 0.
+        assert pipe.stats()["buffered_points"] == 20
+        pipe._commit()
+        assert pipe.committed == [0]
+
+        pipe.app.report_many = orig                          # recovery
+        pipe.step(force_flush=True)
+        assert pipe.stats()["buffered_points"] == 0
+        assert pipe.committed == [20]
+
+    def test_restore_honors_cache_ttl(self, stream_tiles, tmp_path,
+                                      monkeypatch):
+        """A checkpoint restored after a long outage must not resurrect old
+        probe points with a fresh TTL (the cache's privacy bound)."""
+        import time as _time
+
+        probe = synthesize_probe(stream_tiles, seed=81, num_points=40)
+        pipe, _, clock = _pipeline(stream_tiles, flush_min_points=8)
+        pipe.queue.append_many(_records([probe]))
+        while pipe.queue.lag(pipe.committed) > 0:
+            pipe.step()
+        assert len(pipe.app.cache) > 0
+        ckpt = str(tmp_path / "ttl")                         # suffixless on purpose
+        pipe.checkpoint(ckpt)
+
+        # Prompt restore keeps the tail…
+        fresh, _, _ = _pipeline(stream_tiles)
+        fresh.restore(ckpt)
+        assert len(fresh.app.cache) > 0
+
+        # …but restoring hours later discards it.
+        real = _time.time()
+        monkeypatch.setattr(_time, "time", lambda: real + 10_000.0)
+        late, _, _ = _pipeline(stream_tiles)
+        late.restore(ckpt)
+        assert len(late.app.cache) == 0
+
+    def test_checkpoint_restores_histogram(self, stream_tiles, tmp_path):
+        probe = synthesize_probe(stream_tiles, seed=70, num_points=120,
+                                 gps_sigma=3.0)
+        pipe, _, clock = _pipeline(stream_tiles, flush_min_points=16)
+        pipe.queue.append_many(_records([probe]))
+        while pipe.queue.lag(pipe.committed) > 0:
+            pipe.step()
+        pipe.drain()
+        snap = pipe.hist.snapshot()
+        assert snap.sum() > 0
+
+        ckpt = str(tmp_path / "h.npz")
+        pipe.checkpoint(ckpt)
+        pipe2, _, _ = _pipeline(stream_tiles)
+        pipe2.restore(ckpt)
+        np.testing.assert_array_equal(pipe2.hist.snapshot(), snap)
