@@ -35,7 +35,7 @@ class MatcherParams:
                                    # Meili absorbs this via input interpolation, we absorb it
                                    # in the transition model (ops/hmm.route_distance)
     max_device_batch: int = 4096   # traces per device dispatch; bounds HBM for
-                                   # candidate-search intermediates (B·T·9C floats)
+                                   # candidate-search intermediates (B·T·8C floats)
 
     def replace(self, **kw: Any) -> "MatcherParams":
         return dataclasses.replace(self, **kw)
@@ -45,8 +45,12 @@ class MatcherParams:
 class CompilerParams:
     """Offline tile-compiler parameters (the mjolnir/osmlr analog, SURVEY.md §7.1)."""
 
-    cell_size: float = 64.0        # spatial-grid cell edge (m); must be >= search_radius for 3x3 query
-    cell_capacity: int = 32        # max line-segments indexed per cell (padded, -1 sentinel)
+    cell_size: float = 64.0        # spatial-grid cell edge (m)
+    cell_capacity: int = 64        # max line-segments indexed per cell (padded, -1 sentinel)
+    index_radius: float = 50.0     # grid registration dilation (m): every segment is
+                                   # indexed in all cells within this distance of its
+                                   # bbox, so a query reads ONE cell row and still sees
+                                   # every segment within search_radius <= index_radius
     reach_radius: float = 600.0    # reachability precompute radius (m)
     reach_max: int = 32            # max reachable target edges kept per edge
     osmlr_max_length: float = 1000.0  # OSMLR segment chaining target length (m)
@@ -111,14 +115,14 @@ class Config:
     matcher_backend: str = "jax"   # {"jax", "reference_cpu"} — the backend boundary
 
     def validate(self) -> "Config":
-        """Cross-section invariants. The grid's 3×3-gather candidate search is
-        only a superset of the radius ball when cells are at least radius-sized
-        (tiles/compiler._build_grid)."""
-        if self.compiler.cell_size < self.matcher.search_radius:
+        """Cross-section invariants. The grid's single-cell candidate gather
+        is only a superset of the radius ball when segment registration was
+        dilated by at least the search radius (tiles/compiler._build_grid)."""
+        if self.compiler.index_radius < self.matcher.search_radius:
             raise ValueError(
-                f"compiler.cell_size ({self.compiler.cell_size}) must be >= "
-                f"matcher.search_radius ({self.matcher.search_radius}) for the "
-                "3x3 grid gather to cover the search radius")
+                f"compiler.index_radius ({self.compiler.index_radius}) must be "
+                f">= matcher.search_radius ({self.matcher.search_radius}) for "
+                "the single-cell grid gather to cover the search radius")
         if self.matcher_backend not in ("jax", "reference_cpu"):
             raise ValueError(f"unknown matcher_backend {self.matcher_backend!r}")
         s = self.streaming

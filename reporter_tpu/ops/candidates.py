@@ -2,12 +2,23 @@
 
 Replaces Meili's CandidateGridQuery (SURVEY.md §2.2 "Candidate search" —
 valhalla/meili/candidate_search, UNVERIFIED): instead of a per-point hash-grid
-walk with pointer chasing, every query gathers a fixed 3×3 neighborhood of
-grid cells (cell_size >= search_radius guarantees coverage, see
-config.Config.validate), computes point→segment distances for all 9·C
+walk with pointer chasing, every query gathers its OWN grid cell's row —
+registration was dilated by index_radius offline (tiles/compiler._build_grid),
+so that one row already contains every segment within
+search_radius <= index_radius — computes point→segment distances for all C
 registered line segments at once on the VPU, and selects the K nearest
 *distinct edges* with a fixed-K argmin scan. All shapes static, fully
 vmappable over points and traces.
+
+Memory layout matters more than FLOPs here: all per-segment data
+(endpoints, offset, length, owning edge) is pre-fused into ``cell_pack``
+rows (tiles/tileset.build_cell_pack), so each query issues ONE contiguous
+row-gather of [8C] floats. The naive formulation (id grid + six
+data-dependent scalar gathers over global segment arrays, 3×3 cell
+neighborhood) ran ~40× slower on TPU: gathers of lone f32 elements
+serialize, and 9 row-gathers per point beat the HBM access pattern to
+death. Offline dilation trades registrations for exactly one contiguous
+row read per point.
 """
 
 from __future__ import annotations
@@ -17,7 +28,9 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from reporter_tpu.tiles.tileset import TileMeta
+from reporter_tpu.tiles.tileset import (
+    PACK_AX, PACK_AY, PACK_BX, PACK_BY, PACK_EDGE, PACK_LEN, PACK_NCOMP,
+    PACK_OFF, TileMeta)
 
 BIG = jnp.float32(1e30)   # "infinity" that survives subtraction without NaNs
 
@@ -25,15 +38,16 @@ BIG = jnp.float32(1e30)   # "infinity" that survives subtraction without NaNs
 class GridMeta(NamedTuple):
     """Grid geometry as scalars — static Python floats for the single-metro
     path, or traced jnp scalars when each shard of a sharded mesh carries a
-    different metro's grid (parallel/multimetro.py). ``cell_size`` must stay
-    static either way: the 3×3-gather coverage check against search_radius
-    happens at trace time."""
+    different metro's grid (parallel/multimetro.py). ``cell_size`` and
+    ``index_radius`` must stay static either way: the coverage check against
+    search_radius happens at trace time."""
 
     ox: Any          # grid origin x (cell (0,0) lower-left)
     oy: Any          # grid origin y
     cell_size: float
     gw: Any          # grid width in cells
     gh: Any          # grid height in cells
+    index_radius: float  # registration dilation the grid was built with
 
 
 def as_grid_meta(meta: "TileMeta | GridMeta") -> GridMeta:
@@ -41,7 +55,8 @@ def as_grid_meta(meta: "TileMeta | GridMeta") -> GridMeta:
         return meta
     return GridMeta(ox=meta.grid_origin[0], oy=meta.grid_origin[1],
                     cell_size=meta.cell_size,
-                    gw=meta.grid_dims[0], gh=meta.grid_dims[1])
+                    gw=meta.grid_dims[0], gh=meta.grid_dims[1],
+                    index_radius=meta.index_radius)
 
 
 class CandidateSet(NamedTuple):
@@ -70,34 +85,33 @@ def _point_segment_dist(px, py, ax, ay, bx, by):
     return d, t, jnp.sqrt(denom)
 
 
-def gather_cell_segments(pt, grid, meta: "TileMeta | GridMeta"):
-    """Segment ids registered in the 3×3 cell neighborhood of ``pt``.
+def gather_cell_pack(pt, cell_pack, meta: "TileMeta | GridMeta"):
+    """Fused segment data for the grid cell containing ``pt``.
 
-    Returns i32 [9*C]; -1 entries are padding or out-of-bounds cells.
-    Out-of-range cell rows of a *padded* grid (multimetro stacking pads every
-    metro's grid to the same cell count) are never touched: indices are
-    clipped to the metro's own gw/gh and masked by in_bounds.
+    Returns (ax, ay, bx, by, off, slen, edge), each [C]; edge = -1 marks
+    padding slots. Registration dilation guarantees this one row covers the
+    whole search ball. Out-of-grid points clip to the nearest boundary cell,
+    whose dilated registrations cover the first index_radius beyond the
+    edge; anything farther is correctly rejected by the distance test.
+    Out-of-range rows of a *padded* cell_pack (multimetro stacking pads
+    every metro's grid to the same cell count) are never touched: indices
+    are clipped to the metro's own gw/gh.
     """
     gm = as_grid_meta(meta)
-    gw, gh = gm.gw, gm.gh
-    ox, oy = gm.ox, gm.oy
-    cx = jnp.floor((pt[0] - ox) / gm.cell_size).astype(jnp.int32)
-    cy = jnp.floor((pt[1] - oy) / gm.cell_size).astype(jnp.int32)
-    dx = jnp.array([-1, -1, -1, 0, 0, 0, 1, 1, 1], jnp.int32)
-    dy = jnp.array([-1, 0, 1, -1, 0, 1, -1, 0, 1], jnp.int32)
-    xs = cx + dx
-    ys = cy + dy
-    in_bounds = (xs >= 0) & (xs < gw) & (ys >= 0) & (ys < gh)
-    cells = jnp.clip(xs, 0, gw - 1) * gh + jnp.clip(ys, 0, gh - 1)
-    segs = grid[cells]                                   # [9, C]
-    segs = jnp.where(in_bounds[:, None], segs, -1)
-    return segs.reshape(-1)
+    cx = jnp.floor((pt[0] - gm.ox) / gm.cell_size).astype(jnp.int32)
+    cy = jnp.floor((pt[1] - gm.oy) / gm.cell_size).astype(jnp.int32)
+    cell = (jnp.clip(cx, 0, gm.gw - 1) * gm.gh
+            + jnp.clip(cy, 0, gm.gh - 1))
+    row = cell_pack[cell].reshape(PACK_NCOMP, -1)        # [NCOMP, C]
+    edge = jax.lax.bitcast_convert_type(row[PACK_EDGE], jnp.int32)
+    return (row[PACK_AX], row[PACK_AY], row[PACK_BX], row[PACK_BY],
+            row[PACK_OFF], row[PACK_LEN], edge)
 
 
 def _topk_distinct_edges(seg_edges, dists, ts, k: int):
     """K nearest distinct edges from per-segment distances.
 
-    seg_edges i32 [S9], dists f32 [S9] (BIG = invalid), ts f32 [S9] projection
+    seg_edges i32 [C], dists f32 [C] (BIG = invalid), ts f32 [C] projection
     parameter. K sequential argmin steps; after picking an edge every segment
     of that edge is masked, so each edge appears at most once (Meili keeps one
     candidate per edge — the closest projection).
@@ -123,20 +137,15 @@ def find_candidates(pt, tables, meta: "TileMeta | GridMeta",
     tables: dict from TileSet.device_tables().
     Returns (edge [K], offset [K], dist [K], valid [K]).
     """
-    segs = gather_cell_segments(pt, tables["grid"], meta)        # [9C]
-    safe = jnp.maximum(segs, 0)
-    ax = tables["seg_ax"][safe]
-    ay = tables["seg_ay"][safe]
-    bx = tables["seg_bx"][safe]
-    by = tables["seg_by"][safe]
-    d, t, seg_norm = _point_segment_dist(pt[0], pt[1], ax, ay, bx, by)
-    seg_valid = (segs >= 0) & (d <= search_radius)
+    ax, ay, bx, by, off0, slen, seg_edge = gather_cell_pack(
+        pt, tables["cell_pack"], meta)                           # each [C]
+    d, t, _ = _point_segment_dist(pt[0], pt[1], ax, ay, bx, by)
+    seg_valid = (seg_edge >= 0) & (d <= search_radius)
     d = jnp.where(seg_valid, d, BIG)
-    seg_edge = jnp.where(segs >= 0, tables["seg_edge"][safe], -1)
 
     edges, best_d, idx, t_at, ok = _topk_distinct_edges(
         seg_edge, d, t, max_candidates)
-    off = tables["seg_off"][safe[idx]] + t_at * seg_norm[idx]
+    off = off0[idx] + t_at * slen[idx]
     return CandidateSet(
         edge=edges.astype(jnp.int32),
         offset=jnp.where(ok, off, 0.0).astype(jnp.float32),

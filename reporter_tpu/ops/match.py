@@ -37,12 +37,14 @@ def match_trace(points, valid_pt, tables, meta,
     meta: TileMeta (static) or ops.candidates.GridMeta (scalars, possibly
     traced — the multimetro sharded path).
     """
-    if params.search_radius > meta.cell_size:
-        # Trace-time check (both are static): the 3×3 grid gather only covers
-        # one cell ring, so a radius beyond cell_size silently drops roads.
+    if params.search_radius > meta.index_radius:
+        # Trace-time check (both are static): the single-cell gather only
+        # covers the registration dilation, so a radius beyond index_radius
+        # silently drops roads.
         raise ValueError(
-            f"search_radius ({params.search_radius}) exceeds tile cell_size "
-            f"({meta.cell_size}); recompile tiles with cell_size >= radius")
+            f"search_radius ({params.search_radius}) exceeds tile "
+            f"index_radius ({meta.index_radius}); recompile tiles with "
+            "index_radius >= radius")
     cands = find_candidates_trace(
         points, tables, meta, params.search_radius, params.max_candidates)
     vit = viterbi_decode(

@@ -27,13 +27,15 @@ from reporter_tpu.ops.match import MatchOutput, match_trace
 from reporter_tpu.tiles.tileset import TileSet
 
 _PAD_VALUES: dict[str, Any] = {
-    "grid": -1,              # missing cell entries = no segment
+    # padded cell rows are never gathered (indices clip to the metro's own
+    # gw/gh), but fill them with the bitcast of edge=-1 anyway so a stray
+    # gather could only ever produce an invalid candidate
+    "cell_pack": np.int32(-1).view(np.float32),
     "reach_to": -1,          # no reachable target
     "reach_dist": np.float32(np.inf),
-    "seg_edge": -1,
     "edge_osmlr": -1,
-    # coordinates / lengths / offsets: zero is safe, padded ids above make
-    # sure padded rows are never selected as real candidates
+    # lengths / offsets: zero is safe, padded ids above make sure padded
+    # rows are never selected as real candidates
 }
 
 
@@ -43,6 +45,7 @@ class StackedTiles(NamedTuple):
     tables: dict[str, jnp.ndarray]   # each [M, ...]
     names: tuple[str, ...]
     cell_size: float
+    index_radius: float              # uniform grid registration dilation
     num_osmlr: tuple[int, ...]       # real OSMLR row count per metro
     osmlr_pad: int                   # padded G (histogram width)
 
@@ -56,12 +59,21 @@ def _pad_to(arr: np.ndarray, shape: tuple[int, ...], fill) -> np.ndarray:
 def stack_tilesets(tilesets: Sequence[TileSet]) -> StackedTiles:
     """Pad every metro's device tables to common shapes and stack them.
 
-    Requires a uniform compiler cell_size (it is a static kernel parameter);
-    grid origin/dims vary per metro and ride along as traced scalars.
+    Requires uniform compiler cell_size and index_radius (static kernel
+    parameters); grid origin/dims vary per metro and ride along as traced
+    scalars.
     """
     cell_sizes = {ts.meta.cell_size for ts in tilesets}
     if len(cell_sizes) != 1:
         raise ValueError(f"metros compiled with differing cell_size: {cell_sizes}")
+    radii = {ts.meta.index_radius for ts in tilesets}
+    if len(radii) != 1:
+        raise ValueError(f"metros compiled with differing index_radius: {radii}")
+    caps = {ts.grid.shape[1] for ts in tilesets}
+    if len(caps) != 1:
+        # cell_pack rows are component-major [8*C]; padding C at the row tail
+        # would scramble the layout, so capacity must be uniform up front
+        raise ValueError(f"metros compiled with differing cell_capacity: {caps}")
 
     host_tables = []
     for ts in tilesets:
@@ -87,6 +99,7 @@ def stack_tilesets(tilesets: Sequence[TileSet]) -> StackedTiles:
         tables=stacked,
         names=tuple(ts.name for ts in tilesets),
         cell_size=float(cell_sizes.pop()),
+        index_radius=float(radii.pop()),
         num_osmlr=num_osmlr,
         osmlr_pad=max(num_osmlr),
     )
@@ -102,16 +115,17 @@ def make_multimetro_matcher(mesh: Mesh, stacked: StackedTiles,
     whole "dp" axis on device (psum over ICI) — the seed of the streaming
     speed-histogram path (BASELINE config 5).
     """
-    if params.search_radius > stacked.cell_size:
+    if params.search_radius > stacked.index_radius:
         raise ValueError(
-            f"search_radius ({params.search_radius}) exceeds cell_size "
-            f"({stacked.cell_size})")
+            f"search_radius ({params.search_radius}) exceeds index_radius "
+            f"({stacked.index_radius})")
     n_tile = mesh.shape["tile"]
     if len(stacked.names) % n_tile:
         raise ValueError(
             f"{len(stacked.names)} metros not divisible by tile axis {n_tile}")
 
     cell_size = stacked.cell_size
+    index_radius = stacked.index_radius
     gmax = stacked.osmlr_pad
     tables = jax.device_put(
         stacked.tables,
@@ -120,7 +134,7 @@ def make_multimetro_matcher(mesh: Mesh, stacked: StackedTiles,
     def per_metro(pts, val, tbl):
         gm = GridMeta(ox=tbl["grid_ox"], oy=tbl["grid_oy"],
                       cell_size=cell_size, gw=tbl["grid_gw"],
-                      gh=tbl["grid_gh"])
+                      gh=tbl["grid_gh"], index_radius=index_radius)
         out = jax.vmap(lambda p, v: match_trace(p, v, tbl, gm, params))(
             pts, val)
         rows = jnp.where(out.matched,

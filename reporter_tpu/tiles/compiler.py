@@ -139,21 +139,30 @@ def _decompose_segments(shapes: list[np.ndarray]):
 
 
 def _build_grid(seg_a: np.ndarray, seg_b: np.ndarray, cell_size: float,
-                capacity: int, use_native: bool = False):
-    """Padded uniform grid over line segments.
+                capacity: int, index_radius: float, use_native: bool = False):
+    """Padded uniform grid over line segments, dilated by ``index_radius``.
 
-    A segment is registered in every cell its bbox overlaps; with
-    cell_size >= search_radius, a 3×3 gather around the query point's cell is
-    a superset of all segments within the radius (SURVEY.md §7.2a)."""
-    lo = np.minimum(seg_a, seg_b).min(axis=0) - 1.0
-    hi = np.maximum(seg_a, seg_b).max(axis=0) + 1.0
+    A segment is registered in every cell within ``index_radius`` of its
+    bbox. That trades offline registrations (and HBM rows) for the matcher's
+    memory-access pattern: a query point reads exactly ONE cell row — its
+    own — and is guaranteed to see every segment within
+    search_radius <= index_radius. (The earlier design registered only
+    overlapped cells and gathered a 3×3 neighborhood per point; the 9-row
+    gather was the single most expensive memory access in the whole match
+    pipeline on TPU.)"""
+    smin = np.minimum(seg_a, seg_b) - index_radius
+    smax = np.maximum(seg_a, seg_b) + index_radius
+    lo = smin.min(axis=0) - 1.0
+    hi = smax.max(axis=0) + 1.0
     gw = max(1, int(np.ceil((hi[0] - lo[0]) / cell_size)))
     gh = max(1, int(np.ceil((hi[1] - lo[1]) / cell_size)))
     if use_native:
         try:
             from reporter_tpu.tiles.native import build_grid_native
 
-            out = build_grid_native(seg_a, seg_b, lo, cell_size, gw, gh,
+            # The native kernel boxes min/max of the two endpoint arrays it is
+            # given, so passing the dilated corners registers dilated bboxes.
+            out = build_grid_native(smin, smax, lo, cell_size, gw, gh,
                                     capacity)
             if out is not None:
                 grid, overflow = out
@@ -164,8 +173,6 @@ def _build_grid(seg_a: np.ndarray, seg_b: np.ndarray, cell_size: float,
     counts = np.zeros(gw * gh, dtype=np.int32)
     overflow = 0
 
-    smin = np.minimum(seg_a, seg_b)
-    smax = np.maximum(seg_a, seg_b)
     c0 = np.floor((smin - lo) / cell_size).astype(np.int64)
     c1 = np.floor((smax - lo) / cell_size).astype(np.int64)
     c0 = np.clip(c0, 0, [gw - 1, gh - 1])
@@ -215,7 +222,7 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None) -> T
 
     grid, grid_dims, grid_origin, overflow = _build_grid(
         seg_a, seg_b, params.cell_size, params.cell_capacity,
-        use_native=params.use_native)
+        params.index_radius, use_native=params.use_native)
 
     node_out = _build_node_out(net.num_nodes, edge_src)
 
@@ -235,6 +242,7 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None) -> T
         cell_size=float(params.cell_size),
         grid_dims=grid_dims,
         origin_lonlat=(float(origin[0]), float(origin[1])),
+        index_radius=float(params.index_radius),
     )
     ts = TileSet(
         name=net.name, meta=meta,

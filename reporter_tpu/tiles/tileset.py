@@ -27,6 +27,13 @@ segments, C grid cell capacity, M reach-table width):
   reach_to       i32 [E,M]   nearby reachable target edges, -1 padded
   reach_dist     f32 [E,M]   network distance end-of-e → start-of-target (m)
   reach_next     i32 [E,M]   first edge of that path (next-hop, for host walk)
+
+Device-side the grid + per-segment arrays are fused into ``cell_pack``
+(build_cell_pack below): one f32 [ncells, 8*C] row per cell holding every
+registered segment's geometry inline, so candidate search is a single
+contiguous row-gather instead of six dependent scalar gathers (the latter are
+catastrophic on TPU — gathers of single f32 elements run near one element per
+cycle, and dominated the whole match pipeline before this layout).
 """
 
 from __future__ import annotations
@@ -35,6 +42,39 @@ from dataclasses import dataclass, field
 from typing import Any, NamedTuple
 
 import numpy as np
+
+# cell_pack component slots (axis 1 of the [ncells, NCOMP, C] layout)
+PACK_AX, PACK_AY, PACK_BX, PACK_BY = 0, 1, 2, 3
+PACK_OFF, PACK_LEN, PACK_EDGE, PACK_SPARE = 4, 5, 6, 7
+PACK_NCOMP = 8
+
+
+def build_cell_pack(grid: np.ndarray, seg_a: np.ndarray, seg_b: np.ndarray,
+                    seg_edge: np.ndarray, seg_off: np.ndarray,
+                    seg_len: np.ndarray) -> np.ndarray:
+    """Fuse grid + segment SoA arrays into one gatherable f32 row per cell.
+
+    Layout [ncells, NCOMP * C], component-major (all C ax values, then all C
+    ay values, …) so the device kernel reshapes to [NCOMP, C] and slices.
+    Edge ids ride along bitcast int32→float32 (exact round-trip via
+    lax.bitcast_convert_type); empty slots carry edge = -1.
+    """
+    ncells, cap = grid.shape
+    safe = np.maximum(grid, 0)
+    empty = grid < 0
+    pack = np.zeros((ncells, PACK_NCOMP, cap), np.float32)
+    pack[:, PACK_AX] = seg_a[:, 0][safe]
+    pack[:, PACK_AY] = seg_a[:, 1][safe]
+    pack[:, PACK_BX] = seg_b[:, 0][safe]
+    pack[:, PACK_BY] = seg_b[:, 1][safe]
+    pack[:, PACK_OFF] = seg_off[safe]
+    pack[:, PACK_LEN] = seg_len[safe]
+    edge = np.where(empty, np.int32(-1), seg_edge[safe]).astype(np.int32)
+    pack[:, PACK_EDGE] = edge.view(np.float32)
+    for comp in (PACK_AX, PACK_AY, PACK_BX, PACK_BY, PACK_OFF, PACK_LEN):
+        pack[:, comp][empty] = 0.0
+    return pack.reshape(ncells, PACK_NCOMP * cap)
+
 
 _ARRAY_FIELDS = (
     "node_xy", "node_out",
@@ -54,6 +94,9 @@ class TileMeta(NamedTuple):
     cell_size: float
     grid_dims: tuple[int, int]         # (gw, gh); grid array is [gw*gh, C]
     origin_lonlat: tuple[float, float]
+    index_radius: float                # grid registration dilation (m); the
+                                       # single-cell gather covers any
+                                       # search_radius <= this
 
 
 @dataclass
@@ -114,8 +157,13 @@ class TileSet:
         with np.load(path) as z:
             raw = json.loads(bytes(z["_meta"]).decode())
             arrays = {f: z[f] for f in _ARRAY_FIELDS}
-        go, cs, gd, ol = raw["meta"]
-        meta = TileMeta(tuple(go), float(cs), tuple(gd), tuple(ol))
+        if len(raw["meta"]) != len(TileMeta._fields):
+            raise ValueError(
+                f"{path}: tileset metadata has {len(raw['meta'])} fields, "
+                f"expected {len(TileMeta._fields)} — written by an older tile "
+                "compiler; recompile the network with compile_network()")
+        go, cs, gd, ol, ir = raw["meta"]
+        meta = TileMeta(tuple(go), float(cs), tuple(gd), tuple(ol), float(ir))
         return cls(name=raw["name"], meta=meta, stats=raw.get("stats", {}), **arrays)
 
     # ---- device staging --------------------------------------------------
@@ -125,18 +173,14 @@ class TileSet:
         plain dict pytree of jnp arrays (HBM-resident after first use)."""
         import jax.numpy as jnp
 
-        # Segment endpoints go to device as structure-of-arrays: a gathered
-        # [n, 2] array would be tiled T(8,128) on TPU, padding the size-2 lane
-        # dimension to 128 (64× memory blowup at batch scale); four flat [S]
-        # vectors gather into [n] with no padding.
+        # Candidate search reads only cell_pack: per-cell rows with segment
+        # geometry inlined, so the kernel's memory traffic is one contiguous
+        # [8C] row-gather per point (see build_cell_pack). The per-segment
+        # SoA arrays and the id-only grid stay host-side.
         return {
-            "seg_ax": jnp.asarray(self.seg_a[:, 0]),
-            "seg_ay": jnp.asarray(self.seg_a[:, 1]),
-            "seg_bx": jnp.asarray(self.seg_b[:, 0]),
-            "seg_by": jnp.asarray(self.seg_b[:, 1]),
-            "seg_edge": jnp.asarray(self.seg_edge),
-            "seg_off": jnp.asarray(self.seg_off),
-            "grid": jnp.asarray(self.grid),
+            "cell_pack": jnp.asarray(build_cell_pack(
+                self.grid, self.seg_a, self.seg_b, self.seg_edge,
+                self.seg_off, self.seg_len)),
             "edge_len": jnp.asarray(self.edge_len),
             "edge_osmlr": jnp.asarray(self.edge_osmlr),
             "reach_to": jnp.asarray(self.reach_to),

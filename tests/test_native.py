@@ -56,11 +56,14 @@ class TestReachParity:
 class TestGridParity:
     def test_exact_equality(self, city_tiles):
         ts = city_tiles
-        for cell, cap in ((64.0, 32), (100.0, 8), (48.0, 4)):
+        for cell, cap, radius in ((64.0, 64, 50.0), (100.0, 32, 25.0),
+                                  (48.0, 8, 0.0)):
             want_grid, dims, lo, want_ovf = _build_grid(
-                ts.seg_a, ts.seg_b, cell, cap, use_native=False)
-            got = build_grid_native(ts.seg_a, ts.seg_b, lo, cell,
-                                    dims[0], dims[1], cap)
+                ts.seg_a, ts.seg_b, cell, cap, radius, use_native=False)
+            got = build_grid_native(
+                np.minimum(ts.seg_a, ts.seg_b) - radius,
+                np.maximum(ts.seg_a, ts.seg_b) + radius,
+                lo, cell, dims[0], dims[1], cap)
             assert got is not None
             np.testing.assert_array_equal(got[0], want_grid)
             assert got[1] == want_ovf
