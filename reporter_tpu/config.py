@@ -27,6 +27,9 @@ class MatcherParams:
     beta: float = 3.0              # transition scale (m)
     search_radius: float = 50.0    # candidate search radius (m)
     max_candidates: int = 8        # top-K candidates per point
+    candidate_backend: str = "dense"  # "dense" = gather-free pallas sweep
+                                   # (ops/dense_candidates.py); "grid" =
+                                   # cell-row gather (ops/candidates.py)
     breakage_distance: float = 2000.0  # consecutive points farther apart break the HMM chain
     max_route_distance_factor: float = 5.0  # route dist > factor*gc ⇒ transition disallowed
     interpolation_distance: float = 10.0    # points closer than this are interpolated, not matched
@@ -117,8 +120,14 @@ class Config:
     def validate(self) -> "Config":
         """Cross-section invariants. The grid's single-cell candidate gather
         is only a superset of the radius ball when segment registration was
-        dilated by at least the search radius (tiles/compiler._build_grid)."""
-        if self.compiler.index_radius < self.matcher.search_radius:
+        dilated by at least the search radius (tiles/compiler._build_grid);
+        the dense sweep visits every in-radius segment regardless."""
+        if self.matcher.candidate_backend not in ("dense", "grid"):
+            raise ValueError(
+                f"unknown candidate_backend "
+                f"{self.matcher.candidate_backend!r}; use 'dense' or 'grid'")
+        if (self.matcher.candidate_backend == "grid"
+                and self.compiler.index_radius < self.matcher.search_radius):
             raise ValueError(
                 f"compiler.index_radius ({self.compiler.index_radius}) must be "
                 f">= matcher.search_radius ({self.matcher.search_radius}) for "

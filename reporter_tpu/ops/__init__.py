@@ -7,12 +7,15 @@ Viterbi → `hmm`, fused per-trace pipeline → `match`.
 
 from reporter_tpu.ops.candidates import CandidateSet, find_candidates
 from reporter_tpu.ops.hmm import viterbi_decode
-from reporter_tpu.ops.match import match_batch, match_trace
+from reporter_tpu.ops.dense_candidates import find_candidates_dense
+from reporter_tpu.ops.match import match_batch, match_trace, match_traces
 
 __all__ = [
     "CandidateSet",
     "find_candidates",
+    "find_candidates_dense",
     "viterbi_decode",
     "match_batch",
     "match_trace",
+    "match_traces",
 ]

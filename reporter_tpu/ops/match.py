@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 
 from reporter_tpu.config import MatcherParams
-from reporter_tpu.ops.candidates import find_candidates_trace
+from reporter_tpu.ops.candidates import CandidateSet, find_candidates_trace
+from reporter_tpu.ops.dense_candidates import find_candidates_dense
 from reporter_tpu.ops.hmm import viterbi_decode
 from reporter_tpu.tiles.tileset import TileMeta
 
@@ -30,6 +31,53 @@ class MatchOutput(NamedTuple):
     matched: jnp.ndarray      # bool [.., T]
 
 
+def _check_grid_coverage(params: MatcherParams, meta) -> None:
+    if params.search_radius > meta.index_radius:
+        # Trace-time check (both are static): the single-cell gather only
+        # covers the registration dilation, so a radius beyond index_radius
+        # silently drops roads. (Dense backend sweeps everything — exempt.)
+        raise ValueError(
+            f"search_radius ({params.search_radius}) exceeds tile "
+            f"index_radius ({meta.index_radius}); recompile tiles with "
+            "index_radius >= radius")
+
+
+def batch_candidates(points, valid_pt, tables, meta,
+                     params: MatcherParams) -> CandidateSet:
+    """Candidates for a batch of traces: points f32 [B, T, 2] → [B, T, K].
+
+    Backend dispatch (params.candidate_backend is static):
+      dense — ONE pallas sweep over the flattened [B*T] point batch (the
+              kernel amortizes its segment-block DMA across every trace);
+      grid  — per-point cell-row gather, vmapped per trace.
+    """
+    B, T = points.shape[:2]
+    if params.candidate_backend == "dense":
+        flat = find_candidates_dense(
+            points.reshape(B * T, 2),
+            (tables["seg_pack"], tables["seg_bbox"]),
+            params.search_radius, params.max_candidates,
+            valid=valid_pt.reshape(B * T))
+        return CandidateSet(*(x.reshape(B, T, -1) for x in flat))
+    if params.candidate_backend != "grid":
+        raise ValueError(
+            f"unknown candidate_backend {params.candidate_backend!r}; "
+            "use 'dense' or 'grid'")
+    _check_grid_coverage(params, meta)
+    return jax.vmap(lambda p: find_candidates_trace(
+        p, tables, meta, params.search_radius, params.max_candidates))(points)
+
+
+def _viterbi(cands: CandidateSet, points, valid_pt, tables,
+             params: MatcherParams) -> MatchOutput:
+    vit = viterbi_decode(
+        cands, points, valid_pt, tables,
+        params.sigma_z, params.beta, params.max_route_distance_factor,
+        params.breakage_distance, params.backward_slack)
+    return MatchOutput(edge=vit.edge, offset=vit.offset,
+                       chain_start=vit.chain_start, matched=vit.matched)
+
+
 def match_trace(points, valid_pt, tables, meta,
                 params: MatcherParams) -> MatchOutput:
     """Match ONE padded trace: points f32 [T, 2], valid_pt bool [T].
@@ -37,22 +85,18 @@ def match_trace(points, valid_pt, tables, meta,
     meta: TileMeta (static) or ops.candidates.GridMeta (scalars, possibly
     traced — the multimetro sharded path).
     """
-    if params.search_radius > meta.index_radius:
-        # Trace-time check (both are static): the single-cell gather only
-        # covers the registration dilation, so a radius beyond index_radius
-        # silently drops roads.
-        raise ValueError(
-            f"search_radius ({params.search_radius}) exceeds tile "
-            f"index_radius ({meta.index_radius}); recompile tiles with "
-            "index_radius >= radius")
-    cands = find_candidates_trace(
-        points, tables, meta, params.search_radius, params.max_candidates)
-    vit = viterbi_decode(
-        cands, points, valid_pt, tables,
-        params.sigma_z, params.beta, params.max_route_distance_factor,
-        params.breakage_distance, params.backward_slack)
-    return MatchOutput(edge=vit.edge, offset=vit.offset,
-                       chain_start=vit.chain_start, matched=vit.matched)
+    out = match_traces(points[None], valid_pt[None], tables, meta, params)
+    return MatchOutput(*(x[0] for x in out))
+
+
+def match_traces(points, valid_pt, tables, meta,
+                 params: MatcherParams) -> MatchOutput:
+    """Match a batch (not jitted — compose under jit/vmap/shard_map):
+    points f32 [B, T, 2], valid_pt bool [B, T]."""
+    cands = batch_candidates(points, valid_pt, tables, meta, params)
+    return jax.vmap(
+        lambda c, p, v: _viterbi(c, p, v, tables, params))(
+            cands, points, valid_pt)
 
 
 @functools.partial(jax.jit, static_argnames=("meta", "params"))
@@ -64,5 +108,4 @@ def match_batch(points, valid_pt, tables: dict[str, Any], meta: TileMeta,
     geometry, param set), then every batch reuses the executable
     (SURVEY.md §7.5 "jit persistence").
     """
-    return jax.vmap(lambda p, v: match_trace(p, v, tables, meta, params))(
-        points, valid_pt)
+    return match_traces(points, valid_pt, tables, meta, params)

@@ -8,30 +8,41 @@ DP is the first-choice scaling axis for this workload (SURVEY.md §2.3).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from reporter_tpu.config import MatcherParams
-from reporter_tpu.ops.match import MatchOutput, match_trace
+from reporter_tpu.ops.match import MatchOutput, match_traces
 from reporter_tpu.tiles.tileset import TileSet
 
 
 def make_dp_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams):
     """Returns fn(points [B,T,2], valid [B,T]) → MatchOutput, batch sharded
     over every mesh axis. B must be divisible by the mesh's device count
-    (pad with valid=False rows on host)."""
+    (pad with valid=False rows on host).
+
+    shard_map (not bare jit sharding): the dense candidate backend is a
+    pallas custom call, which GSPMD has no partitioning rule for — under
+    plain jit in_shardings it would be replicated (all-gather + redundant
+    full-batch compute per device). shard_map runs the whole matcher
+    per-shard on the local batch slice, which is the intended semantics:
+    zero cross-device communication in the forward match.
+    """
     axes = tuple(mesh.axis_names)              # ("tile", "dp") or ("dp",)
     tables = jax.device_put(ts.device_tables(),
                             NamedSharding(mesh, P()))      # replicated
-    batch_sh = NamedSharding(mesh, P(axes))    # shard B over all axes
     meta = ts.meta
 
-    @functools.partial(jax.jit, in_shardings=(batch_sh, batch_sh),
-                       out_shardings=batch_sh)
+    local = jax.shard_map(
+        lambda p, v, tbl: match_traces(p, v, tbl, meta, params),
+        mesh=mesh,
+        in_specs=(P(axes), P(axes), jax.tree.map(lambda _: P(), tables)),
+        out_specs=P(axes),
+        check_vma=False,   # same constant-carry caveat as multimetro
+    )
+
+    @jax.jit
     def step(points, valid) -> MatchOutput:
-        return jax.vmap(lambda p, v: match_trace(p, v, tables, meta, params))(
-            points, valid)
+        return local(points, valid, tables)
 
     return step

@@ -23,7 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from reporter_tpu.config import MatcherParams
 from reporter_tpu.ops.candidates import GridMeta
-from reporter_tpu.ops.match import MatchOutput, match_trace
+from reporter_tpu.ops.match import MatchOutput, match_traces
 from reporter_tpu.tiles.tileset import TileSet
 
 _PAD_VALUES: dict[str, Any] = {
@@ -31,6 +31,12 @@ _PAD_VALUES: dict[str, Any] = {
     # gw/gh), but fill them with the bitcast of edge=-1 anyway so a stray
     # gather could only ever produce an invalid candidate
     "cell_pack": np.int32(-1).view(np.float32),
+    # the dense sweep DOES visit padding columns: edge = bitcast(-1) marks
+    # them invalid (other components become NaN, which the kernel's
+    # where(valid) masking discards before any reduction)
+    "seg_pack": np.int32(-1).view(np.float32),
+    # NaN bboxes never overlap anything → padded blocks are never selected
+    "seg_bbox": np.float32(np.nan),
     "reach_to": -1,          # no reachable target
     "reach_dist": np.float32(np.inf),
     "edge_osmlr": -1,
@@ -135,8 +141,7 @@ def make_multimetro_matcher(mesh: Mesh, stacked: StackedTiles,
         gm = GridMeta(ox=tbl["grid_ox"], oy=tbl["grid_oy"],
                       cell_size=cell_size, gw=tbl["grid_gw"],
                       gh=tbl["grid_gh"], index_radius=index_radius)
-        out = jax.vmap(lambda p, v: match_trace(p, v, tbl, gm, params))(
-            pts, val)
+        out = match_traces(pts, val, tbl, gm, params)
         rows = jnp.where(out.matched,
                          tbl["edge_osmlr"][jnp.maximum(out.edge, 0)], -1)
         ok = (rows >= 0).reshape(-1)

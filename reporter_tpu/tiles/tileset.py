@@ -173,14 +173,22 @@ class TileSet:
         plain dict pytree of jnp arrays (HBM-resident after first use)."""
         import jax.numpy as jnp
 
-        # Candidate search reads only cell_pack: per-cell rows with segment
-        # geometry inlined, so the kernel's memory traffic is one contiguous
-        # [8C] row-gather per point (see build_cell_pack). The per-segment
-        # SoA arrays and the id-only grid stay host-side.
+        from reporter_tpu.ops.dense_candidates import build_seg_pack
+
+        # Two candidate-search layouts ride to HBM: cell_pack (grid backend —
+        # one contiguous [8C] row-gather per point, see build_cell_pack) and
+        # seg_pack + seg_bbox (dense backend — Morton-blocked [8, S]
+        # component rows swept by the pallas kernel with bbox culling, no
+        # gathers at all; ops/dense_candidates.py). The id-only grid and
+        # per-segment SoA arrays stay host-side.
+        sp = build_seg_pack(self.seg_a, self.seg_b, self.seg_edge,
+                            self.seg_off, self.seg_len)
         return {
             "cell_pack": jnp.asarray(build_cell_pack(
                 self.grid, self.seg_a, self.seg_b, self.seg_edge,
                 self.seg_off, self.seg_len)),
+            "seg_pack": jnp.asarray(sp.pack),
+            "seg_bbox": jnp.asarray(sp.bbox),
             "edge_len": jnp.asarray(self.edge_len),
             "edge_osmlr": jnp.asarray(self.edge_osmlr),
             "reach_to": jnp.asarray(self.reach_to),

@@ -1,0 +1,140 @@
+"""Dense (sweep) candidate search vs the grid-gather path and vs numpy.
+
+The dense backend must agree with the grid backend wherever the grid's
+dilation guarantees coverage (search_radius <= index_radius): same distinct
+top-K edges, same distances, same offsets.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from reporter_tpu.config import CompilerParams, MatcherParams
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.ops.candidates import find_candidates_trace
+from reporter_tpu.ops.dense_candidates import (build_seg_pack,
+                                               find_candidates_dense)
+from reporter_tpu.ops.match import match_batch
+from reporter_tpu.tiles.compiler import compile_network
+
+
+@pytest.fixture(scope="module")
+def ts():
+    return compile_network(generate_city("tiny", seed=11), CompilerParams())
+
+
+@pytest.fixture(scope="module")
+def tables(ts):
+    return ts.device_tables()
+
+
+def _fleet_points(ts, b, t, seed=5):
+    fleet = synthesize_fleet(ts, b, num_points=t, seed=seed)
+    return np.stack([p.xy for p in fleet]).astype(np.float32)
+
+
+def test_seg_pack_roundtrip(ts):
+    sp = build_seg_pack(ts.seg_a, ts.seg_b, ts.seg_edge, ts.seg_off,
+                        ts.seg_len)
+    s = len(ts.seg_edge)
+    assert sp.pack.shape[1] % 256 == 0
+    edges = sp.pack[6].view(np.int32)
+    # Morton sort permutes columns; same multiset of edges, -1 padding tail
+    np.testing.assert_array_equal(np.sort(edges[:s]), np.sort(ts.seg_edge))
+    assert (edges[s:] == -1).all()
+    # every real column lies inside its block's bbox
+    nblocks = sp.pack.shape[1] // 256
+    for blk in range(nblocks):
+        cols = slice(blk * 256, (blk + 1) * 256)
+        e = edges[cols]
+        if (e < 0).all():
+            assert np.isnan(sp.bbox[blk]).all()
+            continue
+        real = e >= 0
+        xs = np.concatenate([sp.pack[0, cols][real], sp.pack[2, cols][real]])
+        ys = np.concatenate([sp.pack[1, cols][real], sp.pack[3, cols][real]])
+        assert xs.min() >= sp.bbox[blk, 0] - 1e-3
+        assert ys.min() >= sp.bbox[blk, 1] - 1e-3
+        assert xs.max() <= sp.bbox[blk, 2] + 1e-3
+        assert ys.max() <= sp.bbox[blk, 3] + 1e-3
+
+
+def test_dense_matches_grid(ts, tables):
+    pts = _fleet_points(ts, 4, 40).reshape(-1, 2)
+    radius, k = 50.0, 8
+
+    dense = find_candidates_dense(
+        jnp.asarray(pts), (tables["seg_pack"], tables["seg_bbox"]), radius, k)
+    grid = find_candidates_trace(jnp.asarray(pts), tables, ts.meta, radius, k)
+
+    d_edge = np.asarray(dense.edge)
+    g_edge = np.asarray(grid.edge)
+    d_dist = np.asarray(dense.dist)
+    g_dist = np.asarray(grid.dist)
+    for i in range(len(pts)):
+        dv, gv = d_edge[i] >= 0, g_edge[i] >= 0
+        assert dv.sum() == gv.sum(), f"point {i}: candidate count differs"
+        dd = np.sort(d_dist[i][dv])
+        gd = np.sort(g_dist[i][gv])
+        # same distance multiset always
+        np.testing.assert_allclose(dd, gd, rtol=1e-5, atol=1e-3,
+                                   err_msg=f"point {i}")
+        # edge sets must agree except at ties with the K-th (cut) distance:
+        # the Morton reorder legally swaps which of several equidistant
+        # edges makes the truncated list
+        if dv.sum():
+            cut = dd[-1] - 1e-3
+            strict_d = set(d_edge[i][dv & (d_dist[i] < cut)].tolist())
+            strict_g = set(g_edge[i][gv & (g_dist[i] < cut)].tolist())
+            assert strict_d == strict_g, f"point {i}"
+
+
+def test_dense_against_numpy_bruteforce(ts, tables):
+    rng = np.random.default_rng(3)
+    lo = ts.node_xy.min(0) - 30.0
+    hi = ts.node_xy.max(0) + 30.0
+    pts = rng.uniform(lo, hi, size=(64, 2)).astype(np.float32)
+    radius, k = 50.0, 8
+
+    dense = find_candidates_dense(
+        jnp.asarray(pts), (tables["seg_pack"], tables["seg_bbox"]), radius, k)
+    a, b = ts.seg_a, ts.seg_b
+    ab = b - a
+    denom = np.maximum((ab * ab).sum(1), 1e-12)
+    for i, p in enumerate(pts):
+        t = np.clip(((p - a) * ab).sum(1) / denom, 0, 1)
+        proj = a + t[:, None] * ab
+        d = np.linalg.norm(p - proj, axis=1)
+        best: dict[int, float] = {}
+        for e, dd in zip(ts.seg_edge, d):
+            if dd <= radius and (e not in best or dd < best[e]):
+                best[int(e)] = float(dd)
+        want = sorted(best.items(), key=lambda kv: kv[1])[:k]
+        got_e = [int(e) for e in np.asarray(dense.edge[i]) if e >= 0]
+        got_d = [float(x) for x, e in
+                 zip(np.asarray(dense.dist[i]), np.asarray(dense.edge[i]))
+                 if e >= 0]
+        assert len(got_e) == len(want), f"point {i}"
+        np.testing.assert_allclose(
+            got_d, [w[1] for w in want], rtol=1e-4, atol=1e-2)
+        # edge identity can swap only between equal distances
+        for (we, wd), ge, gd in zip(want, got_e, got_d):
+            assert we == ge or abs(wd - gd) < 1e-2
+
+
+def test_match_batch_dense_vs_grid(ts, tables):
+    pts = _fleet_points(ts, 6, 48)
+    valid = np.ones(pts.shape[:2], bool)
+    p_dense = MatcherParams(candidate_backend="dense")
+    p_grid = MatcherParams(candidate_backend="grid")
+    out_d = match_batch(jnp.asarray(pts), jnp.asarray(valid), tables,
+                        ts.meta, p_dense)
+    out_g = match_batch(jnp.asarray(pts), jnp.asarray(valid), tables,
+                        ts.meta, p_grid)
+    # candidate-order ties (e.g. the two directed edges of a two-way street
+    # at identical distance) legally resolve differently between backends
+    agree = (np.asarray(out_d.edge) == np.asarray(out_g.edge)).mean()
+    assert agree > 0.95, f"dense vs grid match agreement {agree:.3f}"
+    np.testing.assert_array_equal(np.asarray(out_d.matched),
+                                  np.asarray(out_g.matched))
