@@ -39,7 +39,7 @@ def main() -> None:
     from reporter_tpu.netgen.traces import synthesize_fleet
     from reporter_tpu.tiles.compiler import compile_network
 
-    n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 16000
     n_points = 120
     n_cpu = min(20, n_traces)
 
@@ -49,7 +49,8 @@ def main() -> None:
               for p in fleet]
 
     jax_matcher = SegmentMatcher(ts, Config(matcher_backend="jax"))
-    jax_matcher.match_many(traces[:8])              # compile + stage HBM
+    jax_matcher.match_many(traces)                  # compile + stage HBM
+                                                    # (full batch shape)
     dt_jax = _time_best(lambda: jax_matcher.match_many(traces), repeats=3)
 
     # Device-decode-only throughput (the kernel itself, no host walk).

@@ -37,8 +37,11 @@ class MatcherParams:
                                    # GPS noise shifts projections backwards between samples —
                                    # Meili absorbs this via input interpolation, we absorb it
                                    # in the transition model (ops/hmm.route_distance)
-    max_device_batch: int = 4096   # traces per device dispatch; bounds HBM for
-                                   # candidate-search intermediates (B·T·8C floats)
+    max_device_batch: int = 16384  # traces per device dispatch. Large on
+                                   # purpose: per-dispatch link round-trips
+                                   # dominate small batches on a
+                                   # remote-attached chip; HBM transients
+                                   # stay modest (B·T·K·M f32 per scan step)
 
     def replace(self, **kw: Any) -> "MatcherParams":
         return dataclasses.replace(self, **kw)

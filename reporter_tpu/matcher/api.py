@@ -84,9 +84,15 @@ class SegmentMatcher:
         self.config = (config or Config()).validate()
         self.params: MatcherParams = self.config.matcher
         backend = self.config.matcher_backend
+        self._native_walker = None
         if backend == "jax":
             self._tables = tileset.device_tables()
             self._route_fn = reach_route_fn(tileset)
+            # Native batch walker (walker.cc): same walk as build_segments
+            # with the reach-table route_fn, multithreaded across traces.
+            # None ⇒ per-trace Python fallback.
+            from reporter_tpu.matcher.native_walk import make_native_walker
+            self._native_walker = make_native_walker(tileset)
         elif backend == "reference_cpu":
             self._tables = None
             # Segment-build routing must reach every transition the Viterbi
@@ -153,7 +159,7 @@ class SegmentMatcher:
                 for lo in range(0, len(t.xy), max_b):
                     work.append((i, lo, t.xy[lo:lo + max_b]))
 
-        pieces: dict[tuple[int, int], Any] = {}
+        per_trace: list[list[tuple[int, Any]]] = [[] for _ in traces]
         by_bucket: dict[int, list[int]] = {}
         for w, (_, _, xy) in enumerate(work):
             by_bucket.setdefault(_bucket_len(len(xy)), []).append(w)
@@ -161,6 +167,11 @@ class SegmentMatcher:
         sliced = [(b, ws[i:i + chunk])
                   for b, ws in sorted(by_bucket.items())
                   for i in range(0, len(ws), chunk)]
+        # Two phases: submit every slice (dispatches are async), then
+        # harvest. Device compute and device→host transfers of slice k
+        # overlap with the transfer of slice k-1 — on a remote-attached
+        # chip the link round-trip otherwise serializes with compute.
+        inflight = []
         for b, ws in sliced:
             B = len(ws)
             pts = np.zeros((B, b, 2), np.float32)
@@ -171,22 +182,44 @@ class SegmentMatcher:
                 valid[r, :len(xy)] = True
             res = match_batch(jnp.asarray(pts), jnp.asarray(valid),
                               self._tables, self.ts.meta, self.params)
+            inflight.append((ws, res))
+        for ws, res in inflight:
             edges = np.asarray(res.edge)
             offs = np.asarray(res.offset)
             starts = np.asarray(res.chain_start)
             for r, w in enumerate(ws):
                 i, lo, xy = work[w]
                 T = len(xy)
-                pieces[(i, lo)] = (edges[r, :T], offs[r, :T], starts[r, :T])
+                per_trace[i].append(
+                    (lo, (edges[r, :T], offs[r, :T], starts[r, :T])))
 
         out: list[Any] = []
-        for i, t in enumerate(traces):
-            chunks = [pieces[k] for k in sorted(pieces) if k[0] == i]
-            out.append(tuple(np.concatenate(parts) for parts in zip(*chunks)))
+        for chunks in per_trace:
+            chunks.sort(key=lambda c: c[0])
+            if len(chunks) == 1:
+                out.append(chunks[0][1])
+            else:
+                out.append(tuple(np.concatenate(parts)
+                                 for parts in zip(*(c[1] for c in chunks))))
         return out
 
     def _match_jax_many(self, traces: Sequence[Trace]) -> list[list[SegmentRecord]]:
         decoded = self._decode_many(traces)
+        if self._native_walker is not None:
+            B = len(traces)
+            tmax = max((len(e) for e, _, _ in decoded), default=1) or 1
+            edges = np.full((B, tmax), -1, np.int32)
+            offs = np.zeros((B, tmax), np.float32)
+            starts = np.zeros((B, tmax), np.uint8)
+            times = np.zeros((B, tmax), np.float64)
+            for b, (trace, (e, o, s)) in enumerate(zip(traces, decoded)):
+                t = len(e)
+                edges[b, :t] = e
+                offs[b, :t] = o
+                starts[b, :t] = s
+                times[b, :t] = trace.times[:t]
+            return self._native_walker.walk(edges, offs, starts, times,
+                                            self.params.backward_slack)
         results = []
         for trace, (edges, offs, starts) in zip(traces, decoded):
             pts = [(int(e), float(o), bool(s))

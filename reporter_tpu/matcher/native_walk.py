@@ -1,0 +1,113 @@
+"""ctypes wrapper for the native segment walker (native/walker.cc).
+
+Batch-level replacement for the per-trace Python path in
+matcher/segments.py: one call walks every decoded trace (multithreaded in
+C++) and returns the records as flat numpy columns, which are sliced into
+per-trace SegmentRecord lists. Exact parity with the Python walk is
+asserted by tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from reporter_tpu.matcher.segments import SegmentRecord
+from reporter_tpu.tiles.tileset import TileSet
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeWalker:
+    """Holds the library handle + C-contiguous tile arrays."""
+
+    def __init__(self, lib, ts: TileSet):
+        self._lib = lib
+        self._edge_len = np.ascontiguousarray(ts.edge_len, np.float32)
+        self._edge_way = np.ascontiguousarray(ts.edge_way, np.int64)
+        self._edge_osmlr = np.ascontiguousarray(ts.edge_osmlr, np.int32)
+        self._edge_osmlr_off = np.ascontiguousarray(ts.edge_osmlr_off,
+                                                    np.float32)
+        self._osmlr_id = np.ascontiguousarray(ts.osmlr_id, np.int64)
+        self._osmlr_len = np.ascontiguousarray(ts.osmlr_len, np.float32)
+        self._reach_to = np.ascontiguousarray(ts.reach_to, np.int32)
+        self._reach_dist = np.ascontiguousarray(ts.reach_dist, np.float32)
+        self._reach_next = np.ascontiguousarray(ts.reach_next, np.int32)
+        self._m = int(ts.reach_to.shape[1])
+        self._threads = min(32, os.cpu_count() or 1)
+
+    def walk(self, edges: np.ndarray, offs: np.ndarray, starts: np.ndarray,
+             times: np.ndarray, backward_slack: float,
+             ) -> list[list[SegmentRecord]]:
+        """edges i32 [B,T] (-1 unmatched), offs f32 [B,T], starts bool [B,T],
+        times f64 [B,T] → per-trace record lists."""
+        B, T = edges.shape
+        edges = np.ascontiguousarray(edges, np.int32)
+        offs = np.ascontiguousarray(offs, np.float32)
+        starts = np.ascontiguousarray(starts, np.uint8)
+        times = np.ascontiguousarray(times, np.float64)
+
+        rec_cap = max(64, 2 * B * max(T // 8, 1))
+        way_cap = 8 * rec_cap
+        while True:
+            rec_trace = np.empty(rec_cap, np.int32)
+            rec_seg = np.empty(rec_cap, np.int64)
+            rec_t0 = np.empty(rec_cap, np.float64)
+            rec_t1 = np.empty(rec_cap, np.float64)
+            rec_len = np.empty(rec_cap, np.float64)
+            rec_internal = np.empty(rec_cap, np.uint8)
+            way_off = np.empty(rec_cap + 1, np.int32)
+            way_ids = np.empty(way_cap, np.int64)
+            n_ways = ctypes.c_int64(0)
+
+            n = self._lib.reporter_walk_segments(
+                _ptr(edges, ctypes.c_int32), _ptr(offs, ctypes.c_float),
+                _ptr(starts, ctypes.c_uint8), _ptr(times, ctypes.c_double),
+                B, T,
+                _ptr(self._edge_len, ctypes.c_float),
+                _ptr(self._edge_way, ctypes.c_int64),
+                _ptr(self._edge_osmlr, ctypes.c_int32),
+                _ptr(self._edge_osmlr_off, ctypes.c_float),
+                _ptr(self._osmlr_id, ctypes.c_int64),
+                _ptr(self._osmlr_len, ctypes.c_float),
+                _ptr(self._reach_to, ctypes.c_int32),
+                _ptr(self._reach_dist, ctypes.c_float),
+                _ptr(self._reach_next, ctypes.c_int32), self._m,
+                float(backward_slack), self._threads,
+                _ptr(rec_trace, ctypes.c_int32), _ptr(rec_seg, ctypes.c_int64),
+                _ptr(rec_t0, ctypes.c_double), _ptr(rec_t1, ctypes.c_double),
+                _ptr(rec_len, ctypes.c_double),
+                _ptr(rec_internal, ctypes.c_uint8), rec_cap,
+                _ptr(way_off, ctypes.c_int32), _ptr(way_ids, ctypes.c_int64),
+                way_cap, ctypes.byref(n_ways))
+            if n <= rec_cap and n_ways.value <= way_cap:
+                break
+            rec_cap = max(rec_cap * 2, int(n) + 64)
+            way_cap = max(way_cap * 2, int(n_ways.value) + 64)
+
+        out: list[list[SegmentRecord]] = [[] for _ in range(B)]
+        for r in range(int(n)):
+            ws = way_ids[way_off[r]:way_off[r + 1]]
+            out[int(rec_trace[r])].append(SegmentRecord(
+                segment_id=int(rec_seg[r]),
+                way_ids=[int(w) for w in ws],
+                start_time=float(rec_t0[r]),
+                end_time=float(rec_t1[r]),
+                length=float(rec_len[r]),
+                internal=bool(rec_internal[r]),
+            ))
+        return out
+
+
+def make_native_walker(ts: TileSet) -> NativeWalker | None:
+    """None when the native library is unavailable (Python fallback)."""
+    from reporter_tpu.native.build import load_native_lib
+
+    lib = load_native_lib()
+    if lib is None or not hasattr(lib, "reporter_walk_segments"):
+        return None
+    return NativeWalker(lib, ts)

@@ -18,7 +18,7 @@ import tempfile
 log = logging.getLogger("reporter_tpu.native")
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ("reach.cc",)
+_SOURCES = ("reach.cc", "walker.cc")
 _LIB_NAME = "_libreporter.so"
 
 
@@ -87,5 +87,21 @@ def load_native_lib() -> "ctypes.CDLL | None":
         ctypes.c_double, ctypes.c_double,            # lox, loy
         ctypes.c_double, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         i32p, i32p,                                  # grid, counts
+    ]
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.reporter_walk_segments.restype = ctypes.c_int64
+    lib.reporter_walk_segments.argtypes = [
+        i32p, f32p, u8p, f64p,                       # edges, offs, starts, times
+        ctypes.c_int64, ctypes.c_int64,              # B, T
+        f32p, i64p, i32p, f32p,                      # edge_{len,way,osmlr,osmlr_off}
+        i64p, f32p,                                  # osmlr_{id,len}
+        i32p, f32p, i32p, ctypes.c_int32,            # reach_{to,dist,next}, M
+        ctypes.c_double, ctypes.c_int32,             # backward_slack, n_threads
+        i32p, i64p, f64p, f64p, f64p, u8p,           # record columns
+        ctypes.c_int64,                              # rec_cap
+        i32p, i64p, ctypes.c_int64,                  # way_off, way_ids, way_cap
+        i64p,                                        # n_ways_out
     ]
     return lib

@@ -284,35 +284,53 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
                              # grid dim must equal the id-list width or the
                              # kernel reads the scalar ref out of bounds
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(nchunks, nj),
-        in_specs=[
-            pl.BlockSpec((_P, 2), lambda i, j, ids: (i, 0)),
-            pl.BlockSpec((SP_NCOMP, _SBLK), lambda i, j, ids: (0, ids[i, j])),
-        ],
-        out_specs=[
-            pl.BlockSpec((_P, k), lambda i, j, ids: (i, 0)),
-            pl.BlockSpec((_P, k), lambda i, j, ids: (i, 0)),
-            pl.BlockSpec((_P, k), lambda i, j, ids: (i, 0)),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((_P, k), jnp.float32),
-            pltpu.VMEM((_P, k), jnp.int32),
-            pltpu.VMEM((_P, k), jnp.float32),
-        ],
-    )
-    edge, off, dist = pl.pallas_call(
-        functools.partial(_sweep_kernel, r2=float(radius) * float(radius),
-                          k=k, nj=nj),
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((npad, k), jnp.int32),
-            jax.ShapeDtypeStruct((npad, k), jnp.float32),
-            jax.ShapeDtypeStruct((npad, k), jnp.float32),
-        ],
-        interpret=_INTERPRET,
-    )(ids, pts, pack)
+    def call(ids_g, pts_g):
+        nc = ids_g.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nc, nj),
+            in_specs=[
+                pl.BlockSpec((_P, 2), lambda i, j, ids: (i, 0)),
+                pl.BlockSpec((SP_NCOMP, _SBLK),
+                             lambda i, j, ids: (0, ids[i, j])),
+            ],
+            out_specs=[
+                pl.BlockSpec((_P, k), lambda i, j, ids: (i, 0)),
+                pl.BlockSpec((_P, k), lambda i, j, ids: (i, 0)),
+                pl.BlockSpec((_P, k), lambda i, j, ids: (i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((_P, k), jnp.float32),
+                pltpu.VMEM((_P, k), jnp.int32),
+                pltpu.VMEM((_P, k), jnp.float32),
+            ],
+        )
+        return pl.pallas_call(
+            functools.partial(_sweep_kernel, r2=float(radius) * float(radius),
+                              k=k, nj=nj),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((nc * _P, k), jnp.int32),
+                jax.ShapeDtypeStruct((nc * _P, k), jnp.float32),
+                jax.ShapeDtypeStruct((nc * _P, k), jnp.float32),
+            ],
+            interpret=_INTERPRET,
+        )(ids_g, pts_g, pack)
+
+    # The prefetched id list lives in SMEM (~1 MB), lane-padded to 128
+    # columns — cap chunks per pallas_call and sequence groups (XLA
+    # pipelines consecutive custom calls).
+    padded_cols = ((nj + 127) // 128) * 128
+    maxc = max(1, (512 * 1024) // (padded_cols * 4))
+    if nchunks <= maxc:
+        edge, off, dist = call(ids, pts)
+    else:
+        parts = []
+        for lo in range(0, nchunks, maxc):
+            hi = min(nchunks, lo + maxc)
+            parts.append(call(ids[lo:hi], pts[lo * _P:hi * _P]))
+        edge, off, dist = (jnp.concatenate(xs, axis=0)
+                           for xs in zip(*parts))
     return edge[:n], off[:n], dist[:n]
 
 

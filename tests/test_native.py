@@ -78,3 +78,45 @@ class TestCompilerIntegration:
         np.testing.assert_array_equal(py.reach_dist, cc.reach_dist)
         np.testing.assert_array_equal(py.reach_next, cc.reach_next)
         np.testing.assert_array_equal(py.grid, cc.grid)
+
+
+class TestNativeWalker:
+    """walker.cc vs the Python segment walk — exact record parity."""
+
+    def test_walker_matches_python_walk(self, tiny_tiles):
+        import numpy as np
+
+        from reporter_tpu.config import Config
+        from reporter_tpu.matcher.api import SegmentMatcher, Trace
+        from reporter_tpu.matcher.native_walk import make_native_walker
+        from reporter_tpu.netgen.traces import synthesize_fleet
+
+        ts = tiny_tiles
+        walker = make_native_walker(ts)
+        if walker is None:
+            import pytest
+            pytest.skip("native toolchain unavailable")
+
+        fleet = synthesize_fleet(ts, 12, num_points=70, seed=21)
+        traces = [Trace(uuid=p.uuid, xy=p.xy.astype("float32"), times=p.times)
+                  for p in fleet]
+        # teleport a jump into a few traces to force chain breaks
+        for tr in traces[::4]:
+            tr.xy[len(tr.xy) // 2:] += np.float32(2500.0)
+
+        m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+        native = m.match_many(traces)              # native walker path
+        m._native_walker = None
+        python = m.match_many(traces)              # python walk fallback
+
+        assert len(native) == len(python)
+        for b, (rn, rp) in enumerate(zip(native, python)):
+            assert len(rn) == len(rp), f"trace {b}: {len(rn)} vs {len(rp)}"
+            for a, c in zip(rn, rp):
+                assert a.segment_id == c.segment_id, f"trace {b}"
+                assert a.way_ids == c.way_ids, f"trace {b}"
+                assert a.internal == c.internal, f"trace {b}"
+                np.testing.assert_allclose(
+                    [a.start_time, a.end_time, a.length],
+                    [c.start_time, c.end_time, c.length],
+                    rtol=1e-9, atol=1e-9, err_msg=f"trace {b}")
