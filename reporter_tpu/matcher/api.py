@@ -31,6 +31,7 @@ from reporter_tpu.matcher.segments import (
     reach_route_fn,
 )
 from reporter_tpu.tiles.tileset import TileSet
+from reporter_tpu.utils.metrics import MetricsRegistry
 
 _BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
 
@@ -79,10 +80,12 @@ def _dijkstra_route_fn(ts: TileSet, bound: float):
 class SegmentMatcher:
     """The backend boundary (reference: SegmentMatcher.Match, SURVEY §3.1)."""
 
-    def __init__(self, tileset: TileSet, config: Config | None = None):
+    def __init__(self, tileset: TileSet, config: Config | None = None,
+                 metrics: MetricsRegistry | None = None):
         self.ts = tileset
         self.config = (config or Config()).validate()
         self.params: MatcherParams = self.config.matcher
+        self.metrics = metrics or MetricsRegistry()
         backend = self.config.matcher_backend
         self._native_walker = None
         if backend == "jax":
@@ -121,9 +124,14 @@ class SegmentMatcher:
     # ---- batched API (the TPU throughput path) --------------------------
 
     def match_many(self, traces: Sequence[Trace]) -> list[list[SegmentRecord]]:
-        if self.backend == "reference_cpu":
-            return [self._match_cpu(t) for t in traces]
-        return self._match_jax_many(traces)
+        with self.metrics.stage("match"):
+            if self.backend == "reference_cpu":
+                out = [self._match_cpu(t) for t in traces]
+            else:
+                out = self._match_jax_many(traces)
+        self.metrics.count("traces", len(traces))
+        self.metrics.count("probes", sum(len(t.xy) for t in traces))
+        return out
 
     def matched_points(self, trace: Trace) -> list[MatchedPoint]:
         """Per-point decode (no segment association) — test/diagnostic hook."""
@@ -204,7 +212,15 @@ class SegmentMatcher:
         return out
 
     def _match_jax_many(self, traces: Sequence[Trace]) -> list[list[SegmentRecord]]:
-        decoded = self._decode_many(traces)
+        with self.metrics.stage("decode"):
+            decoded = self._decode_many(traces)
+        unmatched = sum(int((e < 0).sum()) for e, _, _ in decoded)
+        self.metrics.count("unmatched_points", unmatched)
+        with self.metrics.stage("walk"):
+            return self._walk_decoded(traces, decoded)
+
+    def _walk_decoded(self, traces: Sequence[Trace],
+                      decoded) -> list[list[SegmentRecord]]:
         if self._native_walker is not None:
             B = len(traces)
             tmax = max((len(e) for e, _, _ in decoded), default=1) or 1

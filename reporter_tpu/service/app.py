@@ -165,6 +165,11 @@ class ReporterApp:
         try:
             if path == "/health" and method == "GET":
                 return _respond(start_response, 200, self.health())
+            if path == "/stats" and method == "GET":
+                # per-stage timings + north-star counters (SURVEY.md §5
+                # "Metrics": probes/sec, p50 match latency, failure rate)
+                return _respond(start_response, 200,
+                                self.matcher.metrics.snapshot())
             if path == "/report" and method == "POST":
                 body = _read_json(environ)
                 self.stats["requests"] += 1

@@ -1,0 +1,109 @@
+"""Tile-pipeline CLI — the valhalla_build_tiles / osmlr / associate analog.
+
+The reference's offline pipeline is three C++ CLI tools chained by scripts
+(SURVEY.md §3.4): build routable tiles, generate OSMLR segments, write the
+edge↔segment association back. Here the whole chain is one compiler pass
+(tiles/compiler.compile_network does graph + OSMLR chaining + association +
+grid + reach tables), so the CLI surface is:
+
+    python -m reporter_tpu.tiles build --osm map.osm.xml -o metro.npz
+    python -m reporter_tpu.tiles synth --city sf -o sf.npz
+    python -m reporter_tpu.tiles info metro.npz
+
+Compiled .npz tilesets load with TileSet.load() and stage straight to HBM
+via TileSet.device_tables().
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _params(args: argparse.Namespace):
+    from reporter_tpu.config import CompilerParams
+
+    kw = {}
+    for f in ("cell_size", "cell_capacity", "index_radius", "reach_radius",
+              "reach_max", "osmlr_max_length"):
+        v = getattr(args, f, None)
+        if v is not None:
+            kw[f] = v
+    if getattr(args, "no_native", False):
+        kw["use_native"] = False
+    return CompilerParams(**kw)
+
+
+def _add_compiler_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-o", "--output", required=True, help="output .npz path")
+    p.add_argument("--cell-size", dest="cell_size", type=float)
+    p.add_argument("--cell-capacity", dest="cell_capacity", type=int)
+    p.add_argument("--index-radius", dest="index_radius", type=float)
+    p.add_argument("--reach-radius", dest="reach_radius", type=float)
+    p.add_argument("--reach-max", dest="reach_max", type=int)
+    p.add_argument("--osmlr-max-length", dest="osmlr_max_length", type=float)
+    p.add_argument("--no-native", dest="no_native", action="store_true",
+                   help="force the pure-Python reach/grid builders")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m reporter_tpu.tiles")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("build", help="compile an OSM XML extract")
+    b.add_argument("--osm", required=True, help="OSM XML file (.osm/.xml)")
+    b.add_argument("--name", default=None, help="tileset name")
+    _add_compiler_flags(b)
+
+    s = sub.add_parser("synth", help="compile a synthetic city")
+    s.add_argument("--city", default="sf",
+                   help="tiny|sf|nyc|la (netgen/synthetic.py)")
+    s.add_argument("--seed", type=int, default=0)
+    _add_compiler_flags(s)
+
+    i = sub.add_parser("info", help="print a compiled tileset's stats")
+    i.add_argument("path")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "info":
+        from reporter_tpu.tiles.tileset import TileSet
+
+        ts = TileSet.load(args.path)
+        print(json.dumps({
+            "name": ts.name,
+            "nodes": ts.num_nodes,
+            "edges": ts.num_edges,
+            "line_segments": int(len(ts.seg_edge)),
+            "osmlr_segments": int(len(ts.osmlr_id)),
+            "grid_cells": int(ts.grid.shape[0]),
+            "hbm_bytes": ts.hbm_bytes(),
+            "meta": {"cell_size": ts.meta.cell_size,
+                     "grid_dims": list(ts.meta.grid_dims),
+                     "index_radius": ts.meta.index_radius},
+            "stats": ts.stats,
+        }, indent=2))
+        return 0
+
+    from reporter_tpu.tiles.compiler import compile_network
+
+    if args.cmd == "build":
+        from reporter_tpu.netgen.osm_xml import parse_osm_xml
+
+        name = args.name or args.osm.rsplit("/", 1)[-1].split(".")[0]
+        net = parse_osm_xml(args.osm, name=name)
+    else:
+        from reporter_tpu.netgen.synthetic import generate_city
+
+        net = generate_city(args.city, seed=args.seed)
+
+    ts = compile_network(net, _params(args))
+    ts.save(args.output)
+    print(json.dumps({"written": args.output, "name": ts.name,
+                      "stats": ts.stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Cross-cutting utilities (metrics/observability)."""
+
+from reporter_tpu.utils.metrics import MetricsRegistry, StageTimer
+
+__all__ = ["MetricsRegistry", "StageTimer"]

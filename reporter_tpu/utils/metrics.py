@@ -1,0 +1,119 @@
+"""Per-stage wall-clock metrics and counters.
+
+The reference has no in-process observability (SURVEY.md §5 "Metrics /
+logging": stdout logging only; the external Datastore is the product's
+metric sink). This module is the TPU build's deliberate gap-fill: the
+north-star metrics — probes/sec, p50 per-trace match latency, match-failure
+rate (BASELINE.md) — need a home that both the HTTP service and the
+streaming worker can feed, cheaply, from any thread.
+
+Design: a registry of named counters + stage timers with bounded reservoir
+percentiles. Everything is O(1) per event, lock-guarded (service handlers
+are threaded), and snapshot() renders a plain-dict view for /stats or logs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+
+class _Reservoir:
+    """Bounded sample ring for percentile estimates (newest-N policy —
+    streaming metrics should reflect recent behavior, not all of history)."""
+
+    __slots__ = ("_buf", "_cap", "_n")
+
+    def __init__(self, cap: int = 1024):
+        self._buf: list[float] = []
+        self._cap = cap
+        self._n = 0
+
+    def add(self, v: float) -> None:
+        if len(self._buf) < self._cap:
+            self._buf.append(v)
+        else:
+            self._buf[self._n % self._cap] = v
+        self._n += 1
+
+    def quantile(self, q: float) -> float:
+        if not self._buf:
+            return float("nan")
+        s = sorted(self._buf)
+        i = min(len(s) - 1, max(0, int(q * (len(s) - 1) + 0.5)))
+        return s[i]
+
+
+class StageTimer:
+    """Context manager that records one stage's wall time:
+
+        with metrics.stage("decode"):
+            ...
+    """
+
+    __slots__ = ("_registry", "_name", "_t0")
+
+    def __init__(self, registry: "MetricsRegistry", name: str):
+        self._registry = registry
+        self._name = name
+        self._t0 = 0.0
+
+    def __enter__(self) -> "StageTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._registry.observe(self._name + "_seconds",
+                               time.perf_counter() - self._t0)
+
+
+class MetricsRegistry:
+    """Named counters + observation series; thread-safe; snapshot-able."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._series: dict[str, _Reservoir] = {}
+        self._born = time.time()
+
+    # ---- write side ------------------------------------------------------
+
+    def count(self, name: str, delta: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + delta
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            r = self._series.get(name)
+            if r is None:
+                r = self._series[name] = _Reservoir()
+            r.add(value)
+            self._counters[name + "_total"] = (
+                self._counters.get(name + "_total", 0.0) + value)
+            self._counters[name + "_count"] = (
+                self._counters.get(name + "_count", 0.0) + 1)
+
+    def stage(self, name: str) -> StageTimer:
+        return StageTimer(self, name)
+
+    # ---- read side -------------------------------------------------------
+
+    def value(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Plain-dict view: counters verbatim + p50/p95 per series + derived
+        rates for the north-star metrics when their inputs exist."""
+        with self._lock:
+            out: dict[str, Any] = dict(self._counters)
+            for name, r in self._series.items():
+                out[name + "_p50"] = r.quantile(0.50)
+                out[name + "_p95"] = r.quantile(0.95)
+            probes = out.get("probes", 0.0)
+            busy = out.get("match_seconds_total", 0.0)
+            if probes and busy:
+                out["probes_per_sec_busy"] = probes / busy
+            out["uptime_seconds"] = time.time() - self._born
+            return out

@@ -1,0 +1,86 @@
+"""Determinism — the functional-JAX analog of the reference deps' TSan CI
+(SURVEY.md §5 "Race detection": the device program must be a pure function;
+same batch ⇒ bit-identical output, and a trace's result must not depend on
+which batch it rode in)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from reporter_tpu.config import MatcherParams
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.ops.match import match_batch
+
+
+def _points(ts, b, t, seed=31):
+    fleet = synthesize_fleet(ts, b, num_points=t, seed=seed)
+    return np.stack([p.xy for p in fleet]).astype(np.float32)
+
+
+def test_same_batch_bit_identical(tiny_tiles):
+    ts = tiny_tiles
+    tables = ts.device_tables()
+    pts = jnp.asarray(_points(ts, 8, 48))
+    valid = jnp.ones(pts.shape[:2], bool)
+    params = MatcherParams()
+
+    a = match_batch(pts, valid, tables, ts.meta, params)
+    b = match_batch(pts, valid, tables, ts.meta, params)
+    for fa, fb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+def test_result_independent_of_batch_composition(tiny_tiles):
+    """Trace 0 decoded alone == trace 0 decoded inside a larger batch
+    (per-point candidate independence + per-trace Viterbi vmap; the dense
+    sweep's chunk grouping must not leak across traces)."""
+    ts = tiny_tiles
+    tables = ts.device_tables()
+    pts = _points(ts, 6, 48)
+    valid = np.ones(pts.shape[:2], bool)
+    params = MatcherParams()
+
+    full = match_batch(jnp.asarray(pts), jnp.asarray(valid), tables,
+                       ts.meta, params)
+    solo = match_batch(jnp.asarray(pts[:1]), jnp.asarray(valid[:1]), tables,
+                       ts.meta, params)
+    for ff, fs in zip(full, solo):
+        np.testing.assert_array_equal(np.asarray(ff)[0], np.asarray(fs)[0])
+
+
+def test_cli_synth_info_build(tmp_path):
+    import json
+
+    from reporter_tpu.tiles.__main__ import main
+
+    out = tmp_path / "tiny.npz"
+    assert main(["synth", "--city", "tiny", "--seed", "3",
+                 "-o", str(out)]) == 0
+    assert out.exists()
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main(["info", str(out)]) == 0
+    info = json.loads(buf.getvalue())
+    assert info["edges"] > 0 and info["osmlr_segments"] > 0
+
+    xml = tmp_path / "f.osm"
+    xml.write_text("""<?xml version='1.0'?>
+    <osm>
+      <node id='1' lat='37.700' lon='-122.400'/>
+      <node id='2' lat='37.701' lon='-122.400'/>
+      <node id='3' lat='37.702' lon='-122.401'/>
+      <way id='100'>
+        <nd ref='1'/><nd ref='2'/><nd ref='3'/>
+        <tag k='highway' v='residential'/>
+      </way>
+    </osm>""")
+    out2 = tmp_path / "osm.npz"
+    assert main(["build", "--osm", str(xml), "-o", str(out2),
+                 "--reach-radius", "300"]) == 0
+    from reporter_tpu.tiles.tileset import TileSet
+
+    ts = TileSet.load(str(out2))
+    assert ts.num_edges == 4  # one residential two-way chain

@@ -74,6 +74,16 @@ class TestEndpoints:
         assert body["status"] == "ok"
         assert body["edges"] == app.matcher.ts.num_edges
 
+    def test_stats_endpoint(self, app, svc_tiles):
+        payload = _probe_payload(svc_tiles, seed=23)
+        wsgi_call(app, "POST", "/report", payload)
+        status, body = wsgi_call(app, "GET", "/stats")
+        assert status == 200
+        assert body["probes"] >= len(payload["trace"])
+        assert body["match_seconds_count"] >= 1
+        assert body["match_seconds_p50"] > 0
+        assert "uptime_seconds" in body
+
     def test_report_roundtrip(self, app, svc_tiles):
         payload = _probe_payload(svc_tiles, seed=11)
         status, body = wsgi_call(app, "POST", "/report", payload)
