@@ -37,11 +37,12 @@ class MatcherParams:
                                    # GPS noise shifts projections backwards between samples —
                                    # Meili absorbs this via input interpolation, we absorb it
                                    # in the transition model (ops/hmm.route_distance)
-    max_device_batch: int = 16384  # traces per device dispatch. Large on
-                                   # purpose: per-dispatch link round-trips
-                                   # dominate small batches on a
-                                   # remote-attached chip; HBM transients
-                                   # stay modest (B·T·K·M f32 per scan step)
+    max_device_batch: int = 4096   # traces per device dispatch. Big enough
+                                   # to amortize per-dispatch link
+                                   # round-trips, small enough that
+                                   # submit-all-then-harvest overlaps device
+                                   # compute with result transfers (measured
+                                   # optimum on a remote-attached v5e)
 
     def replace(self, **kw: Any) -> "MatcherParams":
         return dataclasses.replace(self, **kw)

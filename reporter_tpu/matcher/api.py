@@ -153,7 +153,7 @@ class SegmentMatcher:
         chain_starts) numpy triples, bucketed by padded length."""
         import jax.numpy as jnp
 
-        from reporter_tpu.ops.match import match_batch
+        from reporter_tpu.ops.match import match_batch_wire, unpack_wire
 
         max_b = _BUCKETS[-1]
         # Traces beyond the largest bucket are decoded in consecutive chunks
@@ -183,18 +183,16 @@ class SegmentMatcher:
         for b, ws in sliced:
             B = len(ws)
             pts = np.zeros((B, b, 2), np.float32)
-            valid = np.zeros((B, b), bool)
+            lens = np.zeros(B, np.int32)
             for r, w in enumerate(ws):
                 xy = work[w][2]
                 pts[r, :len(xy)] = xy
-                valid[r, :len(xy)] = True
-            res = match_batch(jnp.asarray(pts), jnp.asarray(valid),
-                              self._tables, self.ts.meta, self.params)
-            inflight.append((ws, res))
-        for ws, res in inflight:
-            edges = np.asarray(res.edge)
-            offs = np.asarray(res.offset)
-            starts = np.asarray(res.chain_start)
+                lens[r] = len(xy)
+            wire = match_batch_wire(jnp.asarray(pts), jnp.asarray(lens),
+                                    self._tables, self.ts.meta, self.params)
+            inflight.append((ws, wire))
+        for ws, wire in inflight:
+            edges, offs, starts = unpack_wire(np.asarray(wire))
             for r, w in enumerate(ws):
                 i, lo, xy = work[w]
                 T = len(xy)

@@ -109,3 +109,45 @@ def match_batch(points, valid_pt, tables: dict[str, Any], meta: TileMeta,
     (SURVEY.md §7.5 "jit persistence").
     """
     return match_traces(points, valid_pt, tables, meta, params)
+
+
+# Wire format (match_batch_wire): one u16 [B, 3, T] array so the decode
+# result crosses the device→host link as a SINGLE transfer (a remote-attached
+# chip pays a round-trip per fetched array, and bytes are the bottleneck):
+#   lane 0: offset along edge, 0.25 m fixed-point (u16 ⇒ edges to 16.4 km)
+#   lane 1: edge id low 16 bits
+#   lane 2: edge id bits 16..28 | chain_start << 14 | matched << 15
+OFFSET_QUANTUM = 0.25
+
+
+@functools.partial(jax.jit, static_argnames=("meta", "params"))
+def match_batch_wire(points, lengths, tables: dict[str, Any], meta: TileMeta,
+                     params: MatcherParams):
+    """points f32 [B, T, 2], lengths i32 [B] (valid prefix per trace) →
+    u16 [B, 3, T] wire array; unpack with unpack_wire()."""
+    T = points.shape[1]
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
+    out = match_traces(points, valid, tables, meta, params)
+    edge = jnp.maximum(out.edge, 0).astype(jnp.uint32)
+    off_q = jnp.clip(jnp.round(out.offset / OFFSET_QUANTUM), 0, 65535)
+    w0 = off_q.astype(jnp.uint16)
+    w1 = (edge & 0xFFFF).astype(jnp.uint16)
+    w2 = ((edge >> 16) & 0x1FFF
+          | (out.chain_start.astype(jnp.uint32) << 14)
+          | (out.matched.astype(jnp.uint32) << 15)).astype(jnp.uint16)
+    return jnp.stack([w0, w1, w2], axis=1)
+
+
+def unpack_wire(wire) -> tuple[Any, Any, Any]:
+    """numpy unpack: u16 [B, 3, T] → (edges i32 [B,T] with -1 unmatched,
+    offsets f32 [B,T], chain_starts bool [B,T])."""
+    import numpy as np
+
+    w0 = wire[:, 0].astype(np.int64)
+    w1 = wire[:, 1].astype(np.int64)
+    w2 = wire[:, 2].astype(np.int64)
+    matched = (w2 >> 15) & 1
+    edges = np.where(matched == 1, w1 | ((w2 & 0x1FFF) << 16), -1)
+    offsets = (w0 * OFFSET_QUANTUM).astype(np.float32)
+    starts = ((w2 >> 14) & 1).astype(bool)
+    return edges.astype(np.int32), offsets, starts
