@@ -21,6 +21,9 @@ CITY_PRESETS: dict[str, tuple[int, int, int]] = {
     "sf": (1, 40, 40),
     "nyc": (2, 56, 36),
     "la": (3, 48, 48),
+    # metro-scale tile set (BASELINE config 3 "Bay-Area tiles in HBM"):
+    # ~16k intersections, ~110k directed edges, ~17 km on a side
+    "bayarea": (4, 128, 128),
 }
 
 _CITY_CENTERS = {
@@ -28,6 +31,7 @@ _CITY_CENTERS = {
     "sf": (-122.4194, 37.7749),
     "nyc": (-73.9857, 40.7484),
     "la": (-118.2437, 34.0522),
+    "bayarea": (-122.2711, 37.8044),
 }
 
 

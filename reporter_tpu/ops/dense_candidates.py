@@ -63,7 +63,7 @@ SP_NCOMP = 8
 _INTERPRET = os.environ.get("RTPU_PALLAS_INTERPRET", "") == "1"
 
 _P = 128          # points per chunk (sublane-friendly)
-_SBLK = 256       # segment columns per block (small: culling granularity)
+_SBLK = 512       # segment columns per block (small: culling granularity)
 _NSUB = 4         # chunk sub-bboxes (tighter than one bbox for long chunks)
 
 

@@ -151,6 +151,7 @@ class ReporterApp:
             "backend": self.matcher.backend,
             "tileset": self.matcher.ts.name,
             "edges": self.matcher.ts.num_edges,
+            "tile_hbm_bytes": self.matcher.ts.hbm_bytes(),
             "cached_uuids": len(self.cache),
             "published": self.publisher.published,
             "dropped": self.publisher.dropped,

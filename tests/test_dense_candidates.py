@@ -37,16 +37,18 @@ def _fleet_points(ts, b, t, seed=5):
 def test_seg_pack_roundtrip(ts):
     sp = build_seg_pack(ts.seg_a, ts.seg_b, ts.seg_edge, ts.seg_off,
                         ts.seg_len)
+    from reporter_tpu.ops.dense_candidates import _SBLK
+
     s = len(ts.seg_edge)
-    assert sp.pack.shape[1] % 256 == 0
+    assert sp.pack.shape[1] % _SBLK == 0
     edges = sp.pack[6].view(np.int32)
     # Morton sort permutes columns; same multiset of edges, -1 padding tail
     np.testing.assert_array_equal(np.sort(edges[:s]), np.sort(ts.seg_edge))
     assert (edges[s:] == -1).all()
     # every real column lies inside its block's bbox
-    nblocks = sp.pack.shape[1] // 256
+    nblocks = sp.pack.shape[1] // _SBLK
     for blk in range(nblocks):
-        cols = slice(blk * 256, (blk + 1) * 256)
+        cols = slice(blk * _SBLK, (blk + 1) * _SBLK)
         e = edges[cols]
         if (e < 0).all():
             assert np.isnan(sp.bbox[blk]).all()
