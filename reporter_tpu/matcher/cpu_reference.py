@@ -123,8 +123,24 @@ def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
     def emit(c: _Cand) -> float:
         return c.dist ** 2 / (2.0 * params.sigma_z ** 2)
 
-    # Forward pass over active points (those with candidates).
-    act = [t for t in range(T) if cands[t]]
+    # Input interpolation (mirror of ops.hmm.interpolation_keep_mask):
+    # points within interpolation_distance of the last kept point do not
+    # vote in the HMM.
+    keep = [True] * T
+    if params.interpolation_distance > 0.0 and T:
+        last = None
+        for t in range(T):
+            if last is None:
+                last = t
+                continue
+            if (float(np.linalg.norm(xy[t] - xy[last]))
+                    < params.interpolation_distance):
+                keep[t] = False
+            else:
+                last = t
+
+    # Forward pass over active points (those kept, with candidates).
+    act = [t for t in range(T) if keep[t] and cands[t]]
     if not act:
         return results
     scores: dict[int, list[float]] = {}
@@ -190,4 +206,15 @@ def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
                 if k < 0 and tt != chain_ts[0]:
                     break  # defensive: should only hit -1 at the chain head
         i = start - 1
+
+    # Interpolated points ride the matched path (mirror of the device
+    # fill pass in ops.hmm.viterbi_decode): inherit the last matched
+    # point's location.
+    last: "tuple[int, float] | None" = None
+    for t in range(T):
+        e, off, _ = results[t]
+        if e >= 0:
+            last = (e, off)
+        elif not keep[t] and last is not None:
+            results[t] = (last[0], last[1], False)
     return results

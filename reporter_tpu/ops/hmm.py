@@ -86,22 +86,47 @@ def emission_costs(cands: CandidateSet, sigma_z: float):
     return jnp.where(cands.valid, c, BIG)
 
 
+def interpolation_keep_mask(points, valid_pt, interp_distance: float):
+    """bool [T]: False for points within ``interp_distance`` of the last
+    kept point — Meili's input interpolation (such points ride the matched
+    path instead of voting in the HMM; SURVEY.md §2.2 map-matcher row).
+    Sequential by definition (distance to the last KEPT point), so a small
+    lax.scan over T; vmap over traces upstream."""
+    if interp_distance <= 0.0:
+        return valid_pt
+    d2_min = jnp.float32(interp_distance) ** 2
+
+    def step(carry, x):
+        last_pt, any_kept = carry
+        pt, v = x
+        d2 = jnp.sum((pt - last_pt) ** 2)
+        keep = v & (~any_kept | (d2 >= d2_min))
+        return (jnp.where(keep, pt, last_pt), any_kept | keep), keep
+
+    (_, _), keep = jax.lax.scan(
+        step, (points[0], jnp.bool_(False)), (points, valid_pt))
+    return keep
+
+
 def viterbi_decode(cands: CandidateSet, points, valid_pt, tables,
                    sigma_z: float, beta: float, max_route_factor: float,
                    breakage_distance: float,
-                   backward_slack: float = 10.0) -> ViterbiResult:
+                   backward_slack: float = 10.0,
+                   interpolation_distance: float = 0.0) -> ViterbiResult:
     """Viterbi over the candidate lattice of ONE trace.
 
     points: f32 [T, 2] (for gc distances); valid_pt: bool [T] padding mask.
     Chain breakage: when consecutive points are farther apart than
     ``breakage_distance`` or no transition is allowed, the chain restarts at
     the new point, mirroring Meili's broken-path behavior. Inactive points
-    (padding, or no candidate in radius) pass the carry through untouched with
-    identity backpointers, so chains connect across them.
+    (padding, interpolated, or no candidate in radius) pass the carry
+    through untouched with identity backpointers, so chains connect across
+    them.
     """
     T, K = cands.edge.shape
     em = emission_costs(cands, sigma_z)                     # [T, K]
-    active = valid_pt & jnp.any(cands.valid, axis=1)        # [T]
+    keep = interpolation_keep_mask(points, valid_pt, interpolation_distance)
+    active = keep & jnp.any(cands.valid, axis=1)            # [T]
     identity_bp = jnp.arange(K, dtype=jnp.int32)
 
     def slot_view(t_idx):
@@ -170,10 +195,32 @@ def viterbi_decode(cands: CandidateSet, points, valid_pt, tables,
     safe = jnp.maximum(choice, 0)
     matched = choice >= 0
     t_ar = jnp.arange(T)
+    edge = jnp.where(matched, cands.edge[t_ar, safe], -1).astype(jnp.int32)
+    offset = jnp.where(matched, cands.offset[t_ar, safe], 0.0)
+
+    # Interpolated points (valid but not voting) ride the matched path:
+    # inherit the last matched point's (edge, offset), as Meili interpolates
+    # skipped input points onto the route. Padding stays unmatched.
+    interp = valid_pt & ~keep
+
+    def fill(carry, x):
+        pe, po, pok = carry
+        e, o, m, ip = x
+        use = ip & pok & ~m
+        e2 = jnp.where(use, pe, e)
+        o2 = jnp.where(use, po, o)
+        m2 = m | use
+        new = (jnp.where(m, e, pe), jnp.where(m, o, po), pok | m)
+        return new, (e2, o2, m2)
+
+    _, (edge, offset, matched) = jax.lax.scan(
+        fill, (jnp.int32(-1), jnp.float32(0.0), jnp.bool_(False)),
+        (edge, offset, matched, interp))
+
     return ViterbiResult(
         choice=choice.astype(jnp.int32),
-        edge=jnp.where(matched, cands.edge[t_ar, safe], -1).astype(jnp.int32),
-        offset=jnp.where(matched, cands.offset[t_ar, safe], 0.0),
+        edge=edge,
+        offset=offset,
         chain_start=started,
         matched=matched,
     )

@@ -73,7 +73,8 @@ def _viterbi(cands: CandidateSet, points, valid_pt, tables,
     vit = viterbi_decode(
         cands, points, valid_pt, tables,
         params.sigma_z, params.beta, params.max_route_distance_factor,
-        params.breakage_distance, params.backward_slack)
+        params.breakage_distance, params.backward_slack,
+        params.interpolation_distance)
     return MatchOutput(edge=vit.edge, offset=vit.offset,
                        chain_start=vit.chain_start, matched=vit.matched)
 

@@ -169,3 +169,64 @@ class TestMatchAccuracy:
         # (the jump may be < breakage if the walks happen to end nearby; seed
         # pair chosen so they don't).
         assert starts[1:].any()
+
+
+class TestInterpolationMask:
+    def test_keep_mask_matches_naive(self, tiny_tiles):
+        import jax.numpy as jnp
+
+        from reporter_tpu.ops.hmm import interpolation_keep_mask
+
+        rng = np.random.default_rng(5)
+        # random walk with some stationary clusters
+        steps = rng.normal(0, 8, size=(40, 2))
+        steps[10:15] = 0.1   # stopped vehicle
+        pts = np.cumsum(steps, axis=0).astype(np.float32)
+        valid = np.ones(40, bool)
+        valid[35:] = False
+
+        got = np.asarray(interpolation_keep_mask(
+            jnp.asarray(pts), jnp.asarray(valid), 10.0))
+
+        want = np.zeros(40, bool)
+        last = None
+        for t in range(40):
+            if not valid[t]:
+                continue
+            if last is None or np.linalg.norm(pts[t] - pts[last]) >= 10.0:
+                want[t] = True
+                last = t
+        np.testing.assert_array_equal(got, want)
+
+    def test_disabled_keeps_all(self, tiny_tiles):
+        import jax.numpy as jnp
+
+        from reporter_tpu.ops.hmm import interpolation_keep_mask
+
+        pts = jnp.zeros((8, 2), jnp.float32)
+        valid = jnp.ones(8, bool)
+        got = np.asarray(interpolation_keep_mask(pts, valid, 0.0))
+        assert got.all()
+
+    def test_stationary_cluster_interpolated_both_backends(self, tiny_tiles):
+        """A stopped vehicle's noise cloud must not fragment the match, and
+        jax/cpu backends must agree on which points vote."""
+        from reporter_tpu.config import Config, MatcherParams
+        from reporter_tpu.matcher.api import SegmentMatcher, Trace
+        from reporter_tpu.netgen.traces import synthesize_probe
+
+        ts = tiny_tiles
+        probe = synthesize_probe(ts, seed=13, num_points=50, gps_sigma=3.0)
+        xy = probe.xy.copy()
+        xy[20:30] = xy[20] + np.random.default_rng(0).normal(
+            0, 2.0, size=(10, 2))          # 10 samples while stopped
+        times = probe.times
+        tr = Trace(uuid="veh", xy=xy.astype(np.float32), times=times)
+
+        recs = {}
+        for backend in ("jax", "reference_cpu"):
+            m = SegmentMatcher(ts, Config(matcher_backend=backend))
+            recs[backend] = m.match_many([tr])[0]
+        ids_j = [r.segment_id for r in recs["jax"]]
+        ids_c = [r.segment_id for r in recs["reference_cpu"]]
+        assert ids_j == ids_c
