@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from reporter_tpu.config import MatcherParams
 from reporter_tpu.ops.candidates import CandidateSet, find_candidates_trace
 from reporter_tpu.ops.dense_candidates import find_candidates_dense
-from reporter_tpu.ops.hmm import viterbi_decode
+from reporter_tpu.ops.hmm import viterbi_decode_batched
 from reporter_tpu.tiles.tileset import TileMeta
 
 
@@ -68,17 +68,6 @@ def batch_candidates(points, valid_pt, tables, meta,
         p, tables, meta, params.search_radius, params.max_candidates))(points)
 
 
-def _viterbi(cands: CandidateSet, points, valid_pt, tables,
-             params: MatcherParams) -> MatchOutput:
-    vit = viterbi_decode(
-        cands, points, valid_pt, tables,
-        params.sigma_z, params.beta, params.max_route_distance_factor,
-        params.breakage_distance, params.backward_slack,
-        params.interpolation_distance)
-    return MatchOutput(edge=vit.edge, offset=vit.offset,
-                       chain_start=vit.chain_start, matched=vit.matched)
-
-
 def match_trace(points, valid_pt, tables, meta,
                 params: MatcherParams) -> MatchOutput:
     """Match ONE padded trace: points f32 [T, 2], valid_pt bool [T].
@@ -95,9 +84,13 @@ def match_traces(points, valid_pt, tables, meta,
     """Match a batch (not jitted — compose under jit/vmap/shard_map):
     points f32 [B, T, 2], valid_pt bool [B, T]."""
     cands = batch_candidates(points, valid_pt, tables, meta, params)
-    return jax.vmap(
-        lambda c, p, v: _viterbi(c, p, v, tables, params))(
-            cands, points, valid_pt)
+    vit = viterbi_decode_batched(
+        cands, points, valid_pt, tables,
+        params.sigma_z, params.beta, params.max_route_distance_factor,
+        params.breakage_distance, params.backward_slack,
+        params.interpolation_distance)
+    return MatchOutput(edge=vit.edge, offset=vit.offset,
+                       chain_start=vit.chain_start, matched=vit.matched)
 
 
 @functools.partial(jax.jit, static_argnames=("meta", "params"))

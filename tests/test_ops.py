@@ -230,3 +230,39 @@ class TestInterpolationMask:
         ids_j = [r.segment_id for r in recs["jax"]]
         ids_c = [r.segment_id for r in recs["reference_cpu"]]
         assert ids_j == ids_c
+
+
+class TestBatchedViterbi:
+    def test_batched_matches_vmapped(self, tiny_tiles):
+        """viterbi_decode_batched must be bit-identical to
+        vmap(viterbi_decode) — same lattice, batch-last layout."""
+        import jax
+        import jax.numpy as jnp
+
+        from reporter_tpu.config import MatcherParams
+        from reporter_tpu.netgen.traces import synthesize_fleet
+        from reporter_tpu.ops.hmm import viterbi_decode, viterbi_decode_batched
+        from reporter_tpu.ops.match import batch_candidates
+
+        ts = tiny_tiles
+        tables = ts.device_tables()
+        params = MatcherParams()
+        fleet = synthesize_fleet(ts, 7, num_points=40, seed=17)
+        pts = np.stack([p.xy for p in fleet]).astype(np.float32)
+        # chain break + padding coverage
+        pts[2, 20:] += np.float32(3000.0)
+        valid = np.ones(pts.shape[:2], bool)
+        valid[5, 30:] = False
+
+        pj, vj = jnp.asarray(pts), jnp.asarray(valid)
+        cands = batch_candidates(pj, vj, tables, ts.meta, params)
+        args = (tables, params.sigma_z, params.beta,
+                params.max_route_distance_factor, params.breakage_distance,
+                params.backward_slack, params.interpolation_distance)
+
+        ref = jax.vmap(lambda c, p, v: viterbi_decode(c, p, v, *args))(
+            cands, pj, vj)
+        got = viterbi_decode_batched(cands, pj, vj, *args)
+        for name, a, b in zip(ref._fields, ref, got):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=name)
