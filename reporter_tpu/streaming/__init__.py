@@ -15,5 +15,6 @@ checkpoints offsets + buffers + histograms for crash recovery
 from reporter_tpu.streaming.queue import IngestQueue
 from reporter_tpu.streaming.histogram import SpeedHistogram
 from reporter_tpu.streaming.pipeline import StreamPipeline
+from reporter_tpu.streaming.worker import StreamWorker
 
 __all__ = ["IngestQueue", "SpeedHistogram", "StreamPipeline"]

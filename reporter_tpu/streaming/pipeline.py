@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any
+from typing import Any, Sequence
 
 import numpy as np
 
@@ -45,12 +45,25 @@ class StreamPipeline:
     def __init__(self, tileset: TileSet, config: Config | None = None,
                  queue: IngestQueue | None = None,
                  transport: Transport | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 partitions: "Sequence[int] | None" = None):
         self.config = (config or Config()).validate()
         sc = self.config.streaming
         self.queue = queue or IngestQueue(sc.num_partitions)
         if self.queue.num_partitions != sc.num_partitions:
             raise ValueError("queue/config partition count mismatch")
+        # Partition assignment (Kafka consumer-group analog, SURVEY.md §3.3):
+        # each worker owns a disjoint subset; uuid-hash routing guarantees a
+        # vehicle's records live in exactly one partition, so per-worker
+        # buffers never overlap. Reassigning a dead worker's partitions to a
+        # live pipeline (constructed at the dead worker's committed offsets)
+        # replays its unflushed tail — at-least-once, like a group rebalance.
+        owned = range(sc.num_partitions) if partitions is None else partitions
+        self.partitions = sorted(set(int(p) for p in owned))
+        if any(p < 0 or p >= sc.num_partitions for p in self.partitions):
+            raise ValueError(
+                f"partitions {self.partitions} out of range "
+                f"0..{sc.num_partitions - 1}")
         self.app = ReporterApp(tileset, self.config, transport=transport)
         self.clock = clock
         self.committed = [0] * sc.num_partitions
@@ -69,7 +82,7 @@ class StreamPipeline:
         Returns the number of reports produced this step.
         """
         sc = self.config.streaming
-        for p in range(sc.num_partitions):
+        for p in self.partitions:
             for off, rec in self.queue.poll(p, self._consumed[p],
                                             sc.poll_max_records):
                 self._consume(p, off, rec)
@@ -155,7 +168,8 @@ class StreamPipeline:
         return {
             "steps": self.steps,
             "malformed": self.malformed,
-            "lag": self.queue.lag(self.committed),
+            "lag": sum(self.queue.end_offset(p) - self.committed[p]
+                       for p in self.partitions),
             "buffered_uuids": len(self._buffers),
             "buffered_points": sum(len(b.points)
                                    for b in self._buffers.values()),

@@ -1,0 +1,68 @@
+"""StreamWorker — background thread driving a StreamPipeline.
+
+The process shape of the reference's Kafka matcher workers (SURVEY.md §3.3:
+one consumer-group member per partition set). Each worker owns a pipeline
+(and through it a disjoint partition subset); a host can run several
+workers as threads — while one blocks on the device link, the others
+ingest and publish, which is the host-side half of the survey's
+"double-buffered infeed" pipeline parallelism row (§2.3 PP).
+
+Failure model: a worker that dies leaves its partitions' committed offsets
+behind (pipeline.checkpoint, or simply its `committed` list); constructing
+a replacement pipeline over those partitions and restoring from the
+checkpoint replays the unflushed tail — the consumer-group rebalance
+analog, tested in tests/test_streaming.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from reporter_tpu.streaming.pipeline import StreamPipeline
+
+
+class StreamWorker:
+    """Drives pipeline.step() until stopped; drains on stop by default."""
+
+    def __init__(self, pipeline: StreamPipeline, poll_interval: float = 0.02,
+                 name: str | None = None):
+        self.pipeline = pipeline
+        self.poll_interval = float(poll_interval)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=name or f"stream-worker-{id(self) & 0xFFFF:04x}")
+        self.reports = 0
+        self.errors = 0
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "StreamWorker":
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+        if drain:
+            self.reports += self.pipeline.drain()
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    # ---- loop ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                n = self.pipeline.step()
+                self.reports += n
+            except Exception:
+                # Keep the worker alive (supervisor semantics): unflushed
+                # buffers hold the commit floor, so the next step retries.
+                self.errors += 1
+                n = 0
+            if n == 0:
+                time.sleep(self.poll_interval)

@@ -1,6 +1,7 @@
 """Streaming tests: fake ingest queue + deterministic clock (SURVEY.md §4)."""
 
 import json
+import time
 
 import numpy as np
 import pytest
@@ -276,3 +277,90 @@ class TestPipeline:
         pipe2, _, _ = _pipeline(stream_tiles)
         pipe2.restore(ckpt)
         np.testing.assert_array_equal(pipe2.hist.snapshot(), snap)
+
+
+class TestConsumerGroup:
+    """Partition assignment + worker threads (SURVEY §3.3 consumer groups)."""
+
+    def _two_workers(self, tiles):
+        published = []
+
+        def transport(url, body):
+            published.append(json.loads(body))
+            return 200
+
+        cfg = Config(service=ServiceConfig(datastore_url="http://ds.test/"),
+                     streaming=StreamingConfig(num_partitions=4,
+                                               flush_min_points=16))
+        clock = FakeClock()
+        queue = IngestQueue(4)
+        a = StreamPipeline(tiles, cfg, queue=queue, transport=transport,
+                           clock=clock, partitions=[0, 1])
+        b = StreamPipeline(tiles, cfg, queue=queue, transport=transport,
+                           clock=clock, partitions=[2, 3])
+        return a, b, queue, published, clock
+
+    def test_disjoint_partitions_drain_everything(self, stream_tiles):
+        a, b, queue, published, _ = self._two_workers(stream_tiles)
+        probes = [synthesize_probe(stream_tiles, seed=s, num_points=60,
+                                   gps_sigma=3.0) for s in range(6)]
+        queue.append_many(_records(probes))
+        for _ in range(8):
+            a.step()
+            b.step()
+        a.drain()
+        b.drain()
+        # every record consumed by exactly one worker
+        for p in range(4):
+            owner = a if p in a.partitions else b
+            assert owner.committed[p] == queue.end_offset(p)
+        assert published  # reports flowed to the datastore
+
+    def test_rebalance_replays_dead_workers_tail(self, stream_tiles,
+                                                 tmp_path):
+        a, b, queue, published, clock = self._two_workers(stream_tiles)
+        probes = [synthesize_probe(stream_tiles, seed=10 + s, num_points=80,
+                                   gps_sigma=3.0) for s in range(4)]
+        recs = _records(probes)
+        queue.append_many(recs[:len(recs) // 2])
+        a.step()
+        b.step()
+        ckpt = str(tmp_path / "a.npz")
+        a.checkpoint(ckpt)        # a "dies" here; b's partitions unaffected
+        queue.append_many(recs[len(recs) // 2:])
+
+        # rebalance: a fresh pipeline adopts a's partitions from checkpoint
+        a2 = StreamPipeline(stream_tiles, a.config, queue=queue,
+                            transport=a.app.publisher._transport,
+                            clock=clock, partitions=[0, 1])
+        a2.restore(ckpt)
+        for _ in range(8):
+            a2.step()
+            b.step()
+        a2.drain()
+        b.drain()
+        for p in (0, 1):
+            assert a2.committed[p] == queue.end_offset(p)
+        for p in (2, 3):
+            assert b.committed[p] == queue.end_offset(p)
+
+    def test_worker_threads(self, stream_tiles):
+        from reporter_tpu.streaming.worker import StreamWorker
+
+        a, b, queue, published, clock = self._two_workers(stream_tiles)
+        probes = [synthesize_probe(stream_tiles, seed=30 + s, num_points=60,
+                                   gps_sigma=3.0) for s in range(4)]
+        wa, wb = StreamWorker(a).start(), StreamWorker(b).start()
+        queue.append_many(_records(probes))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(pl.stats()["lag"] == 0 for pl in (a, b)):
+                break
+            time.sleep(0.05)
+        wa.stop()
+        wb.stop()
+        assert not wa.alive and not wb.alive
+        assert wa.errors == 0 and wb.errors == 0
+        for p in range(4):
+            owner = a if p in a.partitions else b
+            assert owner.committed[p] == queue.end_offset(p)
