@@ -122,6 +122,26 @@ def match_batch_wire(points, lengths, tables: dict[str, Any], meta: TileMeta,
     T = points.shape[1]
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
     out = match_traces(points, valid, tables, meta, params)
+    return _pack_wire(out)
+
+
+@functools.partial(jax.jit, static_argnames=("meta", "params"))
+def match_batch_wire_q(points_q, origins, lengths, tables: dict[str, Any],
+                       meta: TileMeta, params: MatcherParams):
+    """Quantized-input variant: points_q i16 [B, T, 2] are 0.25 m
+    fixed-point offsets from per-trace origins f32 [B, 2] (host→device
+    bytes halve vs f32; 0.125 m quantization ≪ sigma_z). Traces spanning
+    beyond ±8.19 km of their origin don't fit i16 — the host batcher
+    (matcher/api._decode_many) falls back to the f32 entry for those."""
+    T = points_q.shape[1]
+    points = origins[:, None, :] + points_q.astype(jnp.float32) * jnp.float32(
+        OFFSET_QUANTUM)
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
+    out = match_traces(points, valid, tables, meta, params)
+    return _pack_wire(out)
+
+
+def _pack_wire(out: MatchOutput):
     edge = jnp.maximum(out.edge, 0).astype(jnp.uint32)
     off_q = jnp.clip(jnp.round(out.offset / OFFSET_QUANTUM), 0, 65535)
     w0 = off_q.astype(jnp.uint16)
