@@ -124,7 +124,9 @@ class SegmentMatcher:
     # ---- batched API (the TPU throughput path) --------------------------
 
     def match_many(self, traces: Sequence[Trace]) -> list[list[SegmentRecord]]:
-        with self.metrics.stage("match"):
+        from reporter_tpu.utils.profiling import device_trace
+
+        with self.metrics.stage("match"), device_trace():
             if self.backend == "reference_cpu":
                 out = [self._match_cpu(t) for t in traces]
             else:

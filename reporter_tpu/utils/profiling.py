@@ -1,0 +1,45 @@
+"""Device profiling hooks (SURVEY.md §5 "Tracing / profiling").
+
+The reference has no tracing; Valhalla only has timing logs. The TPU
+build's device side is opaque without XLA-level traces, so this wraps
+jax.profiler with a uniform entry point:
+
+    from reporter_tpu.utils.profiling import device_trace
+    with device_trace("/tmp/xplane"):          # no-op when dir is falsy
+        matcher.match_many(traces)
+
+The dump is an XPlane/perfetto trace directory loadable in TensorBoard's
+profile plugin or ui.perfetto.dev. `REPORTER_TPU_TRACE_DIR` turns every
+`device_trace(None)` call site on without code changes — the service and
+stream workers wrap their match calls with it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: "str | None" = None) -> Iterator[None]:
+    """Context manager: capture a jax.profiler trace into ``trace_dir``.
+
+    Falsy ``trace_dir`` falls back to $REPORTER_TPU_TRACE_DIR; if that is
+    unset too, the context is a no-op (zero overhead in production).
+    """
+    target = trace_dir or os.environ.get("REPORTER_TPU_TRACE_DIR", "")
+    if not target:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(target):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-span inside a device_trace (TraceAnnotation wrapper)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
