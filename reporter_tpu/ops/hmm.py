@@ -273,24 +273,14 @@ def interpolation_keep_mask(points, valid_pt, interp_distance: float):
     return keep
 
 
-def viterbi_decode(cands: CandidateSet, points, valid_pt, tables,
-                   sigma_z: float, beta: float, max_route_factor: float,
-                   breakage_distance: float,
-                   backward_slack: float = 10.0,
-                   interpolation_distance: float = 0.0) -> ViterbiResult:
-    """Viterbi over the candidate lattice of ONE trace.
-
-    points: f32 [T, 2] (for gc distances); valid_pt: bool [T] padding mask.
-    Chain breakage: when consecutive points are farther apart than
-    ``breakage_distance`` or no transition is allowed, the chain restarts at
-    the new point, mirroring Meili's broken-path behavior. Inactive points
-    (padding, interpolated, or no candidate in radius) pass the carry
-    through untouched with identity backpointers, so chains connect across
-    them.
-    """
+def _forward_lattice(cands: CandidateSet, points, valid_pt, keep, tables,
+                     sigma_z: float, beta: float, max_route_factor: float,
+                     breakage_distance: float, backward_slack: float):
+    """Forward Viterbi pass of ONE trace → (scores [T,K], backptrs [T,K],
+    started [T], active [T]). Shared by viterbi_decode (best path) and
+    viterbi_topk_paths (K-best terminal completions)."""
     T, K = cands.edge.shape
     em = emission_costs(cands, sigma_z)                     # [T, K]
-    keep = interpolation_keep_mask(points, valid_pt, interpolation_distance)
     active = keep & jnp.any(cands.valid, axis=1)            # [T]
     identity_bp = jnp.arange(K, dtype=jnp.int32)
 
@@ -332,6 +322,29 @@ def viterbi_decode(cands: CandidateSet, points, valid_pt, tables,
             jnp.int32(0))
     xs = (em, points, active, jnp.arange(T, dtype=jnp.int32))
     _, (scores, backptrs, started) = jax.lax.scan(step, init, xs)
+    return scores, backptrs, started, active
+
+
+def viterbi_decode(cands: CandidateSet, points, valid_pt, tables,
+                   sigma_z: float, beta: float, max_route_factor: float,
+                   breakage_distance: float,
+                   backward_slack: float = 10.0,
+                   interpolation_distance: float = 0.0) -> ViterbiResult:
+    """Viterbi over the candidate lattice of ONE trace.
+
+    points: f32 [T, 2] (for gc distances); valid_pt: bool [T] padding mask.
+    Chain breakage: when consecutive points are farther apart than
+    ``breakage_distance`` or no transition is allowed, the chain restarts at
+    the new point, mirroring Meili's broken-path behavior. Inactive points
+    (padding, interpolated, or no candidate in radius) pass the carry
+    through untouched with identity backpointers, so chains connect across
+    them.
+    """
+    T, K = cands.edge.shape
+    keep = interpolation_keep_mask(points, valid_pt, interpolation_distance)
+    scores, backptrs, started, active = _forward_lattice(
+        cands, points, valid_pt, keep, tables, sigma_z, beta,
+        max_route_factor, breakage_distance, backward_slack)
 
     # ---- backtrack (reverse scan) ---------------------------------------
     # carry = (slot chosen at the level just above, propagated down through
@@ -389,3 +402,62 @@ def viterbi_decode(cands: CandidateSet, points, valid_pt, tables,
         chain_start=started,
         matched=matched,
     )
+
+
+def viterbi_topk_paths(cands: CandidateSet, points, valid_pt, tables,
+                       sigma_z: float, beta: float, max_route_factor: float,
+                       breakage_distance: float,
+                       backward_slack: float = 10.0,
+                       interpolation_distance: float = 0.0):
+    """K-best path interpretations of ONE trace (Meili's TopKSearch analog,
+    SURVEY.md §2.2 HMM row).
+
+    Ranks the final chain's K terminal candidates by accumulated cost and
+    backtracks each one; earlier chains keep their best path. (Meili
+    enumerates alternates by penalized re-search over the whole lattice;
+    terminal completion is the standard single-pass K-best Viterbi
+    approximation — alternates differ in the suffix, which for map matching
+    is where the ambiguity that TopK serves lives: parallel roads at the
+    trace's end.)
+
+    Returns (choice [K, T] i32 candidate slots (-1 unmatched), score [K]
+    f32 accumulated cost, valid [K] bool), ranked best-first.
+    """
+    T, K = cands.edge.shape
+    keep = interpolation_keep_mask(points, valid_pt, interpolation_distance)
+    scores, backptrs, started, active = _forward_lattice(
+        cands, points, valid_pt, keep, tables, sigma_z, beta,
+        max_route_factor, breakage_distance, backward_slack)
+
+    final = scores[-1]                                   # [K]
+    order = jnp.argsort(final).astype(jnp.int32)         # best-first slots
+    rank_score = final[order]
+    rank_valid = rank_score < BIG
+
+    def back_one(slot):
+        # Same reverse scan as viterbi_decode, but the level above T-1 is
+        # pinned to `slot`: bp row of all-slot + non-terminal carry makes
+        # the last level choose `slot`, propagated down through inactive
+        # levels by the identity backpointers.
+        def back(carry, inp):
+            nxt_choice, nxt_started = carry
+            score_t, bp_next, act_t, started_t = inp
+            prop = jnp.where(nxt_choice >= 0,
+                             bp_next[jnp.maximum(nxt_choice, 0)], -1)
+            own = jnp.argmin(score_t).astype(jnp.int32)
+            own = jnp.where(score_t[own] < BIG, own, -1)
+            terminal = nxt_started | (nxt_choice < 0)
+            choice_t = jnp.where(terminal, own, prop)
+            out = jnp.where(act_t, choice_t, -1)
+            return (choice_t, started_t), out
+
+        bp_above = jnp.concatenate(
+            [backptrs[1:], jnp.broadcast_to(slot, (1, K)).astype(jnp.int32)])
+        rev = (scores[::-1], bp_above[::-1], active[::-1], started[::-1])
+        _, choices_rev = jax.lax.scan(
+            back, (slot.astype(jnp.int32), jnp.bool_(False)), rev)
+        return choices_rev[::-1]
+
+    choices = jax.vmap(back_one)(order)                  # [K, T]
+    choices = jnp.where(rank_valid[:, None], choices, -1)
+    return choices, rank_score, rank_valid

@@ -163,6 +163,17 @@ class ReporterApp:
     def __call__(self, environ: dict, start_response: Callable):
         method = environ.get("REQUEST_METHOD", "GET")
         path = environ.get("PATH_INFO", "/")
+        if method == "POST":
+            t0 = time.perf_counter()
+            try:
+                return self._dispatch(environ, start_response, method, path)
+            finally:
+                self.matcher.metrics.observe(
+                    "request_seconds", time.perf_counter() - t0)
+        return self._dispatch(environ, start_response, method, path)
+
+    def _dispatch(self, environ: dict, start_response: Callable,
+                  method: str, path: str):
         try:
             if path == "/health" and method == "GET":
                 return _respond(start_response, 200, self.health())

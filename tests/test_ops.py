@@ -266,3 +266,45 @@ class TestBatchedViterbi:
         for name, a, b in zip(ref._fields, ref, got):
             np.testing.assert_array_equal(
                 np.asarray(a), np.asarray(b), err_msg=name)
+
+
+class TestTopKPaths:
+    def test_best_path_matches_viterbi(self, tiny_tiles):
+        import jax.numpy as jnp
+
+        from reporter_tpu.config import MatcherParams
+        from reporter_tpu.netgen.traces import synthesize_probe
+        from reporter_tpu.ops.hmm import viterbi_decode, viterbi_topk_paths
+        from reporter_tpu.ops.candidates import find_candidates_trace
+
+        ts = tiny_tiles
+        tables = ts.device_tables()
+        params = MatcherParams()
+        p = synthesize_probe(ts, seed=8, num_points=40, gps_sigma=3.0)
+        pts = jnp.asarray(p.xy.astype(np.float32))
+        valid = jnp.ones(len(p.xy), bool)
+        cands = find_candidates_trace(pts, tables, ts.meta,
+                                      params.search_radius,
+                                      params.max_candidates)
+        args = (tables, params.sigma_z, params.beta,
+                params.max_route_distance_factor, params.breakage_distance,
+                params.backward_slack, params.interpolation_distance)
+        best = viterbi_decode(cands, pts, valid, *args)
+        choices, scores, ok = viterbi_topk_paths(cands, pts, valid, *args)
+
+        assert bool(ok[0])
+        np.testing.assert_array_equal(np.asarray(choices[0]),
+                                      np.asarray(best.choice))
+        s = np.asarray(scores)
+        v = np.asarray(ok)
+        # scores ascend over valid ranks; invalid ranks sort last
+        assert (np.diff(s[v]) >= -1e-5).all()
+        # every valid alternate's choices point at real candidates
+        cv = np.asarray(cands.valid)
+        for r in range(len(v)):
+            if not v[r]:
+                continue
+            ch = np.asarray(choices[r])
+            for t, c in enumerate(ch):
+                if c >= 0:
+                    assert cv[t, c], f"rank {r} t {t}"
