@@ -258,3 +258,55 @@ class TestReportBuilder:
         assert reports[0].next_segment_id == 2
         assert reports[1].next_segment_id is None
         assert reports[2].next_segment_id is None
+
+
+class TestRequestCombining:
+    def test_concurrent_requests_combine_and_stay_scoped(self, svc_tiles):
+        import threading
+
+        cfg = Config(matcher_backend="jax")
+        a = make_app(svc_tiles, cfg, transport=lambda u, b: 200)
+        n = 12
+        payloads = [_probe_payload(svc_tiles, seed=40 + i, num_points=40)
+                    for i in range(n)]
+        for i, p in enumerate(payloads):
+            p["uuid"] = f"veh-{i}"
+        solo_app = make_app(svc_tiles, cfg, transport=lambda u, b: 200)
+        expected = [solo_app.report_one(p) for p in payloads]
+
+        results: list = [None] * n
+        errors: list = []
+
+        def worker(i):
+            try:
+                results[i] = a.report_one(payloads[i])
+            except Exception as e:     # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors
+        for i in range(n):
+            got = [s["segment_id"] for s in results[i]["segments"]]
+            want = [s["segment_id"] for s in expected[i]["segments"]]
+            assert got == want, f"request {i}"
+        # at least some combining happened (n submissions, fewer batches)
+        assert a.stats["batched_submissions"] == n
+        assert 1 <= a.stats["batches"] <= n
+
+    def test_bad_payload_rejected_without_poisoning_batch(self, svc_tiles):
+        a = make_app(svc_tiles, Config(matcher_backend="jax"),
+                     transport=lambda u, b: 200)
+        import pytest as _pytest
+
+        from reporter_tpu.service.app import BadRequest
+
+        with _pytest.raises(BadRequest):
+            a.report_one({"uuid": "x", "trace": "nope"})
+        # service still healthy afterwards
+        ok = a.report_one(_probe_payload(svc_tiles, seed=77, num_points=30))
+        assert "segments" in ok
