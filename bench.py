@@ -51,10 +51,10 @@ def main() -> None:
     jax_matcher = SegmentMatcher(ts, Config(matcher_backend="jax"))
     jax_matcher.match_many(traces)                  # compile + stage HBM
                                                     # (full batch shape)
-    dt_jax = _time_best(lambda: jax_matcher.match_many(traces), repeats=3)
+    dt_jax = _time_best(lambda: jax_matcher.match_many(traces), repeats=5)
 
     # Device-decode-only throughput (the kernel itself, no host walk).
-    dt_decode = _time_best(lambda: jax_matcher._decode_many(traces), repeats=3)
+    dt_decode = _time_best(lambda: jax_matcher._decode_many(traces), repeats=5)
 
     cpu_matcher = SegmentMatcher(ts, Config(matcher_backend="reference_cpu"))
     dt_cpu = _time_best(lambda: cpu_matcher.match_many(traces[:n_cpu]),
