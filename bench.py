@@ -60,6 +60,19 @@ def main() -> None:
     dt_cpu = _time_best(lambda: cpu_matcher.match_many(traces[:n_cpu]),
                         repeats=1)
 
+    # Fidelity (BASELINE north star: <5% segment-ID disagreement vs the
+    # exact-Dijkstra CPU oracle, the in-repo Meili stand-in): per trace,
+    # 1 - |ids_jax ∩ ids_cpu| / max(|ids_jax|, |ids_cpu|), averaged.
+    rj = jax_matcher.match_many(traces[:n_cpu])
+    rc = cpu_matcher.match_many(traces[:n_cpu])
+    disagreements = []
+    for a, b in zip(rj, rc):
+        ia = {r.segment_id for r in a}
+        ib = {r.segment_id for r in b}
+        denom = max(len(ia), len(ib), 1)
+        disagreements.append(1.0 - len(ia & ib) / denom)
+    disagreement = sum(disagreements) / max(len(disagreements), 1)
+
     probes = n_traces * n_points
     jax_pps = probes / dt_jax
     cpu_pps = (n_cpu * n_points) / dt_cpu
@@ -73,6 +86,7 @@ def main() -> None:
             "device": str(jax.devices()[0]).split(":")[0],
             "decode_only_probes_per_sec": round(probes / dt_decode, 1),
             "cpu_reference_probes_per_sec": round(cpu_pps, 1),
+            "segment_id_disagreement_vs_cpu_ref": round(disagreement, 4),
             "batch_seconds": round(dt_jax, 3),
             "setup_seconds": round(time.perf_counter() - t_setup, 1),
             "tile_stats": ts.stats,
