@@ -33,6 +33,10 @@ def main() -> None:
     t_setup = time.perf_counter()
     import jax
 
+    from reporter_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from reporter_tpu.config import CompilerParams, Config
     from reporter_tpu.matcher.api import SegmentMatcher, Trace
     from reporter_tpu.netgen.synthetic import generate_city

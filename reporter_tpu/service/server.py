@@ -48,6 +48,9 @@ def main(argv: list[str] | None = None) -> None:
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from reporter_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     config = Config.load(args.config)
     if args.tiles:
         ts = TileSet.load(args.tiles)

@@ -1,0 +1,31 @@
+"""Persistent XLA compilation cache (service restarts / repeated benches).
+
+The matcher's jit programs take tens of seconds to compile for the big
+batch shapes; the cache turns warm restarts into sub-second loads. Opt-in
+per entry point (bench.py, service.server, __graft_entry__) rather than at
+import — a library shouldn't mutate global jax config on import.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(path: "str | None" = None) -> str:
+    """Point jax at a persistent compilation cache directory.
+
+    Priority: explicit ``path`` → $REPORTER_TPU_XLA_CACHE →
+    ~/.cache/reporter_tpu/xla. Set $REPORTER_TPU_XLA_CACHE=off to disable.
+    Safe to call before or after the backend initializes.
+    """
+    import jax
+
+    target = (path or os.environ.get("REPORTER_TPU_XLA_CACHE")
+              or os.path.join(os.path.expanduser("~"), ".cache",
+                              "reporter_tpu", "xla"))
+    if target.lower() == "off":
+        return ""
+    os.makedirs(target, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", target)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return target
