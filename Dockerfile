@@ -1,0 +1,31 @@
+# reporter_tpu service image (packaging parity with the reference's
+# Docker-on-Valhalla-base image, SURVEY.md §2.1 "Packaging / orchestration").
+#
+# The reference builds atop a Valhalla image and mounts pre-built tiles;
+# here the "native machinery" is jax[tpu] + the in-repo C++ kernels, which
+# build on first import (g++ via native/build.py). Compile tiles offline:
+#   python -m reporter_tpu.tiles build --osm region.osm.xml -o /data/tiles.npz
+# and mount /data, mirroring the reference's tile-volume workflow.
+#
+# NOTE: authored for deployment parity; this repository's CI environment has
+# no Docker daemon or network, so the image build is not exercised here.
+
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+# TPU hosts: jax[tpu]; CPU fallback works with plain jax.
+ARG JAX_EXTRA=tpu
+RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" numpy
+
+WORKDIR /app
+COPY reporter_tpu/ reporter_tpu/
+COPY README.md DISTRIBUTED.md ./
+
+ENV PYTHONPATH=/app \
+    DATASTORE_URL="" \
+    REPORTER_TPU_PORT=8002
+
+EXPOSE 8002
+CMD ["sh", "-c", "python -m reporter_tpu.service.server --tiles ${TILESET:-/data/tiles.npz} --port ${REPORTER_TPU_PORT}"]
