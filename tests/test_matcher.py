@@ -139,3 +139,35 @@ class TestTimes:
             assert t_lo <= r.end_time <= t_hi
             assert r.start_time >= last_end - 1.0  # drive order
             last_end = r.end_time
+
+
+class TestQuantizedInfeed:
+    def test_long_span_trace_falls_back_to_f32(self, short_seg_tiles):
+        """A trace spanning beyond i16 fixed-point range must take the f32
+        wire path and still decode correctly (same records as a nearby
+        normal trace run)."""
+        import numpy as np
+
+        from reporter_tpu.config import Config
+        from reporter_tpu.matcher.api import SegmentMatcher, Trace
+        from reporter_tpu.netgen.traces import synthesize_probe
+
+        ts = short_seg_tiles
+        m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+        p = synthesize_probe(ts, seed=3, num_points=50, gps_sigma=3.0)
+        normal = Trace(uuid="n", xy=p.xy.astype(np.float32), times=p.times)
+
+        # same geometry, but prepend a far-away point to blow the span past
+        # +/-8.19km from the trace origin (forces the f32 fallback for the
+        # whole slice)
+        far = np.concatenate([[p.xy[0] + 9000.0], p.xy]).astype(np.float32)
+        times = np.concatenate([[p.times[0] - 1000.0], p.times])
+        spanning = Trace(uuid="s", xy=far, times=times)
+
+        r_norm = m.match_many([normal])[0]
+        r_both = m.match_many([spanning, normal])
+        ids_solo = [r.segment_id for r in r_norm]
+        ids_in_batch = [r.segment_id for r in r_both[1]]
+        assert ids_solo == ids_in_batch
+        # the spanning trace's tail (the real geometry) still matches
+        assert [r.segment_id for r in r_both[0] if r.segment_id >= 0]
