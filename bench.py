@@ -39,8 +39,14 @@ def _cached_fleet(ts, n_traces: int, n_points: int):
     from reporter_tpu.matcher.api import Trace
     from reporter_tpu.netgen.traces import synthesize_fleet
 
+    # cache key includes a tileset content fingerprint + the synthesis
+    # seed, so geometry/compiler changes invalidate stale fleets
+    # (crc32, not hash(): python string hashing is per-process randomized)
+    import zlib
+
+    fp = f"{zlib.crc32(ts.edge_len.tobytes()) & 0xFFFFFFFF:08x}-s7"
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        f".bench_fleet_{ts.name}_{n_traces}x{n_points}.npz")
+                        f".bench_fleet_{ts.name}_{n_traces}x{n_points}_{fp}.npz")
     if os.path.exists(path):
         with np.load(path) as z:
             xy, times = z["xy"], z["times"]

@@ -120,21 +120,26 @@ class ReporterApp:
         with self._pending_lock:
             self._pending.append(sub)
 
-        while not sub.done.wait(timeout=0.005):
-            # Not served yet: try to become the leader (the previous leader
-            # may have exited between our enqueue and its final drain).
+        while not sub.done.is_set():
+            # Try to become the leader first (the uncontended path must not
+            # pay any wait); re-attempt after each timeout — the previous
+            # leader may have exited between our enqueue and its last drain.
             if self._lock.acquire(blocking=False):
                 try:
-                    self._drain_pending()
+                    self._drain_pending(until=sub)
                 finally:
                     self._lock.release()
+            else:
+                sub.done.wait(timeout=0.005)
         if sub.error is not None:
             raise sub.error
         return sub.results
 
-    def _drain_pending(self) -> None:
+    def _drain_pending(self, until: "_Submission | None" = None) -> None:
         """Leader: process everything queued, in arrival order, as one
-        combined batch per drain round. Runs under self._lock."""
+        combined batch per drain round. Runs under self._lock. Stops after
+        the round that completes ``until`` (waiters retake leadership), so
+        a leader's own response is never delayed by later arrivals."""
         while True:
             with self._pending_lock:
                 batch, self._pending = self._pending, []
@@ -154,6 +159,8 @@ class ReporterApp:
             self.stats["batched_submissions"] += len(batch)
             for s in batch:
                 s.done.set()
+            if until is not None and until.done.is_set():
+                return
 
     def _process_validated(self,
                            validated: "list[tuple[str, list[dict]]]",

@@ -45,6 +45,11 @@ class StreamWorker:
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         self._stop.set()
         self._thread.join(timeout)
+        if self._thread.is_alive():
+            # Still inside step() (e.g. a cold-compile batch): draining here
+            # would race the worker thread through the non-thread-safe
+            # pipeline. The loop will exit after the in-flight step.
+            return
         if drain:
             self.reports += self.pipeline.drain()
 

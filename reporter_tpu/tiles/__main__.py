@@ -56,9 +56,11 @@ def main(argv: list[str] | None = None) -> int:
     b.add_argument("--name", default=None, help="tileset name")
     _add_compiler_flags(b)
 
+    from reporter_tpu.netgen.synthetic import CITY_PRESETS
+
     s = sub.add_parser("synth", help="compile a synthetic city")
     s.add_argument("--city", default="sf",
-                   help="tiny|sf|nyc|la (netgen/synthetic.py)")
+                   help="|".join(CITY_PRESETS) + " (netgen/synthetic.py)")
     s.add_argument("--seed", type=int, default=0)
     _add_compiler_flags(s)
 
