@@ -109,6 +109,9 @@ class StreamingConfig:
     flush_max_age: float = 30.0    # seconds a buffer may age before forced flush
     speed_bins: tuple[float, ...] = (0., 2.5, 5., 7.5, 10., 12.5, 15., 17.5,
                                      20., 25., 30., 40.)  # m/s histogram edges
+    hist_flush_interval: float = 60.0  # seconds between per-segment speed
+                                       # histogram flushes to the datastore
+                                       # (0 = manual flush only)
 
 
 @dataclass(frozen=True)

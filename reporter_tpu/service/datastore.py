@@ -74,3 +74,16 @@ class DatastorePublisher:
                     status, len(reports))
         self.dropped += len(reports)
         return False
+
+    def publish_json(self, payload: dict) -> bool:
+        """POST an arbitrary JSON document (histogram flushes, config 5).
+        True on success or when publishing is disabled."""
+        if not self.url:
+            return True
+        self.requests += 1
+        try:
+            status = self._transport(self.url, json.dumps(payload).encode())
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            log.warning("datastore POST failed: %s", exc)
+            return False
+        return 200 <= status < 300

@@ -71,6 +71,10 @@ class StreamPipeline:
         self._buffers: dict[str, _Buffer] = {}
         self.hist = SpeedHistogram(len(tileset.osmlr_id), sc.speed_bins)
         self._row_of = {int(sid): i for i, sid in enumerate(tileset.osmlr_id)}
+        self._osmlr_ids = np.asarray(tileset.osmlr_id)
+        self._hist_flushed = self.hist.snapshot()   # delta-flush baseline
+        self._hist_flush_at = self.clock()
+        self.hist_flushes = 0
         self.steps = 0
         self.malformed = 0
 
@@ -95,6 +99,9 @@ class StreamPipeline:
                 or (b.points and now - b.born >= sc.flush_max_age)]
         n_reports = self._flush(ripe) if ripe else 0
         self._commit()
+        if (sc.hist_flush_interval > 0
+                and now - self._hist_flush_at >= sc.hist_flush_interval):
+            self.flush_histograms()
         self.steps += 1
         return n_reports
 
@@ -162,6 +169,33 @@ class StreamPipeline:
                 floor[p] = min(floor[p], off)
         self.committed = floor
 
+    def flush_histograms(self) -> int:
+        """Publish the per-segment speed-histogram DELTA since the last
+        flush (SURVEY.md §7.7 / BASELINE config 5: "online per-segment speed
+        histograms … periodic flush to datastore path"). Returns the number
+        of segments flushed. The baseline advances only on successful
+        publish, so a failed POST retries the same delta next interval."""
+        snap = self.hist.snapshot()
+        delta = snap - self._hist_flushed
+        rows = np.nonzero(delta.sum(axis=1))[0]
+        self._hist_flush_at = self.clock()
+        if not len(rows):
+            return 0
+        payload = {
+            "mode": self.config.service.mode,
+            "bin_edges_mps": list(self.config.streaming.speed_bins),
+            "histograms": [
+                {"segment_id": int(self._osmlr_ids[r]),
+                 "counts": delta[r].astype(int).tolist()}
+                for r in rows
+            ],
+        }
+        if self.app.publisher.publish_json(payload):
+            self._hist_flushed = snap
+            self.hist_flushes += 1
+            return int(len(rows))
+        return 0
+
     # ---- observability ---------------------------------------------------
 
     def stats(self) -> dict[str, Any]:
@@ -197,7 +231,8 @@ class StreamPipeline:
         np.savez_compressed(
             path,
             state=np.frombuffer(json.dumps(state).encode(), dtype=np.uint8),
-            hist=self.hist.snapshot())
+            hist=self.hist.snapshot(),
+            hist_flushed=self._hist_flushed)
 
     def restore(self, path: str) -> None:
         """Reset to a checkpoint; consumption resumes at the committed
@@ -209,6 +244,10 @@ class StreamPipeline:
         with np.load(path) as z:
             state = json.loads(bytes(z["state"]).decode())
             self.hist.load(z["hist"])
+            if "hist_flushed" in z.files:
+                self._hist_flushed = z["hist_flushed"]
+            else:   # older checkpoint: re-flush everything (at-least-once)
+                self._hist_flushed = np.zeros_like(self.hist.snapshot())
         self.committed = list(state["committed"])
         self._consumed = list(state["committed"])
         self._buffers = {}

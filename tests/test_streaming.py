@@ -364,3 +364,44 @@ class TestConsumerGroup:
         for p in range(4):
             owner = a if p in a.partitions else b
             assert owner.committed[p] == queue.end_offset(p)
+
+
+class TestHistogramFlush:
+    def test_periodic_delta_flush(self, stream_tiles):
+        published = []
+
+        def transport(url, body):
+            published.append(json.loads(body))
+            return 200
+
+        cfg = Config(service=ServiceConfig(datastore_url="http://ds.test/"),
+                     streaming=StreamingConfig(flush_min_points=16,
+                                               hist_flush_interval=100.0))
+        clock = FakeClock()
+        pipe = StreamPipeline(stream_tiles, cfg, transport=transport,
+                              clock=clock)
+        probes = [synthesize_probe(stream_tiles, seed=90 + s, num_points=120,
+                                   gps_sigma=3.0) for s in range(3)]
+        pipe.queue.append_many(_records(probes))
+        for _ in range(10):
+            pipe.step()
+        pipe.drain()
+        assert pipe.hist.snapshot().sum() > 0
+        before = pipe.hist_flushes
+
+        clock.now += 101.0
+        pipe.step()
+        assert pipe.hist_flushes == before + 1
+        hist_posts = [p for p in published if "histograms" in p]
+        assert hist_posts, "no histogram payload reached the datastore"
+        seg_ids = {h["segment_id"] for p in hist_posts
+                   for h in p["histograms"]}
+        assert seg_ids <= {int(s) for s in stream_tiles.osmlr_id}
+        total = sum(sum(h["counts"]) for p in hist_posts
+                    for h in p["histograms"])
+        assert total == int(pipe.hist.snapshot().sum())
+
+        # no new observations => next interval flushes nothing
+        clock.now += 101.0
+        pipe.step()
+        assert pipe.hist_flushes == before + 1
