@@ -1,0 +1,145 @@
+"""MetroRouter — one service over many metros (config 4's serving face).
+
+The reference deployment ran one reporter instance per region; the TPU
+build's analog keeps every metro's tile arrays resident in HBM at once
+(tens of MB each — see TileSet.hbm_bytes) behind one endpoint. Requests
+route to a metro by an explicit ``"metro"`` payload field or by locating
+the trace's first point inside a metro's (margin-dilated) lonlat bbox —
+the host-side probe→shard dispatch of SURVEY.md §2.3 "EP", single-chip
+flavor. Device-mesh sharding of metros lives in parallel/multimetro.py;
+this router is the HTTP tier that feeds it or (as here) per-metro matchers
+on one chip.
+
+Routes: /report, /report_many (adds per-result "metro"), /health, /stats —
+aggregated over metros.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Sequence
+
+import numpy as np
+
+from reporter_tpu.config import Config
+from reporter_tpu.geometry import xy_to_lonlat
+from reporter_tpu.service.app import (
+    BadRequest,
+    ReporterApp,
+    _read_json,
+    _respond,
+)
+from reporter_tpu.service.datastore import Transport
+from reporter_tpu.tiles.tileset import TileSet
+
+_MARGIN_M = 2000.0    # bbox dilation: probes just outside the grid still route
+
+
+class MetroRouter:
+    """WSGI app dispatching to per-metro ReporterApps."""
+
+    def __init__(self, tilesets: Sequence[TileSet],
+                 config: Config | None = None,
+                 transport: Transport | None = None):
+        if not tilesets:
+            raise ValueError("need at least one tileset")
+        names = [ts.name for ts in tilesets]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate metro names: {names}")
+        self.apps = {ts.name: ReporterApp(ts, config, transport=transport)
+                     for ts in tilesets}
+        self._bounds = {ts.name: self._lonlat_bounds(ts) for ts in tilesets}
+
+    @staticmethod
+    def _lonlat_bounds(ts: TileSet):
+        lo = ts.node_xy.min(axis=0) - _MARGIN_M
+        hi = ts.node_xy.max(axis=0) + _MARGIN_M
+        corners = xy_to_lonlat(np.array([lo, hi]),
+                               np.asarray(ts.meta.origin_lonlat))
+        return corners[0], corners[1]          # (lon_lo, lat_lo), (lon_hi, lat_hi)
+
+    # ---- routing ---------------------------------------------------------
+
+    def route(self, payload: dict) -> str:
+        """Metro name for one payload: explicit field, else point location."""
+        if not isinstance(payload, dict):
+            raise BadRequest("payload must be a JSON object")
+        metro = payload.get("metro")
+        if metro is not None:
+            if metro not in self.apps:
+                raise BadRequest(
+                    f"unknown metro {metro!r}; have {sorted(self.apps)}")
+            return str(metro)
+        pts = payload.get("trace")
+        if not isinstance(pts, list) or not pts or not isinstance(pts[0], dict):
+            raise BadRequest("missing or empty 'trace'")
+        try:
+            lon = float(pts[0]["lon"])
+            lat = float(pts[0]["lat"])
+        except (KeyError, TypeError, ValueError):
+            raise BadRequest("trace points need 'lat' and 'lon'")
+        for name, (lo, hi) in self._bounds.items():
+            if lo[0] <= lon <= hi[0] and lo[1] <= lat <= hi[1]:
+                return name
+        raise BadRequest(
+            f"point ({lat:.4f}, {lon:.4f}) is outside every metro "
+            f"({sorted(self.apps)})")
+
+    def report_one(self, payload: dict) -> dict:
+        metro = self.route(payload)
+        out = self.apps[metro].report_one(payload)
+        out["metro"] = metro
+        return out
+
+    def report_many(self, payloads: list) -> list:
+        routed = [self.route(p) for p in payloads]     # validate ALL first
+        by_metro: dict[str, list[int]] = {}
+        for i, m in enumerate(routed):
+            by_metro.setdefault(m, []).append(i)
+        results: list = [None] * len(payloads)
+        for m, idxs in by_metro.items():
+            outs = self.apps[m].report_many([payloads[i] for i in idxs])
+            for i, out in zip(idxs, outs):
+                out["metro"] = m
+                results[i] = out
+        return results
+
+    # ---- WSGI ------------------------------------------------------------
+
+    def health(self) -> dict:
+        return {
+            "status": "ok",
+            "metros": {n: a.health() for n, a in self.apps.items()},
+        }
+
+    def __call__(self, environ: dict, start_response: Callable):
+        method = environ.get("REQUEST_METHOD", "GET")
+        path = environ.get("PATH_INFO", "/")
+        try:
+            if path == "/health" and method == "GET":
+                return _respond(start_response, 200, self.health())
+            if path == "/stats" and method == "GET":
+                return _respond(start_response, 200, {
+                    n: a.matcher.metrics.snapshot()
+                    for n, a in self.apps.items()})
+            if path == "/report" and method == "POST":
+                return _respond(start_response, 200,
+                                self.report_one(_read_json(environ)))
+            if path == "/report_many" and method == "POST":
+                body = _read_json(environ)
+                traces = body.get("traces") if isinstance(body, dict) else None
+                if not isinstance(traces, list):
+                    raise BadRequest("payload must be {'traces': [...]}")
+                return _respond(start_response, 200,
+                                {"results": self.report_many(traces)})
+            if path in ("/report", "/report_many"):
+                return _respond(start_response, 405,
+                                {"error": f"{method} not allowed"})
+            return _respond(start_response, 404, {"error": "not found"})
+        except BadRequest as exc:
+            return _respond(start_response, 400, {"error": str(exc)})
+
+
+def make_router(tilesets: Sequence[TileSet], config: Config | None = None,
+                transport: Transport | None = None) -> MetroRouter:
+    return MetroRouter(tilesets, config, transport)

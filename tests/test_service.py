@@ -310,3 +310,59 @@ class TestRequestCombining:
         # service still healthy afterwards
         ok = a.report_one(_probe_payload(svc_tiles, seed=77, num_points=30))
         assert "segments" in ok
+
+
+class TestMetroRouter:
+    @pytest.fixture(scope="class")
+    def router(self):
+        from reporter_tpu.service.router import make_router
+
+        # two tiny metros at well-separated centers
+        a = compile_network(generate_city("tiny"),
+                            CompilerParams(reach_radius=500.0,
+                                           osmlr_max_length=200.0))
+        b_net = generate_city("nyc", nx=8, ny=8)
+        b = compile_network(b_net, CompilerParams(reach_radius=500.0,
+                                                  osmlr_max_length=200.0))
+        r = make_router([a, b], Config(matcher_backend="jax"),
+                        transport=lambda u, body: 200)
+        r.test_tiles = {"a": a, "b": b}
+        return r
+
+    def test_routes_by_location(self, router):
+        a, b = router.test_tiles["a"], router.test_tiles["b"]
+        pa = _probe_payload(a, seed=5)
+        pb = _probe_payload(b, seed=6)
+        out_a = router.report_one(pa)
+        out_b = router.report_one(pb)
+        assert out_a["metro"] == a.name
+        assert out_b["metro"] == b.name
+        assert out_a["segments"] or out_b["segments"]
+
+    def test_explicit_metro_field_and_batch(self, router):
+        a, b = router.test_tiles["a"], router.test_tiles["b"]
+        pa = _probe_payload(a, seed=7)
+        pa["metro"] = a.name
+        pb = _probe_payload(b, seed=8)
+        outs = router.report_many([pb, pa, pb])
+        assert [o["metro"] for o in outs] == [b.name, a.name, b.name]
+
+    def test_unroutable_and_unknown(self, router):
+        from reporter_tpu.service.app import BadRequest
+
+        with pytest.raises(BadRequest):
+            router.report_one({"uuid": "x", "trace": [
+                {"lat": -45.0, "lon": 100.0}]})
+        with pytest.raises(BadRequest):
+            router.report_one({"uuid": "x", "metro": "atlantis",
+                               "trace": [{"lat": 0, "lon": 0}]})
+
+    def test_wsgi_endpoints(self, router):
+        a = router.test_tiles["a"]
+        status, body = wsgi_call(router, "GET", "/health")
+        assert status == 200 and set(body["metros"]) == set(router.apps)
+        status, body = wsgi_call(router, "POST", "/report",
+                                 _probe_payload(a, seed=9))
+        assert status == 200 and body["metro"] == a.name
+        status, body = wsgi_call(router, "GET", "/stats")
+        assert status == 200 and set(body) == set(router.apps)
