@@ -1,0 +1,51 @@
+"""Golden-trace regression tests (SURVEY.md §4: "golden segment-ID tests
+per trace" — the reference's canned-fixture pattern).
+
+tests/fixtures/golden_traces.json pins exact OSMLR segment-ID sequences
+for fixed traces on the deterministic 'tiny' city. Any behavioral drift in
+candidate search, Viterbi, routing, or association shows up here first.
+Regenerate deliberately (see the fixture's generator note) only when a
+change is MEANT to alter matching behavior.
+"""
+
+import json
+import os
+
+import pytest
+
+from reporter_tpu.config import CompilerParams, Config
+from reporter_tpu.matcher.api import SegmentMatcher
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.tiles.compiler import compile_network
+
+_FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                         "golden_traces.json")
+
+
+def _load():
+    with open(_FIXTURES) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def golden_tiles():
+    fx = _load()[0]
+    return compile_network(generate_city(fx["city"]),
+                           CompilerParams(**fx["compiler"]))
+
+
+@pytest.mark.parametrize("fx", _load(), ids=lambda f: f["name"])
+def test_golden_segments_jax(golden_tiles, fx):
+    m = SegmentMatcher(golden_tiles, Config(matcher_backend="jax"))
+    res = m.match(fx["request"])
+    got = [s["segment_id"] for s in res["segments"]]
+    assert got == fx["expected_segment_ids"], fx["name"]
+    assert [s["way_ids"] for s in res["segments"]] == fx["expected_way_ids"]
+
+
+@pytest.mark.parametrize("fx", _load(), ids=lambda f: f["name"])
+def test_golden_segments_cpu_oracle(golden_tiles, fx):
+    m = SegmentMatcher(golden_tiles, Config(matcher_backend="reference_cpu"))
+    res = m.match(fx["request"])
+    got = [s["segment_id"] for s in res["segments"]]
+    assert got == fx["expected_segment_ids"], fx["name"]
