@@ -75,10 +75,11 @@ def main() -> None:
     from reporter_tpu.tiles.compiler import compile_network
 
     n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 16000
+    city = sys.argv[2] if len(sys.argv) > 2 else "sf"   # "bayarea" = config 3
     n_points = 120
     n_cpu = min(20, n_traces)
 
-    ts = compile_network(generate_city("sf"), CompilerParams())
+    ts = compile_network(generate_city(city), CompilerParams())
     traces = _cached_fleet(ts, n_traces, n_points)
 
     jax_matcher = SegmentMatcher(ts, Config(matcher_backend="jax"))
@@ -88,6 +89,12 @@ def main() -> None:
 
     # Device-decode-only throughput (the kernel itself, no host walk).
     dt_decode = _time_best(lambda: jax_matcher._decode_many(traces), repeats=5)
+
+    # p50 single-trace match latency (the north star's second metric; on a
+    # remote-attached chip this is link-RTT-bound, not compute-bound)
+    lat = sorted(_time_best(lambda: jax_matcher.match_many(traces[:1]),
+                            repeats=1) for _ in range(7))
+    p50_latency = lat[len(lat) // 2]
 
     cpu_matcher = SegmentMatcher(ts, Config(matcher_backend="reference_cpu"))
     dt_cpu = _time_best(lambda: cpu_matcher.match_many(traces[:n_cpu]),
@@ -118,6 +125,7 @@ def main() -> None:
             "config": f"{n_traces}x{n_points}pt traces, tile={ts.name}",
             "device": str(jax.devices()[0]).split(":")[0],
             "decode_only_probes_per_sec": round(probes / dt_decode, 1),
+            "p50_single_trace_latency_ms": round(p50_latency * 1e3, 2),
             "cpu_reference_probes_per_sec": round(cpu_pps, 1),
             "segment_id_disagreement_vs_cpu_ref": round(disagreement, 4),
             "batch_seconds": round(dt_jax, 3),
