@@ -23,3 +23,25 @@ Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
 """
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):  # lazy top-level API (avoids importing jax on
+    _api = {            # package import; heavy modules load on first use)
+        "Config": ("reporter_tpu.config", "Config"),
+        "CompilerParams": ("reporter_tpu.config", "CompilerParams"),
+        "MatcherParams": ("reporter_tpu.config", "MatcherParams"),
+        "SegmentMatcher": ("reporter_tpu.matcher.api", "SegmentMatcher"),
+        "Trace": ("reporter_tpu.matcher.api", "Trace"),
+        "TileSet": ("reporter_tpu.tiles.tileset", "TileSet"),
+        "compile_network": ("reporter_tpu.tiles.compiler", "compile_network"),
+        "generate_city": ("reporter_tpu.netgen.synthetic", "generate_city"),
+        "parse_osm_xml": ("reporter_tpu.netgen.osm_xml", "parse_osm_xml"),
+        "make_app": ("reporter_tpu.service.app", "make_app"),
+        "make_router": ("reporter_tpu.service.router", "make_router"),
+    }
+    if name in _api:
+        import importlib
+
+        mod, attr = _api[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'reporter_tpu' has no attribute {name!r}")
