@@ -173,7 +173,20 @@ class TileSet:
         plain dict pytree of jnp arrays (HBM-resident after first use)."""
         import jax.numpy as jnp
 
+        import logging
+
         from reporter_tpu.ops.dense_candidates import build_seg_pack
+
+        # The u16 result wire format carries offsets in 0.25 m fixed point
+        # (ops/match.py OFFSET_QUANTUM): edges longer than 16.4 km would
+        # clamp. Real road edges are far shorter (OSMLR chains target 1 km),
+        # so surface the anomaly instead of silently corrupting offsets.
+        max_len = float(self.edge_len.max()) if len(self.edge_len) else 0.0
+        if max_len > 16000.0:
+            logging.getLogger("reporter_tpu.tiles").warning(
+                "tileset %s has an edge of %.0f m — offsets beyond 16383 m "
+                "clamp in the u16 wire format; split such edges upstream",
+                self.name, max_len)
 
         # Two candidate-search layouts ride to HBM: cell_pack (grid backend —
         # one contiguous [8C] row-gather per point, see build_cell_pack) and
