@@ -174,6 +174,12 @@ class SegmentMatcher:
         by_bucket: dict[int, list[int]] = {}
         for w, (_, _, xy) in enumerate(work):
             by_bucket.setdefault(_bucket_len(len(xy)), []).append(w)
+        # Spatial sort within each bucket (Morton code of the first point):
+        # neighbouring traces share point-chunks in the flattened dense
+        # sweep, so co-locating them tightens chunk bboxes and lets the
+        # kernel's block culling skip more of the map.
+        for ws in by_bucket.values():
+            ws.sort(key=lambda w: _morton_key(work[w][2]))
         chunk = max(1, self.params.max_device_batch)
         sliced = [(b, ws[i:i + chunk])
                   for b, ws in sorted(by_bucket.items())
@@ -267,6 +273,23 @@ def _bucket_len(n: int) -> int:
         if n <= b:
             return b
     return _BUCKETS[-1]
+
+
+def _morton_key(xy: np.ndarray) -> int:
+    """Interleaved-bit key of a trace's first point at 64 m resolution
+    (biased positive so negative tile-local coordinates keep locality)."""
+    if not len(xy):
+        return 0
+
+    def spread(v: int) -> int:
+        s = 0
+        for i in range(16):
+            s |= ((v >> i) & 1) << (2 * i)
+        return s
+
+    x = (int(xy[0, 0] // 64) + 0x8000) & 0xFFFF
+    y = (int(xy[0, 1] // 64) + 0x8000) & 0xFFFF
+    return spread(x) | (spread(y) << 1)
 
 
 def _to_chains(pts: list[tuple[int, float, bool]], times: np.ndarray,
