@@ -140,3 +140,43 @@ def test_match_batch_dense_vs_grid(ts, tables):
     assert agree > 0.95, f"dense vs grid match agreement {agree:.3f}"
     np.testing.assert_array_equal(np.asarray(out_d.matched),
                                   np.asarray(out_g.matched))
+
+
+def test_pallas_kernel_interpret_parity():
+    """Run the actual pallas kernel through the interpreter (CPU) and
+    compare with the jnp sweep — guards kernel logic without TPU access.
+    Subprocess: _INTERPRET is read at module import."""
+    import os
+    import subprocess
+    import sys
+
+    script = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, jax.numpy as jnp
+from reporter_tpu.config import CompilerParams
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.ops.dense_candidates import find_candidates_dense, _dense_jnp
+from reporter_tpu.tiles.compiler import compile_network
+
+ts = compile_network(generate_city("tiny", seed=11), CompilerParams())
+t = ts.device_tables()
+fleet = synthesize_fleet(ts, 2, num_points=40, seed=5)
+pts = np.stack([p.xy for p in fleet]).astype(np.float32).reshape(-1, 2)
+pall = find_candidates_dense(jnp.asarray(pts), (t["seg_pack"], t["seg_bbox"]), 50.0, 8)
+e, o, d = _dense_jnp(jnp.asarray(pts), (t["seg_pack"], None), 50.0, 8)
+assert (np.asarray(pall.edge) == np.asarray(e)).all(), "edge mismatch"
+assert np.allclose(np.asarray(pall.dist), np.asarray(d), rtol=1e-5, atol=1e-2)
+print("INTERPRET_PARITY_OK")
+"""
+    env = dict(os.environ)
+    env["RTPU_PALLAS_INTERPRET"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert "INTERPRET_PARITY_OK" in proc.stdout, proc.stderr[-2000:]
