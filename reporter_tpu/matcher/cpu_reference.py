@@ -96,6 +96,23 @@ def viterbi_bound(gc: float, params: MatcherParams) -> float:
     return params.max_route_distance_factor * gc + 10.0 + 2000.0
 
 
+def _dijkstra_cached(ts: TileSet, edge: int, bound: float,
+                     cache: "dict[int, tuple[float, dict]]"):
+    """Per-trace memo for edge_dijkstra. Re-using a LARGER bound is exact:
+    the bound always exceeds the detour-rejection threshold by 2 km
+    (viterbi_bound), so any extra edges a larger search reaches carry
+    routes the explicit `route > factor*gc + 10` guard rejects anyway —
+    membership differences can never change an accepted transition."""
+    hit = cache.get(edge)
+    if hit is not None and hit[0] >= bound:
+        return hit[1]
+    # over-search by 2x so repeated slightly-growing bounds don't thrash
+    use = max(bound, 2.0 * hit[0] if hit else bound)
+    reached = edge_dijkstra(ts, edge, use)
+    cache[edge] = (use, reached)
+    return reached
+
+
 def route_between(ts: TileSet, e1: int, o1: float, e2: int, o2: float,
                   bound: float, backward_slack: float,
                   ) -> tuple[float, list[int]]:
@@ -140,6 +157,7 @@ def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
                 last = t
 
     # Forward pass over active points (those kept, with candidates).
+    dij_cache: dict[int, tuple[float, dict]] = {}   # per-trace Dijkstra memo
     act = [t for t in range(T) if keep[t] and cands[t]]
     if not act:
         return results
@@ -162,7 +180,7 @@ def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
             for j, cj in enumerate(cands[prev_t]):
                 if scores[prev_t][j] == INF:
                     continue
-                reached = edge_dijkstra(ts, cj.edge, bound)
+                reached = _dijkstra_cached(ts, cj.edge, bound, dij_cache)
                 for k, ck in enumerate(cands[t]):
                     if (cj.edge == ck.edge
                             and ck.offset >= cj.offset - params.backward_slack):
