@@ -1,0 +1,49 @@
+"""Regenerate golden_traces.json — run ONLY when a change is meant to
+alter matching behavior:  python tests/fixtures/regen.py"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from reporter_tpu.config import CompilerParams, Config          # noqa: E402
+from reporter_tpu.matcher.api import SegmentMatcher             # noqa: E402
+from reporter_tpu.netgen.synthetic import generate_city         # noqa: E402
+from reporter_tpu.netgen.traces import synthesize_probe         # noqa: E402
+from reporter_tpu.tiles.compiler import compile_network         # noqa: E402
+
+COMPILER = {"reach_radius": 500.0, "osmlr_max_length": 200.0}
+SEEDS = (11, 23, 37)
+
+
+def main() -> None:
+    ts = compile_network(generate_city("tiny"), CompilerParams(**COMPILER))
+    m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    fixtures = []
+    for seed in SEEDS:
+        p = synthesize_probe(ts, seed=seed, num_points=80, gps_sigma=3.0)
+        payload = p.to_report_json()
+        res = m.match(payload)
+        fixtures.append({
+            "name": f"tiny-seed{seed}",
+            "city": "tiny",
+            "compiler": COMPILER,
+            "request": payload,
+            "expected_segment_ids": [s["segment_id"]
+                                     for s in res["segments"]],
+            "expected_way_ids": [s["way_ids"] for s in res["segments"]],
+        })
+    out = os.path.join(os.path.dirname(__file__), "golden_traces.json")
+    with open(out, "w") as f:
+        json.dump(fixtures, f, indent=1)
+    print(f"wrote {out}: {[f['name'] for f in fixtures]}")
+
+
+if __name__ == "__main__":
+    main()
