@@ -141,6 +141,48 @@ class SegmentMatcher:
         return [MatchedPoint(int(e), float(o), bool(s))
                 for e, o, s in zip(*trip)]
 
+    def match_topk(self, trace: Trace,
+                   ) -> list[tuple[float, list[MatchedPoint]]]:
+        """K-best path interpretations of one trace (Meili TopKSearch
+        analog; see ops.hmm.viterbi_topk_paths for the exact semantics).
+        Returns (score, per-point matches) ranked best-first; jax backend
+        only. Diagnostic surface — the reporting pipeline uses the best
+        path."""
+        if self.backend != "jax":
+            raise NotImplementedError("match_topk requires the jax backend")
+        import jax.numpy as jnp
+
+        from reporter_tpu.ops.hmm import viterbi_topk_paths
+        from reporter_tpu.ops.match import batch_candidates
+
+        T = max(len(trace.xy), 1)
+        pts = np.zeros((1, _bucket_len(T), 2), np.float32)
+        pts[0, :len(trace.xy)] = trace.xy
+        valid = np.zeros((1, pts.shape[1]), bool)
+        valid[0, :len(trace.xy)] = True
+        pj, vj = jnp.asarray(pts), jnp.asarray(valid)
+        cands = batch_candidates(pj, vj, self._tables, self.ts.meta,
+                                 self.params)
+        p = self.params
+        trace_cands = type(cands)(*(x[0] for x in cands))
+        choices, scores, ok = viterbi_topk_paths(
+            trace_cands, pj[0], vj[0], self._tables, p.sigma_z, p.beta,
+            p.max_route_distance_factor, p.breakage_distance,
+            p.backward_slack, p.interpolation_distance)
+        ce = np.asarray(cands.edge[0])
+        co = np.asarray(cands.offset[0])
+        out = []
+        for r in range(choices.shape[0]):
+            if not bool(ok[r]):
+                continue
+            ch = np.asarray(choices[r])[:len(trace.xy)]
+            pts_r = [MatchedPoint(
+                int(ce[t, c]) if c >= 0 else -1,
+                float(co[t, c]) if c >= 0 else 0.0, False)
+                for t, c in enumerate(ch)]
+            out.append((float(scores[r]), pts_r))
+        return out
+
     # ---- internals -------------------------------------------------------
 
     def _match_cpu(self, trace: Trace) -> list[SegmentRecord]:

@@ -171,3 +171,28 @@ class TestQuantizedInfeed:
         assert ids_solo == ids_in_batch
         # the spanning trace's tail (the real geometry) still matches
         assert [r.segment_id for r in r_both[0] if r.segment_id >= 0]
+
+
+class TestMatchTopK:
+    def test_topk_best_matches_primary(self, short_seg_tiles):
+        import numpy as np
+
+        from reporter_tpu.config import Config
+        from reporter_tpu.matcher.api import SegmentMatcher, Trace
+        from reporter_tpu.netgen.traces import synthesize_probe
+
+        ts = short_seg_tiles
+        m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+        p = synthesize_probe(ts, seed=15, num_points=50, gps_sigma=3.0)
+        tr = Trace(uuid="k", xy=p.xy.astype(np.float32), times=p.times)
+
+        ranked = m.match_topk(tr)
+        assert ranked, "no valid alternates"
+        scores = [s for s, _ in ranked]
+        assert scores == sorted(scores)
+        best = {mp.edge for mp in ranked[0][1] if mp.edge >= 0}
+        primary = {mp.edge for mp in m.matched_points(tr) if mp.edge >= 0}
+        # primary decode adds interpolation fill and 0.25m offset wire
+        # quantization; topk reports raw lattice choices — the best
+        # alternate's edges must all appear in the primary decode
+        assert best <= primary
