@@ -29,9 +29,15 @@ class _QuietHandler(WSGIRequestHandler):
         logging.getLogger("reporter_tpu.http").info(fmt, *args)
 
 
-def serve(app: ReporterApp, host: str = "0.0.0.0", port: int | None = None):
-    """Serve forever (threaded). Returns the server for tests to shut down."""
-    port = app.config.service.port if port is None else port
+def serve(app, host: str = "0.0.0.0", port: int | None = None):
+    """Serve forever (threaded). Returns the server for tests to shut down.
+    ``app`` is a ReporterApp or a MetroRouter (any WSGI callable with a
+    ``config``-bearing app when port is omitted)."""
+    if port is None:
+        cfg = getattr(app, "config", None)
+        if cfg is None:          # MetroRouter: take any member app's config
+            cfg = next(iter(app.apps.values())).config
+        port = cfg.service.port
     server = make_server(host, port, app, server_class=ThreadedWSGIServer,
                          handler_class=_QuietHandler)
     return server
@@ -39,8 +45,9 @@ def serve(app: ReporterApp, host: str = "0.0.0.0", port: int | None = None):
 
 def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description="reporter_tpu report service")
-    ap.add_argument("--tiles", required=False,
-                    help="compiled TileSet .npz (default: synthetic 'sf')")
+    ap.add_argument("--tiles", nargs="*", default=None,
+                    help="compiled TileSet .npz path(s); several start the "
+                         "multi-metro router (default: synthetic 'sf')")
     ap.add_argument("--config", help="JSON config path")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int)
@@ -53,18 +60,24 @@ def main(argv: list[str] | None = None) -> None:
     enable_compilation_cache()
     config = Config.load(args.config)
     if args.tiles:
-        ts = TileSet.load(args.tiles)
+        tilesets = [TileSet.load(p) for p in args.tiles]
     else:
         from reporter_tpu.netgen.synthetic import generate_city
         from reporter_tpu.tiles.compiler import compile_network
 
         logging.info("no --tiles given; compiling synthetic 'sf'")
-        ts = compile_network(generate_city("sf"), config.compiler)
-    app = make_app(ts, config)
+        tilesets = [compile_network(generate_city("sf"), config.compiler)]
+
+    if len(tilesets) == 1:
+        app = make_app(tilesets[0], config)
+        desc = f"{tilesets[0].name} ({tilesets[0].num_edges} edges)"
+    else:
+        from reporter_tpu.service.router import make_router
+
+        app = make_router(tilesets, config)
+        desc = "router[" + ", ".join(ts.name for ts in tilesets) + "]"
     server = serve(app, args.host, args.port)
-    logging.info("serving %s (%d edges, backend=%s) on :%d",
-                 ts.name, ts.num_edges, app.matcher.backend,
-                 server.server_address[1])
+    logging.info("serving %s on :%d", desc, server.server_address[1])
     server.serve_forever()
 
 
