@@ -17,6 +17,7 @@ inserts the collectives.
 
 from reporter_tpu.parallel.mesh import make_mesh
 from reporter_tpu.parallel.dp import make_dp_matcher
+from reporter_tpu.parallel.sharded_candidates import make_sharded_matcher
 from reporter_tpu.parallel.multimetro import (
     MetroBatch,
     StackedTiles,
@@ -26,6 +27,7 @@ from reporter_tpu.parallel.multimetro import (
 )
 
 __all__ = [
+    "make_sharded_matcher",
     "make_mesh",
     "make_dp_matcher",
     "MetroBatch",

@@ -1,0 +1,157 @@
+"""Segment-sharded candidate search — the framework's TP analog.
+
+SURVEY.md §2.3 marks tensor parallelism "not needed; optional sharded kNN
+reduce over ICI if a metro's edge set exceeds one core's HBM". This is
+that option: the Morton-blocked segment table (seg_pack columns + their
+bboxes) is sharded over a mesh axis, every device sweeps its shard of the
+map against the FULL point batch, and the per-shard top-K candidate lists
+are all-gathered over ICI and merged with the same distinct-edge K-merge
+the dense kernel uses per block. Viterbi then runs data-parallel on the
+merged candidates (reach tables replicated — they are [E, M] and small
+relative to shape data).
+
+Segments of one edge may straddle a shard boundary; the merge dedupes by
+edge id keeping the closer projection, exactly as the in-kernel block
+merge does, so results match the unsharded sweep (up to distance ties).
+
+Collective traffic per batch: one all-gather of [shards, B·T, K] candidate
+triples over ICI — bytes ≈ shards × points × K × 12, tiny next to the
+sharded HBM win (each device holds 1/shards of the map).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from reporter_tpu.config import MatcherParams
+from reporter_tpu.ops.candidates import CandidateSet
+from reporter_tpu.ops.dense_candidates import (
+    _SBLK,
+    SegPack,
+    build_seg_pack,
+    find_candidates_dense,
+)
+from reporter_tpu.ops.hmm import viterbi_decode_batched
+from reporter_tpu.ops.match import MatchOutput
+from reporter_tpu.tiles.tileset import TileSet
+
+
+class ShardedTables(NamedTuple):
+    seg_pack: jnp.ndarray    # [8, S_pad] — sharded over columns
+    seg_bbox: jnp.ndarray    # [nblocks, 4] — sharded over rows
+    edge_len: jnp.ndarray    # replicated
+    reach_to: jnp.ndarray
+    reach_dist: jnp.ndarray
+
+
+def shard_tables(mesh: Mesh, ts: TileSet, axis: str = "tile",
+                 ) -> ShardedTables:
+    """Pad the segment table to shards × block multiples and device_put with
+    the column dimension sharded over ``axis``."""
+    n = mesh.shape[axis]
+    sp = build_seg_pack(ts.seg_a, ts.seg_b, ts.seg_edge, ts.seg_off,
+                        ts.seg_len)
+    spad = sp.pack.shape[1]
+    per = -(-spad // (n * _SBLK)) * _SBLK          # columns per shard
+    total = per * n
+    pack = np.full((sp.pack.shape[0], total), np.int32(-1).view(np.float32),
+                   np.float32)
+    pack[:, :spad] = sp.pack
+    bbox = np.full((total // _SBLK, 4), np.nan, np.float32)
+    bbox[:sp.bbox.shape[0]] = sp.bbox
+
+    return ShardedTables(
+        seg_pack=jax.device_put(jnp.asarray(pack),
+                                NamedSharding(mesh, P(None, axis))),
+        seg_bbox=jax.device_put(jnp.asarray(bbox),
+                                NamedSharding(mesh, P(axis))),
+        edge_len=jax.device_put(jnp.asarray(ts.edge_len),
+                                NamedSharding(mesh, P())),
+        reach_to=jax.device_put(jnp.asarray(ts.reach_to),
+                                NamedSharding(mesh, P())),
+        reach_dist=jax.device_put(jnp.asarray(ts.reach_dist),
+                                  NamedSharding(mesh, P())),
+    )
+
+
+def _merge_topk(edge, dist, off, k: int):
+    """Merge gathered per-shard K-lists: fields [shards, N, K] → [N, K].
+    Distinct-edge K-merge (same semantics as the dense kernel's block
+    merge): per pass pick the global min distance, drop every other entry
+    of that edge."""
+    s, n, kk = edge.shape
+    e = jnp.moveaxis(edge, 0, 1).reshape(n, s * kk)
+    d = jnp.moveaxis(dist, 0, 1).reshape(n, s * kk)
+    o = jnp.moveaxis(off, 0, 1).reshape(n, s * kk)
+    d = jnp.where(e >= 0, d, jnp.float32(1e30))
+
+    cols = jnp.arange(s * kk, dtype=jnp.int32)[None, :]
+    outs_e, outs_d, outs_o = [], [], []
+    for _ in range(k):
+        m = jnp.min(d, axis=1, keepdims=True)
+        pick = jnp.min(jnp.where(d == m, cols, s * kk), axis=1, keepdims=True)
+        sel = cols == pick
+        e_k = jnp.max(jnp.where(sel, e, -(2 ** 31 - 1)), axis=1)
+        o_k = jnp.max(jnp.where(sel, o, -jnp.float32(1e30)), axis=1)
+        ok = m[:, 0] < 1e30
+        outs_e.append(jnp.where(ok, e_k, -1))
+        outs_d.append(jnp.where(ok, m[:, 0], 1e30))
+        outs_o.append(jnp.where(ok, o_k, 0.0))
+        d = jnp.where((e == e_k[:, None]) & ok[:, None], 1e30, d)
+    return (jnp.stack(outs_e, 1), jnp.stack(outs_d, 1), jnp.stack(outs_o, 1))
+
+
+def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
+                         axis: str = "tile"):
+    """fn(points [B,T,2], valid [B,T]) → MatchOutput with the segment table
+    sharded over ``axis`` (map-capacity scaling) and the batch replicated
+    on that axis. Compose with batch sharding over the other mesh axes
+    externally if desired."""
+    tables = shard_tables(mesh, ts, axis)
+    radius, k = params.search_radius, params.max_candidates
+
+    def local(points, valid, seg_pack, seg_bbox, edge_len, reach_to,
+              reach_dist):
+        B, T = points.shape[:2]
+        flat = find_candidates_dense(
+            points.reshape(B * T, 2), (seg_pack, seg_bbox), radius, k,
+            valid=valid.reshape(B * T))
+        # all-gather each shard's K-list over ICI, then K-merge
+        ge = jax.lax.all_gather(flat.edge, axis)        # [shards, N, K]
+        gd = jax.lax.all_gather(flat.dist, axis)
+        go = jax.lax.all_gather(flat.offset, axis)
+        me, md, mo = _merge_topk(ge, gd, go, k)
+        cands = CandidateSet(edge=me.reshape(B, T, k),
+                             offset=mo.reshape(B, T, k),
+                             dist=md.reshape(B, T, k),
+                             valid=(me >= 0).reshape(B, T, k))
+        vit = viterbi_decode_batched(
+            cands, points, valid,
+            {"edge_len": edge_len, "reach_to": reach_to,
+             "reach_dist": reach_dist},
+            params.sigma_z, params.beta, params.max_route_distance_factor,
+            params.breakage_distance, params.backward_slack,
+            params.interpolation_distance)
+        return MatchOutput(edge=vit.edge, offset=vit.offset,
+                           chain_start=vit.chain_start, matched=vit.matched)
+
+    other = [a for a in mesh.axis_names if a != axis]
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(*other) if other else P(), P(*other) if other else P(),
+                  P(None, axis), P(axis), P(), P(), P()),
+        out_specs=P(*other) if other else P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(points, valid) -> MatchOutput:
+        return sharded(points, valid, tables.seg_pack, tables.seg_bbox,
+                       tables.edge_len, tables.reach_to, tables.reach_dist)
+
+    return step

@@ -162,3 +162,39 @@ class TestDispatch:
         with pytest.raises(KeyError):
             dispatch_traces(("a",), [("zz", np.ones((2, 2), np.float32))],
                             dp=1, bucket=8)
+
+
+class TestShardedCandidates:
+    """Segment-table sharding (the TP analog): results must match the
+    unsharded matcher up to distance ties."""
+
+    def test_sharded_matches_unsharded(self, tiny_tiles):
+        import jax
+        import jax.numpy as jnp
+
+        from reporter_tpu.config import MatcherParams
+        from reporter_tpu.netgen.traces import synthesize_fleet
+        from reporter_tpu.ops.match import match_batch
+        from reporter_tpu.parallel.mesh import make_mesh
+        from reporter_tpu.parallel.sharded_candidates import (
+            make_sharded_matcher,
+        )
+
+        ts = tiny_tiles
+        params = MatcherParams()
+        devices = jax.devices()[:8]
+        mesh = make_mesh(tile=4, dp=2, devices=devices)
+        step = make_sharded_matcher(mesh, ts, params, axis="tile")
+
+        fleet = synthesize_fleet(ts, 8, num_points=48, seed=12)
+        pts = np.stack([p.xy for p in fleet]).astype(np.float32)
+        valid = np.ones(pts.shape[:2], bool)
+
+        out_s = step(jnp.asarray(pts), jnp.asarray(valid))
+        out_u = match_batch(jnp.asarray(pts), jnp.asarray(valid),
+                            ts.device_tables(), ts.meta, params)
+
+        np.testing.assert_array_equal(np.asarray(out_s.matched),
+                                      np.asarray(out_u.matched))
+        agree = (np.asarray(out_s.edge) == np.asarray(out_u.edge)).mean()
+        assert agree > 0.95, f"sharded vs unsharded agreement {agree:.3f}"
