@@ -155,11 +155,14 @@ class SegmentMatcher:
         from reporter_tpu.ops.hmm import viterbi_topk_paths
         from reporter_tpu.ops.match import batch_candidates
 
-        T = max(len(trace.xy), 1)
+        # diagnostic surface: alternates are computed over the first
+        # max-bucket points (match_many chunks longer traces instead)
+        xy = trace.xy[:_BUCKETS[-1]]
+        T = max(len(xy), 1)
         pts = np.zeros((1, _bucket_len(T), 2), np.float32)
-        pts[0, :len(trace.xy)] = trace.xy
+        pts[0, :len(xy)] = xy
         valid = np.zeros((1, pts.shape[1]), bool)
-        valid[0, :len(trace.xy)] = True
+        valid[0, :len(xy)] = True
         pj, vj = jnp.asarray(pts), jnp.asarray(valid)
         cands = batch_candidates(pj, vj, self._tables, self.ts.meta,
                                  self.params)
@@ -175,7 +178,7 @@ class SegmentMatcher:
         for r in range(choices.shape[0]):
             if not bool(ok[r]):
                 continue
-            ch = np.asarray(choices[r])[:len(trace.xy)]
+            ch = np.asarray(choices[r])[:len(xy)]
             pts_r = [MatchedPoint(
                 int(ce[t, c]) if c >= 0 else -1,
                 float(co[t, c]) if c >= 0 else 0.0, False)
@@ -319,19 +322,16 @@ def _bucket_len(n: int) -> int:
 
 def _morton_key(xy: np.ndarray) -> int:
     """Interleaved-bit key of a trace's first point at 64 m resolution
-    (biased positive so negative tile-local coordinates keep locality)."""
+    (biased positive so negative tile-local coordinates keep locality).
+    Same curve as the device-side segment blocking (ops.dense_candidates
+    ._morton) so host trace sorting matches the layout it exploits."""
     if not len(xy):
         return 0
+    from reporter_tpu.ops.dense_candidates import _morton
 
-    def spread(v: int) -> int:
-        s = 0
-        for i in range(16):
-            s |= ((v >> i) & 1) << (2 * i)
-        return s
-
-    x = (int(xy[0, 0] // 64) + 0x8000) & 0xFFFF
-    y = (int(xy[0, 1] // 64) + 0x8000) & 0xFFFF
-    return spread(x) | (spread(y) << 1)
+    x = np.asarray([(int(xy[0, 0] // 64) + 0x8000) & 0xFFFF], np.uint32)
+    y = np.asarray([(int(xy[0, 1] // 64) + 0x8000) & 0xFFFF], np.uint32)
+    return int(_morton(x, y)[0])
 
 
 def _to_chains(pts: list[tuple[int, float, bool]], times: np.ndarray,

@@ -17,6 +17,7 @@ aggregated over metros.
 from __future__ import annotations
 
 import json
+import logging
 from typing import Callable, Sequence
 
 import numpy as np
@@ -49,6 +50,13 @@ class MetroRouter:
         self.apps = {ts.name: ReporterApp(ts, config, transport=transport)
                      for ts in tilesets}
         self._bounds = {ts.name: self._lonlat_bounds(ts) for ts in tilesets}
+        # overlapping/nested metros: route to the SMALLEST containing bbox
+        # (most specific), not list order — deterministic regardless of
+        # --tiles argument ordering
+        self._by_area = sorted(
+            self._bounds.items(),
+            key=lambda kv: ((kv[1][1][0] - kv[1][0][0])
+                            * (kv[1][1][1] - kv[1][0][1])))
 
     @staticmethod
     def _lonlat_bounds(ts: TileSet):
@@ -78,7 +86,7 @@ class MetroRouter:
             lat = float(pts[0]["lat"])
         except (KeyError, TypeError, ValueError):
             raise BadRequest("trace points need 'lat' and 'lon'")
-        for name, (lo, hi) in self._bounds.items():
+        for name, (lo, hi) in self._by_area:
             if lo[0] <= lon <= hi[0] and lo[1] <= lat <= hi[1]:
                 return name
         raise BadRequest(
@@ -138,6 +146,10 @@ class MetroRouter:
             return _respond(start_response, 404, {"error": "not found"})
         except BadRequest as exc:
             return _respond(start_response, 400, {"error": str(exc)})
+        except Exception:                                 # pragma: no cover
+            logging.getLogger("reporter_tpu.router").exception(
+                "unhandled error serving %s %s", method, path)
+            return _respond(start_response, 500, {"error": "internal error"})
 
 
 def make_router(tilesets: Sequence[TileSet], config: Config | None = None,
