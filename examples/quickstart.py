@@ -27,7 +27,8 @@ from reporter_tpu.netgen.traces import synthesize_fleet  # noqa: E402
 
 def main() -> None:
     # 1. offline tile pipeline: road network → device-ready arrays
-    ts = compile_network(generate_city("tiny"), CompilerParams())
+    ts = compile_network(generate_city("tiny"),
+                         CompilerParams(osmlr_max_length=200.0))
     print(f"tileset '{ts.name}': {ts.num_edges} edges, "
           f"{len(ts.osmlr_id)} OSMLR segments, "
           f"{ts.hbm_bytes() / 1e6:.1f} MB of arrays")

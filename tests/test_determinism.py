@@ -84,3 +84,30 @@ def test_cli_synth_info_build(tmp_path):
 
     ts = TileSet.load(str(out2))
     assert ts.num_edges == 4  # one residential two-way chain
+
+
+def test_utils_surfaces(tmp_path, monkeypatch):
+    """compile-cache + profiling hooks: side-effect-light smoke coverage."""
+    import jax
+
+    from reporter_tpu.utils.compile_cache import enable_compilation_cache
+    from reporter_tpu.utils.profiling import device_trace
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        target = enable_compilation_cache(str(tmp_path / "xla"))
+        assert target and (tmp_path / "xla").is_dir()
+        assert enable_compilation_cache("off") == ""
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+    # no-op when unconfigured
+    monkeypatch.delenv("REPORTER_TPU_TRACE_DIR", raising=False)
+    with device_trace():
+        pass
+    # active when pointed at a directory
+    with device_trace(str(tmp_path / "trace")):
+        import jax.numpy as jnp
+
+        jnp.zeros(4).sum()
+    assert (tmp_path / "trace").exists()
