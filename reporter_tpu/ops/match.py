@@ -52,17 +52,23 @@ def batch_candidates(points, valid_pt, tables, meta,
       grid  — per-point cell-row gather, vmapped per trace.
     """
     B, T = points.shape[:2]
-    if params.candidate_backend == "dense":
+    backend = params.candidate_backend
+    if backend == "auto":
+        # trace-time resolution: the sweep wins ~50x on accelerators, the
+        # gather wins ~50x on CPU (XLA CPU gathers are cheap; an O(S)
+        # sweep per chunk is not)
+        backend = "grid" if jax.default_backend() == "cpu" else "dense"
+    if backend == "dense":
         flat = find_candidates_dense(
             points.reshape(B * T, 2),
             (tables["seg_pack"], tables["seg_bbox"]),
             params.search_radius, params.max_candidates,
             valid=valid_pt.reshape(B * T))
         return CandidateSet(*(x.reshape(B, T, -1) for x in flat))
-    if params.candidate_backend != "grid":
+    if backend != "grid":
         raise ValueError(
             f"unknown candidate_backend {params.candidate_backend!r}; "
-            "use 'dense' or 'grid'")
+            "use 'auto', 'dense' or 'grid'")
     _check_grid_coverage(params, meta)
     return jax.vmap(lambda p: find_candidates_trace(
         p, tables, meta, params.search_radius, params.max_candidates))(points)

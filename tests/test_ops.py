@@ -225,7 +225,14 @@ class TestInterpolationMask:
 
         recs = {}
         for backend in ("jax", "reference_cpu"):
-            m = SegmentMatcher(ts, Config(matcher_backend=backend))
+            # pin the dense candidate path: this test compares interpolation
+            # semantics across matcher backends, and grid-vs-dense tie
+            # ordering on CPU would add unrelated noise
+            from reporter_tpu.config import MatcherParams
+
+            m = SegmentMatcher(ts, Config(
+                matcher_backend=backend,
+                matcher=MatcherParams(candidate_backend="dense")))
             recs[backend] = m.match_many([tr])[0]
         ids_j = [r.segment_id for r in recs["jax"]]
         ids_c = [r.segment_id for r in recs["reference_cpu"]]
