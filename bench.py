@@ -104,8 +104,11 @@ def main() -> None:
     n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 16000
     city = sys.argv[2] if len(sys.argv) > 2 else "sf"   # "bayarea" = config 3
     if not tpu_ok:
-        n_traces = min(n_traces, 128)   # the jnp fallback sweep on one CPU
-                                        # core can't take the full batch
+        n_traces = min(n_traces, 128)   # keep the degraded-mode run short:
+                                        # even the grid gather path (auto's
+                                        # CPU pick, ~60k probes/s) plus the
+                                        # oracle pass should finish in well
+                                        # under a minute on one core
     n_points = 120
     n_cpu = min(20, n_traces)
 

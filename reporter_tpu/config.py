@@ -137,7 +137,12 @@ class Config:
                 f"unknown candidate_backend "
                 f"{self.matcher.candidate_backend!r}; "
                 "use 'auto', 'dense' or 'grid'")
-        if (self.matcher.candidate_backend in ("grid", "auto")
+        # Early error for explicitly-grid configs only: "auto" may resolve
+        # to dense (no coverage requirement), and the authoritative check
+        # against the ACTUAL tileset's index_radius happens at trace time
+        # (ops/match._check_grid_coverage) — this one guards the common
+        # case where one Config drives both compiler and matcher.
+        if (self.matcher.candidate_backend == "grid"
                 and self.compiler.index_radius < self.matcher.search_radius):
             raise ValueError(
                 f"compiler.index_radius ({self.compiler.index_radius}) must be "
