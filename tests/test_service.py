@@ -366,3 +366,25 @@ class TestMetroRouter:
         assert status == 200 and body["metro"] == a.name
         status, body = wsgi_call(router, "GET", "/stats")
         assert status == 200 and set(body) == set(router.apps)
+
+
+def test_router_nested_metros_route_most_specific():
+    """Overlapping/nested bboxes must route to the smallest containing
+    metro, independent of tileset list order."""
+    from reporter_tpu.service.router import make_router
+
+    # big: 16x16 city; small: 6x6 city at the same center → nested bboxes
+    big = compile_network(generate_city("tiny", nx=16, ny=16, seed=2),
+                          CompilerParams(reach_radius=400.0))
+    big.name = "big"
+    small = compile_network(generate_city("tiny", nx=6, ny=6, seed=3),
+                            CompilerParams(reach_radius=400.0))
+    small.name = "small"
+
+    probe = synthesize_probe(small, seed=4, num_points=20, gps_sigma=3.0)
+    payload = probe.to_report_json()
+
+    for order in ([big, small], [small, big]):
+        r = make_router(order, Config(matcher_backend="jax"),
+                        transport=lambda u, b: 200)
+        assert r.route(payload) == "small", [ts.name for ts in order]
