@@ -111,3 +111,33 @@ def test_utils_surfaces(tmp_path, monkeypatch):
 
         jnp.zeros(4).sum()
     assert (tmp_path / "trace").exists()
+
+
+def test_wire_format_roundtrip_random():
+    """u16 wire pack/unpack is lossless for edge ids (<2^29), flags, and
+    0.25m-quantized offsets across random MatchOutput values."""
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.match import (OFFSET_QUANTUM, MatchOutput,
+                                        _pack_wire, unpack_wire)
+
+    rng = np.random.default_rng(8)
+    B, T = 16, 64
+    edges = rng.integers(0, 2 ** 29 - 1, size=(B, T), dtype=np.int64)
+    matched = rng.random((B, T)) < 0.8
+    edges = np.where(matched, edges, -1).astype(np.int32)
+    offsets = (rng.integers(0, 65535, size=(B, T))
+               * OFFSET_QUANTUM).astype(np.float32)
+    offsets = np.where(matched, offsets, 0.0).astype(np.float32)
+    starts = rng.random((B, T)) < 0.2
+
+    wire = np.asarray(_pack_wire(MatchOutput(
+        edge=jnp.asarray(edges), offset=jnp.asarray(offsets),
+        chain_start=jnp.asarray(starts), matched=jnp.asarray(matched))))
+    assert wire.dtype == np.uint16 and wire.shape == (B, 3, T)
+
+    e2, o2, s2 = unpack_wire(wire)
+    np.testing.assert_array_equal(e2, edges)
+    np.testing.assert_allclose(o2, offsets, atol=1e-6)
+    # chain_start survives for all points (unmatched ones included)
+    np.testing.assert_array_equal(s2, starts)
