@@ -198,3 +198,64 @@ class TestShardedCandidates:
                                       np.asarray(out_u.matched))
         agree = (np.asarray(out_s.edge) == np.asarray(out_u.edge)).mean()
         assert agree > 0.95, f"sharded vs unsharded agreement {agree:.3f}"
+
+
+class TestDenseBackendSharded:
+    """The TPU-shaped path (dense sweep under shard_map) must stay green:
+    'auto' resolves to grid on the CPU test mesh, so pin dense explicitly."""
+
+    def test_dp_dense(self, tiny_tiles):
+        import jax
+        import jax.numpy as jnp
+
+        from reporter_tpu.config import MatcherParams
+        from reporter_tpu.netgen.traces import synthesize_fleet
+        from reporter_tpu.ops.match import match_batch
+        from reporter_tpu.parallel.dp import make_dp_matcher
+        from reporter_tpu.parallel.mesh import make_mesh
+
+        ts = tiny_tiles
+        params = MatcherParams(candidate_backend="dense")
+        mesh = make_mesh(tile=1, dp=8, devices=jax.devices()[:8])
+        step = make_dp_matcher(mesh, ts, params)
+
+        fleet = synthesize_fleet(ts, 8, num_points=32, seed=3)
+        pts = np.stack([p.xy for p in fleet]).astype(np.float32)
+        valid = np.ones(pts.shape[:2], bool)
+        out = step(jnp.asarray(pts), jnp.asarray(valid))
+        ref = match_batch(jnp.asarray(pts), jnp.asarray(valid),
+                          ts.device_tables(), ts.meta, params)
+        np.testing.assert_array_equal(np.asarray(out.edge),
+                                      np.asarray(ref.edge))
+
+    def test_multimetro_dense(self, tiny_tiles):
+        import jax
+        import jax.numpy as jnp
+
+        from reporter_tpu.config import CompilerParams, MatcherParams
+        from reporter_tpu.netgen.synthetic import generate_city
+        from reporter_tpu.netgen.traces import synthesize_fleet
+        from reporter_tpu.parallel.mesh import make_mesh
+        from reporter_tpu.parallel.multimetro import (
+            make_multimetro_matcher,
+            stack_tilesets,
+        )
+        from reporter_tpu.tiles.compiler import compile_network
+
+        cp = CompilerParams(reach_radius=400.0)
+        metros = [compile_network(generate_city("tiny", seed=30 + i), cp)
+                  for i in range(2)]
+        mesh = make_mesh(tile=2, dp=4, devices=jax.devices()[:8])
+        params = MatcherParams(candidate_backend="dense")
+        step = make_multimetro_matcher(mesh, stack_tilesets(metros), params)
+
+        B, T = 8, 16
+        points = np.zeros((2, B, T, 2), np.float32)
+        valid = np.zeros((2, B, T), bool)
+        for m, ts in enumerate(metros):
+            fleet = synthesize_fleet(ts, B, num_points=T, seed=m)
+            points[m] = np.stack([p.xy for p in fleet]).astype(np.float32)
+            valid[m] = True
+        out, hist = step(jnp.asarray(points), jnp.asarray(valid))
+        assert bool(np.asarray(out.matched).any())
+        assert int(np.asarray(hist).sum()) > 0
