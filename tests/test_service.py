@@ -388,3 +388,17 @@ def test_router_nested_metros_route_most_specific():
         r = make_router(order, Config(matcher_backend="jax"),
                         transport=lambda u, b: 200)
         assert r.route(payload) == "small", [ts.name for ts in order]
+
+
+def test_config_json_roundtrip_all_fields():
+    from reporter_tpu.config import (Config, MatcherParams, ServiceConfig,
+                                     StreamingConfig)
+
+    c = Config(
+        matcher=MatcherParams(candidate_backend="grid", search_radius=42.0,
+                              max_candidates=6),
+        service=ServiceConfig(datastore_url="http://x/", mode="bike"),
+        streaming=StreamingConfig(hist_flush_interval=7.0,
+                                  num_partitions=3),
+        matcher_backend="reference_cpu")
+    assert Config.from_json(c.to_json()) == c
