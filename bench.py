@@ -110,7 +110,9 @@ def main() -> None:
                                         # oracle pass should finish in well
                                         # under a minute on one core
     n_points = 120
-    n_cpu = min(20, n_traces)
+    # Oracle audit size: ≥200 traces (24k probes) — affordable because the
+    # CPU reference shares one bound-aware Dijkstra memo across traces.
+    n_cpu = min(200, n_traces)
 
     ts = compile_network(generate_city(city), CompilerParams())
     traces = _cached_fleet(ts, n_traces, n_points)
@@ -124,26 +126,31 @@ def main() -> None:
     dt_decode = _time_best(lambda: jax_matcher._decode_many(traces), repeats=5)
 
     # p50 single-trace match latency (the north star's second metric; on a
-    # remote-attached chip this is link-RTT-bound, not compute-bound)
+    # remote-attached chip this is link-RTT-bound, not compute-bound).
+    # Untimed warmup first: the B=1 executable was not compiled by the
+    # full-batch warmup above, and the first rep must not pay jit cost.
+    jax_matcher.match_many(traces[:1])
     lat = sorted(_time_best(lambda: jax_matcher.match_many(traces[:1]),
                             repeats=1) for _ in range(7))
     p50_latency = lat[len(lat) // 2]
 
+    # One timed CPU-oracle pass, reused for both the throughput anchor and
+    # the fidelity audit (BASELINE north star: <5% segment-ID disagreement
+    # vs the exact-Dijkstra CPU oracle, the in-repo Meili stand-in):
+    # per trace, 1 - |ids_jax ∩ ids_cpu| / max(|ids_jax|, |ids_cpu|), avg.
     cpu_matcher = SegmentMatcher(ts, Config(matcher_backend="reference_cpu"))
-    dt_cpu = _time_best(lambda: cpu_matcher.match_many(traces[:n_cpu]),
-                        repeats=1)
-
-    # Fidelity (BASELINE north star: <5% segment-ID disagreement vs the
-    # exact-Dijkstra CPU oracle, the in-repo Meili stand-in): per trace,
-    # 1 - |ids_jax ∩ ids_cpu| / max(|ids_jax|, |ids_cpu|), averaged.
-    rj = jax_matcher.match_many(traces[:n_cpu])
+    t0 = time.perf_counter()
     rc = cpu_matcher.match_many(traces[:n_cpu])
+    dt_cpu = time.perf_counter() - t0
+
+    rj = jax_matcher.match_many(traces[:n_cpu])
+    from collections import Counter
     disagreements = []
     for a, b in zip(rj, rc):
-        ia = {r.segment_id for r in a}
-        ib = {r.segment_id for r in b}
-        denom = max(len(ia), len(ib), 1)
-        disagreements.append(1.0 - len(ia & ib) / denom)
+        ia = Counter(r.segment_id for r in a)
+        ib = Counter(r.segment_id for r in b)
+        denom = max(sum(ia.values()), sum(ib.values()), 1)
+        disagreements.append(1.0 - sum((ia & ib).values()) / denom)
     disagreement = sum(disagreements) / max(len(disagreements), 1)
 
     probes = n_traces * n_points
@@ -161,6 +168,7 @@ def main() -> None:
             "decode_only_probes_per_sec": round(probes / dt_decode, 1),
             "p50_single_trace_latency_ms": round(p50_latency * 1e3, 2),
             "cpu_reference_probes_per_sec": round(cpu_pps, 1),
+            "oracle_sample_traces": n_cpu,
             "segment_id_disagreement_vs_cpu_ref": round(disagreement, 4),
             "batch_seconds": round(dt_jax, 3),
             "setup_seconds": round(time.perf_counter() - t_setup, 1),

@@ -65,11 +65,12 @@ class MatchedPoint:
     chain_start: bool
 
 
-def _dijkstra_route_fn(ts: TileSet, bound: float):
+def _dijkstra_route_fn(ts: TileSet, bound: float,
+                       cache: "cpu_reference.DijkstraCache"):
     def route(e1: int, e2: int):
         if e1 == e2:
             return []
-        reached = cpu_reference.edge_dijkstra(ts, e1, bound)
+        reached = cache.reached(ts, e1, bound)
         if e2 not in reached:
             return None
         return cpu_reference.walk_prev(reached, e2)
@@ -98,11 +99,15 @@ class SegmentMatcher:
             self._native_walker = make_native_walker(tileset)
         elif backend == "reference_cpu":
             self._tables = None
+            # One bound-aware Dijkstra memo shared by the Viterbi pass and
+            # segment-build routing, across every trace this matcher sees.
+            self._dij_cache = cpu_reference.DijkstraCache()
             # Segment-build routing must reach every transition the Viterbi
             # pass could have accepted, so reuse its worst-case bound.
             self._route_fn = _dijkstra_route_fn(
                 tileset, bound=cpu_reference.viterbi_bound(
-                    self.params.breakage_distance, self.params))
+                    self.params.breakage_distance, self.params),
+                cache=self._dij_cache)
         else:  # pragma: no cover - Config.validate rejects earlier
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
@@ -190,7 +195,7 @@ class SegmentMatcher:
 
     def _match_cpu(self, trace: Trace) -> list[SegmentRecord]:
         pts = cpu_reference.match_trace_cpu(self.ts, trace.xy.astype(np.float64),
-                                            self.params)
+                                            self.params, self._dij_cache)
         chains = _to_chains(pts, trace.times)
         return build_segments(self.ts, chains, self._route_fn,
                               self.params.backward_slack)

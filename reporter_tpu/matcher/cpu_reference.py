@@ -96,21 +96,40 @@ def viterbi_bound(gc: float, params: MatcherParams) -> float:
     return params.max_route_distance_factor * gc + 10.0 + 2000.0
 
 
-def _dijkstra_cached(ts: TileSet, edge: int, bound: float,
-                     cache: "dict[int, tuple[float, dict]]"):
-    """Per-trace memo for edge_dijkstra. Re-using a LARGER bound is exact:
-    the bound always exceeds the detour-rejection threshold by 2 km
-    (viterbi_bound), so any extra edges a larger search reaches carry
-    routes the explicit `route > factor*gc + 10` guard rejects anyway —
-    membership differences can never change an accepted transition."""
-    hit = cache.get(edge)
-    if hit is not None and hit[0] >= bound:
-        return hit[1]
-    # over-search by 2x so repeated slightly-growing bounds don't thrash
-    use = max(bound, 2.0 * hit[0] if hit else bound)
-    reached = edge_dijkstra(ts, edge, use)
-    cache[edge] = (use, reached)
-    return reached
+class DijkstraCache:
+    """Bound-aware memo for edge_dijkstra, shareable across traces.
+
+    Re-using a LARGER bound is exact: the bound always exceeds the
+    detour-rejection threshold by 2 km (viterbi_bound), so any extra edges a
+    larger search reaches carry routes the explicit
+    `route > factor*gc + 10` guard rejects anyway — membership differences
+    can never change an accepted transition. Sharing across traces is
+    therefore also exact (results depend only on the graph), and is what
+    makes 200-trace oracle audits affordable: fleets on one tile revisit
+    the same popular edges. Bounded: evicts the oldest half when full so a
+    metro-scale audit can't hoard GBs of reached-dicts.
+    """
+
+    def __init__(self, max_edges: int = 4096):
+        self._d: dict[int, tuple[float, dict]] = {}
+        self.max_edges = max_edges
+        self.searches = 0       # actual Dijkstra runs (observability)
+        self.hits = 0
+
+    def reached(self, ts: TileSet, edge: int, bound: float) -> dict:
+        hit = self._d.get(edge)
+        if hit is not None and hit[0] >= bound:
+            self.hits += 1
+            return hit[1]
+        # over-search by 2x so repeated slightly-growing bounds don't thrash
+        use = max(bound, 2.0 * hit[0] if hit else bound)
+        reached = edge_dijkstra(ts, edge, use)
+        self.searches += 1
+        if edge not in self._d and len(self._d) >= self.max_edges:
+            for k in list(self._d)[: self.max_edges // 2]:
+                del self._d[k]
+        self._d[edge] = (use, reached)
+        return reached
 
 
 def route_between(ts: TileSet, e1: int, o1: float, e2: int, o2: float,
@@ -128,10 +147,12 @@ def route_between(ts: TileSet, e1: int, o1: float, e2: int, o2: float,
 
 
 def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
+                    dij_cache: DijkstraCache | None = None,
                     ) -> list[tuple[int, float, bool]]:
     """Match one trace; returns per-point (edge, offset, chain_start),
     edge = -1 for unmatched points. One forward Viterbi pass with exact
-    routing, then one backpointer backtrack per chain."""
+    routing, then one backpointer backtrack per chain. ``dij_cache`` may be
+    shared across traces on the same tile (see DijkstraCache)."""
     T = len(xy)
     cands = [find_candidates_cpu(ts, xy[t], params) for t in range(T)]
     results: list[tuple[int, float, bool]] = [(-1, 0.0, False)] * T
@@ -157,7 +178,8 @@ def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
                 last = t
 
     # Forward pass over active points (those kept, with candidates).
-    dij_cache: dict[int, tuple[float, dict]] = {}   # per-trace Dijkstra memo
+    if dij_cache is None:
+        dij_cache = DijkstraCache()
     act = [t for t in range(T) if keep[t] and cands[t]]
     if not act:
         return results
@@ -180,7 +202,7 @@ def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
             for j, cj in enumerate(cands[prev_t]):
                 if scores[prev_t][j] == INF:
                     continue
-                reached = _dijkstra_cached(ts, cj.edge, bound, dij_cache)
+                reached = dij_cache.reached(ts, cj.edge, bound)
                 for k, ck in enumerate(cands[t]):
                     if (cj.edge == ck.edge
                             and ck.offset >= cj.offset - params.backward_slack):

@@ -46,6 +46,7 @@ class DatastorePublisher:
         self.published = 0          # reports successfully POSTed
         self.dropped = 0            # reports lost to transport errors
         self.requests = 0           # POST attempts
+        self.json_failures = 0      # failed publish_json POSTs (flushes)
 
     def publish(self, reports: list[Report]) -> bool:
         """POST one batch. True on success (or no-op); False on failure."""
@@ -85,5 +86,10 @@ class DatastorePublisher:
             status = self._transport(self.url, json.dumps(payload).encode())
         except (urllib.error.URLError, OSError, TimeoutError) as exc:
             log.warning("datastore POST failed: %s", exc)
+            self.json_failures += 1
             return False
-        return 200 <= status < 300
+        if 200 <= status < 300:
+            return True
+        log.warning("datastore POST returned %d", status)
+        self.json_failures += 1
+        return False

@@ -2,6 +2,8 @@
 inputs. The jax and CPU-oracle backends must stay within the BASELINE
 disagreement budget on every seed, and nothing may crash on garbage."""
 
+from collections import Counter
+
 import numpy as np
 import pytest
 
@@ -26,12 +28,15 @@ def test_random_city_backend_agreement(seed):
 
     agree = total = 0
     for a, b in zip(rj, rc):
-        ia = [r.segment_id for r in a]
-        ib = [r.segment_id for r in b]
-        total += max(len(ia), len(ib), 1)
-        # longest-common-prefix-free set agreement: count shared ids
-        agree += len(set(ia) & set(ib)) if ia or ib else 1
-    assert agree / total >= 0.8, f"seed {seed}: {agree}/{total}"
+        ia = Counter(r.segment_id for r in a)
+        ib = Counter(r.segment_id for r in b)
+        total += max(sum(ia.values()), sum(ib.values()), 1)
+        # multiset agreement: a legitimately re-traversed segment counts
+        # once per traversal on both sides (a set metric would punish it)
+        agree += sum((ia & ib).values()) if ia or ib else 1
+    # Gate at the BASELINE north-star budget (<5% disagreement), not a
+    # looser stand-in — a fidelity regression past the budget must fail CI.
+    assert agree / total >= 0.95, f"seed {seed}: {agree}/{total}"
 
 
 def test_degenerate_inputs_do_not_crash():

@@ -106,6 +106,25 @@ class TestEndpoints:
         assert {"id", "next_id", "t0", "t1", "length", "queue_length"} <= set(
             last["reports"][0])
 
+    def test_publish_json_failures_counted(self):
+        from reporter_tpu.service.datastore import DatastorePublisher
+
+        def bad_transport(url, body):
+            raise OSError("connection refused")
+
+        pub = DatastorePublisher(url="http://ds.test/",
+                                 transport=bad_transport)
+        assert pub.publish_json({"histograms": []}) is False
+        assert pub.json_failures == 1
+        pub2 = DatastorePublisher(url="http://ds.test/",
+                                  transport=lambda u, b: 503)
+        assert pub2.publish_json({"histograms": []}) is False
+        assert pub2.json_failures == 1
+        pub3 = DatastorePublisher(url="http://ds.test/",
+                                  transport=lambda u, b: 200)
+        assert pub3.publish_json({"histograms": []}) is True
+        assert pub3.json_failures == 0
+
     def test_next_segment_chaining(self, app, svc_tiles):
         payload = _probe_payload(svc_tiles, seed=13, num_points=200)
         _, body = wsgi_call(app, "POST", "/report", payload)
