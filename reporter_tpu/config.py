@@ -62,7 +62,14 @@ class CompilerParams:
                                    # bbox, so a query reads ONE cell row and still sees
                                    # every segment within search_radius <= index_radius
     reach_radius: float = 600.0    # reachability precompute radius (m)
-    reach_max: int = 32            # max reachable target edges kept per edge
+    reach_max: int = 128           # max reachable targets kept per NODE row.
+                                   # Node-keyed tables make a wide row cheap
+                                   # (~3× fewer rows than per-edge); 128
+                                   # keeps every audited transition at
+                                   # 5s-sparse urban sampling (see
+                                   # tiles/reach_audit.py; 32 truncated
+                                   # coverage to ~170 m and dropped ~2% of
+                                   # oracle-accepted transitions)
     osmlr_max_length: float = 1000.0  # OSMLR segment chaining target length (m)
     use_native: bool = True        # use the C++ reach/grid builder when available
 

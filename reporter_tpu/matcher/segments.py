@@ -73,15 +73,16 @@ def reach_route_fn(ts: TileSet) -> RouteFn:
         e = e1
         gap = np.inf
         while True:
-            row = ts.reach_to[e]
+            u = int(ts.edge_dst[e])     # reach rows are node-keyed
+            row = ts.reach_to[u]
             hit = np.nonzero(row == e2)[0]
             if not len(hit):
                 return None
-            new_gap = float(ts.reach_dist[e, hit[0]])
+            new_gap = float(ts.reach_dist[u, hit[0]])
             if new_gap >= gap:  # no progress ⇒ inconsistent tables; bail out
                 return None
             gap = new_gap
-            nxt = int(ts.reach_next[e, hit[0]])
+            nxt = int(ts.reach_next[u, hit[0]])
             if nxt == e2:
                 return chain
             if nxt < 0:

@@ -76,7 +76,7 @@ def load_native_lib() -> "ctypes.CDLL | None":
     lib.reporter_build_reach.restype = ctypes.c_int64
     lib.reporter_build_reach.argtypes = [
         i32p, ctypes.c_int64, ctypes.c_int64,        # node_out, N, deg
-        i32p, f32p, ctypes.c_int64,                  # edge_dst, edge_len, E
+        i32p, f32p,                                  # edge_dst, edge_len
         ctypes.c_double, ctypes.c_int32,             # radius, max_targets
         ctypes.c_int32,                              # n_threads
         i32p, f32p, i32p,                            # outputs
@@ -97,6 +97,7 @@ def load_native_lib() -> "ctypes.CDLL | None":
         ctypes.c_int64, ctypes.c_int64,              # B, T
         f32p, i64p, i32p, f32p,                      # edge_{len,way,osmlr,osmlr_off}
         i64p, f32p,                                  # osmlr_{id,len}
+        i32p,                                        # edge_dst (node-keyed reach)
         i32p, f32p, i32p, ctypes.c_int32,            # reach_{to,dist,next}, M
         ctypes.c_double, ctypes.c_int32,             # backward_slack, n_threads
         i32p, i64p, f64p, f64p, f64p, u8p,           # record columns

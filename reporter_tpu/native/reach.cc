@@ -92,21 +92,17 @@ void node_targets(int32_t u,
 
 extern "C" {
 
-// Outputs: reach_to/reach_next i32 [E, max_targets] (-1 pad),
-// reach_dist f32 [E, max_targets] (+inf pad). Returns the number of nodes
-// whose target list was truncated (parity with the Python builder).
+// Outputs: reach_to/reach_next i32 [N, max_targets] (-1 pad),
+// reach_dist f32 [N, max_targets] (+inf pad) — NODE-keyed (the row for
+// edge e is row edge_dst[e]; see tiles/reach.py). Outputs must arrive
+// pre-filled with the pad values. Returns the number of nodes whose
+// target list was truncated (parity with the Python builder).
 int64_t reporter_build_reach(const int32_t* node_out, int64_t num_nodes,
                              int64_t deg, const int32_t* edge_dst,
-                             const float* edge_len, int64_t num_edges,
+                             const float* edge_len,
                              double radius, int32_t max_targets,
                              int32_t n_threads, int32_t* reach_to,
                              float* reach_dist, int32_t* reach_next) {
-  // Per-node rows, then broadcast to incoming edges (dst-node lookup).
-  std::vector<int32_t> row_to(size_t(num_nodes) * max_targets, -1);
-  std::vector<float> row_dist(size_t(num_nodes) * max_targets,
-                              std::numeric_limits<float>::infinity());
-  std::vector<int32_t> row_next(size_t(num_nodes) * max_targets, -1);
-
   std::atomic<int64_t> truncated{0};
   std::atomic<int64_t> next_node{0};
   if (n_threads <= 0) {
@@ -135,9 +131,9 @@ int64_t reporter_build_reach(const int32_t* node_out, int64_t num_nodes,
         truncated.fetch_add(1);
         targets.resize(max_targets);
       }
-      int32_t* rt = row_to.data() + u * max_targets;
-      float* rd = row_dist.data() + u * max_targets;
-      int32_t* rn = row_next.data() + u * max_targets;
+      int32_t* rt = reach_to + u * max_targets;
+      float* rd = reach_dist + u * max_targets;
+      int32_t* rn = reach_next + u * max_targets;
       for (size_t k = 0; k < targets.size(); ++k) {
         rt[k] = targets[k].to;
         rd[k] = float(targets[k].dist);
@@ -149,16 +145,6 @@ int64_t reporter_build_reach(const int32_t* node_out, int64_t num_nodes,
   std::vector<std::thread> pool;
   for (int32_t t = 0; t < n_threads; ++t) pool.emplace_back(worker);
   for (auto& th : pool) th.join();
-
-  for (int64_t e = 0; e < num_edges; ++e) {
-    int64_t u = edge_dst[e];
-    std::copy_n(row_to.data() + u * max_targets, max_targets,
-                reach_to + e * max_targets);
-    std::copy_n(row_dist.data() + u * max_targets, max_targets,
-                reach_dist + e * max_targets);
-    std::copy_n(row_next.data() + u * max_targets, max_targets,
-                reach_next + e * max_targets);
-  }
   return truncated.load();
 }
 

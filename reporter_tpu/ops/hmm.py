@@ -43,13 +43,15 @@ def route_distance(e1, off1, e2, off2, tables, backward_slack: float = 10.0):
     within the reach radius.
     """
     edge_len = tables["edge_len"]
-    reach_to = tables["reach_to"]      # [E, M]
-    reach_dist = tables["reach_dist"]  # [E, M]
+    edge_dst = tables["edge_dst"]      # i32 [E] — reach rows are node-keyed
+    reach_to = tables["reach_to"]      # [N, M]
+    reach_dist = tables["reach_dist"]  # [N, M]
 
     e1s = jnp.maximum(e1, 0)
     e2s = jnp.maximum(e2, 0)
-    row_to = reach_to[e1s]             # [..., M]
-    row_d = reach_dist[e1s]
+    n1 = edge_dst[e1s]
+    row_to = reach_to[n1]              # [..., M]
+    row_d = reach_dist[n1]
     hit = row_to == e2s[..., None]
     gap = jnp.min(jnp.where(hit, row_d, BIG), axis=-1)
     cross = (edge_len[e1s] - off1) + gap + off2
@@ -137,6 +139,7 @@ def viterbi_decode_batched(cands: CandidateSet, points, valid_pt, tables,
     k_iota = jnp.arange(K, dtype=jnp.int32)
 
     edge_len = tables["edge_len"]
+    edge_dst = tables["edge_dst"]
     reach_to = tables["reach_to"]
     reach_dist = tables["reach_dist"]
 
@@ -144,8 +147,9 @@ def viterbi_decode_batched(cands: CandidateSet, points, valid_pt, tables,
         """[K, K, B] transition costs (mirror of transition_costs)."""
         e1 = jnp.maximum(pe, 0)                         # [K, B]
         e2 = jnp.maximum(e, 0)
-        rows_to = reach_to[e1]                          # [K, B, M]
-        rows_d = reach_dist[e1]
+        n1 = edge_dst[e1]                               # node-keyed reach rows
+        rows_to = reach_to[n1]                          # [K, B, M]
+        rows_d = reach_dist[n1]
         hit = rows_to[:, None] == e2[None, :, :, None]  # [K, K, B, M]
         gap = jnp.min(jnp.where(hit, rows_d[:, None], BIG), axis=-1)
         cross = (edge_len[e1] - po)[:, None] + gap + o[None, :]

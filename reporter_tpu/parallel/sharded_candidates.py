@@ -7,7 +7,7 @@ bboxes) is sharded over a mesh axis, every device sweeps its shard of the
 map against the FULL point batch, and the per-shard top-K candidate lists
 are all-gathered over ICI and merged with the same distinct-edge K-merge
 the dense kernel uses per block. Viterbi then runs data-parallel on the
-merged candidates (reach tables replicated — they are [E, M] and small
+merged candidates (reach tables replicated — node-keyed [N, M] and small
 relative to shape data).
 
 Segments of one edge may straddle a shard boundary; the merge dedupes by
@@ -45,6 +45,7 @@ class ShardedTables(NamedTuple):
     seg_pack: jnp.ndarray    # [8, S_pad] — sharded over columns
     seg_bbox: jnp.ndarray    # [nblocks, 4] — sharded over rows
     edge_len: jnp.ndarray    # replicated
+    edge_dst: jnp.ndarray    # replicated (reach rows are node-keyed)
     reach_to: jnp.ndarray
     reach_dist: jnp.ndarray
 
@@ -71,6 +72,8 @@ def shard_tables(mesh: Mesh, ts: TileSet, axis: str = "tile",
         seg_bbox=jax.device_put(jnp.asarray(bbox),
                                 NamedSharding(mesh, P(axis))),
         edge_len=jax.device_put(jnp.asarray(ts.edge_len),
+                                NamedSharding(mesh, P())),
+        edge_dst=jax.device_put(jnp.asarray(ts.edge_dst),
                                 NamedSharding(mesh, P())),
         reach_to=jax.device_put(jnp.asarray(ts.reach_to),
                                 NamedSharding(mesh, P())),
@@ -115,8 +118,8 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     tables = shard_tables(mesh, ts, axis)
     radius, k = params.search_radius, params.max_candidates
 
-    def local(points, valid, seg_pack, seg_bbox, edge_len, reach_to,
-              reach_dist):
+    def local(points, valid, seg_pack, seg_bbox, edge_len, edge_dst,
+              reach_to, reach_dist):
         B, T = points.shape[:2]
         flat = find_candidates_dense(
             points.reshape(B * T, 2), (seg_pack, seg_bbox), radius, k,
@@ -132,8 +135,8 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
                              valid=(me >= 0).reshape(B, T, k))
         vit = viterbi_decode_batched(
             cands, points, valid,
-            {"edge_len": edge_len, "reach_to": reach_to,
-             "reach_dist": reach_dist},
+            {"edge_len": edge_len, "edge_dst": edge_dst,
+             "reach_to": reach_to, "reach_dist": reach_dist},
             params.sigma_z, params.beta, params.max_route_distance_factor,
             params.breakage_distance, params.backward_slack,
             params.interpolation_distance)
@@ -144,7 +147,7 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     sharded = jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(*other) if other else P(), P(*other) if other else P(),
-                  P(None, axis), P(axis), P(), P(), P()),
+                  P(None, axis), P(axis), P(), P(), P(), P()),
         out_specs=P(*other) if other else P(),
         check_vma=False,
     )
@@ -152,6 +155,7 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     @jax.jit
     def step(points, valid) -> MatchOutput:
         return sharded(points, valid, tables.seg_pack, tables.seg_bbox,
-                       tables.edge_len, tables.reach_to, tables.reach_dist)
+                       tables.edge_len, tables.edge_dst,
+                       tables.reach_to, tables.reach_dist)
 
     return step

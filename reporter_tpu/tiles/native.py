@@ -32,18 +32,18 @@ def build_reach_native(node_out: np.ndarray, edge_src: np.ndarray,
     if lib is None:
         return None
     num_nodes, deg = node_out.shape
-    num_edges = len(edge_dst)
     node_out = _as_c(node_out, np.int32)
     edge_dst = _as_c(edge_dst, np.int32)
     edge_len = _as_c(edge_len, np.float32)
-    reach_to = np.full((num_edges, max_targets), -1, dtype=np.int32)
-    reach_dist = np.full((num_edges, max_targets), np.inf, dtype=np.float32)
-    reach_next = np.full((num_edges, max_targets), -1, dtype=np.int32)
+    # node-keyed rows (the row for edge e is row edge_dst[e])
+    reach_to = np.full((num_nodes, max_targets), -1, dtype=np.int32)
+    reach_dist = np.full((num_nodes, max_targets), np.inf, dtype=np.float32)
+    reach_next = np.full((num_nodes, max_targets), -1, dtype=np.int32)
     n_threads = int(os.environ.get("REPORTER_TPU_NATIVE_THREADS", "0"))
     truncated = lib.reporter_build_reach(
         _ptr(node_out, ctypes.c_int32), num_nodes, deg,
         _ptr(edge_dst, ctypes.c_int32), _ptr(edge_len, ctypes.c_float),
-        num_edges, float(radius), int(max_targets), n_threads,
+        float(radius), int(max_targets), n_threads,
         _ptr(reach_to, ctypes.c_int32), _ptr(reach_dist, ctypes.c_float),
         _ptr(reach_next, ctypes.c_int32))
     return reach_to, reach_dist, reach_next, int(truncated)

@@ -7,10 +7,16 @@ the reference's hot loop, §3.1). A data-dependent priority queue cannot run on
 the MXU, so we move the graph search OFFLINE: for every directed edge ``e``,
 precompute the network distance from the END of ``e`` to the START of every
 edge reachable within ``radius`` meters, keep the ``M`` nearest, and store
-them as fixed-shape [E, M] tables. At match time a transition cost is then a
-single gather + compare — exactly what the TPU is good at. ``reach_next``
+them as fixed-shape tables. At match time a transition cost is then a
+gather + compare — exactly what the TPU is good at. ``reach_next``
 (first edge of each path) lets the host reconstruct full paths after Viterbi
 by repeated next-hop lookup, replacing Meili's edge walk.
+
+Tables are keyed by NODE ([N, M]): every in-edge of a node shares one target
+row, so the row for edge ``e`` is ``reach_*[edge_dst[e]]`` (one extra tiny
+gather on device). Node-keying cuts the footprint ~E/N (≈3×) versus the
+per-edge broadcast, which is what makes a wide M (deep truncation coverage —
+see tiles/reach_audit.py) affordable at metro scale.
 
 A C++ builder (native/reach.cc) accelerates this for large metros; this module
 is the reference implementation and fallback.
@@ -63,23 +69,20 @@ def build_reach_tables(
     max_targets: int,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """Build (reach_to, reach_dist, reach_next, truncated_nodes); tables are
-    each [E, max_targets].
+    each [N, max_targets], keyed by node.
 
-    For edge e ending at node u, targets are out-edges e' of every node v with
+    For node u, targets are out-edges e' of every node v with
     d(u, v) <= radius; reach_dist = d(u, src(e')), reach_next = first edge of
-    the u→v path (or e' itself when v == u, i.e. e' directly follows e).
-    Rows are sorted by distance; -1/inf padded. One Dijkstra per *node*, shared
-    by all its incoming edges.
+    the u→v path (or e' itself when v == u, i.e. e' directly follows an
+    in-edge of u). Rows are sorted by distance; -1/inf padded. The row that
+    governs transitions out of edge e is row edge_dst[e].
     """
     num_nodes = len(node_out)
-    num_edges = len(edge_src)
-    reach_to = np.full((num_edges, max_targets), -1, dtype=np.int32)
-    reach_dist = np.full((num_edges, max_targets), np.inf, dtype=np.float32)
-    reach_next = np.full((num_edges, max_targets), -1, dtype=np.int32)
+    reach_to = np.full((num_nodes, max_targets), -1, dtype=np.int32)
+    reach_dist = np.full((num_nodes, max_targets), np.inf, dtype=np.float32)
+    reach_next = np.full((num_nodes, max_targets), -1, dtype=np.int32)
 
-    # Per-node target rows, computed once, then broadcast to incoming edges.
     truncated = 0
-    node_rows: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
     for u in range(num_nodes):
         reached = node_dijkstra(u, node_out, edge_dst, edge_len, radius)
         tos: list[int] = []
@@ -93,34 +96,23 @@ def build_reach_tables(
                 dists.append(d)
                 nexts.append(int(e2) if v == u else fe)
         if not tos:
-            node_rows.append(
-                (np.empty(0, np.int32), np.empty(0, np.float32), np.empty(0, np.int32))
-            )
             continue
         order = np.lexsort((np.asarray(tos), np.asarray(dists)))
         if len(order) > max_targets:
             truncated += 1
             order = order[:max_targets]
-        node_rows.append(
-            (
-                np.asarray(tos, np.int32)[order],
-                np.asarray(dists, np.float32)[order],
-                np.asarray(nexts, np.int32)[order],
-            )
-        )
-
-    for e in range(num_edges):
-        tos, dists, nexts = node_rows[int(edge_dst[e])]
-        k = len(tos)
-        reach_to[e, :k] = tos
-        reach_dist[e, :k] = dists
-        reach_next[e, :k] = nexts
+        k = len(order)
+        reach_to[u, :k] = np.asarray(tos, np.int32)[order]
+        reach_dist[u, :k] = np.asarray(dists, np.float32)[order]
+        reach_next[u, :k] = np.asarray(nexts, np.int32)[order]
 
     return reach_to, reach_dist, reach_next, truncated
 
 
-def reach_lookup(reach_to: np.ndarray, reach_dist: np.ndarray, e1: int, e2: int) -> float:
+def reach_lookup(reach_to: np.ndarray, reach_dist: np.ndarray,
+                 edge_dst: np.ndarray, e1: int, e2: int) -> float:
     """Network distance end-of-e1 → start-of-e2, inf if outside the table."""
-    row = reach_to[e1]
+    u = int(edge_dst[e1])
+    row = reach_to[u]
     hit = np.nonzero(row == e2)[0]
-    return float(reach_dist[e1, hit[0]]) if len(hit) else float(np.inf)
+    return float(reach_dist[u, hit[0]]) if len(hit) else float(np.inf)

@@ -24,9 +24,14 @@ segments, C grid cell capacity, M reach-table width):
   seg_off        f32 [S]     distance along edge at seg_a
   seg_len        f32 [S]     |seg_b - seg_a|
   grid           i32 [ncells,C]  line-segment ids per spatial cell, -1 padded
-  reach_to       i32 [E,M]   nearby reachable target edges, -1 padded
-  reach_dist     f32 [E,M]   network distance end-of-e → start-of-target (m)
-  reach_next     i32 [E,M]   first edge of that path (next-hop, for host walk)
+  reach_to       i32 [N,M]   nearby reachable target edges, -1 padded
+  reach_dist     f32 [N,M]   network distance node → start-of-target (m)
+  reach_next     i32 [N,M]   first edge of that path (next-hop, for host walk)
+
+Reach tables are node-keyed: the row governing transitions out of edge e is
+row edge_dst[e] (all in-edges of a node share targets), ~3× smaller than a
+per-edge broadcast — which pays for a wide M (tiles/reach_audit.py measures
+what truncation would cost).
 
 Device-side the grid + per-segment arrays are fused into ``cell_pack``
 (build_cell_pack below): one f32 [ncells, 8*C] row per cell holding every
@@ -143,7 +148,10 @@ class TileSet:
             path += ".npz"  # savez appends it; normalize so load(path) matches
         payload = {f: getattr(self, f) for f in _ARRAY_FIELDS}
         payload["_meta"] = np.frombuffer(
-            json.dumps({"name": self.name, "meta": list(self.meta), "stats": self.stats}).encode(),
+            json.dumps({"name": self.name, "meta": list(self.meta),
+                        "stats": self.stats,
+                        # schema 2: reach tables node-keyed [N, M]
+                        "schema": 2}).encode(),
             dtype=np.uint8,
         )
         np.savez_compressed(path, **payload)
@@ -162,6 +170,10 @@ class TileSet:
                 f"{path}: tileset metadata has {len(raw['meta'])} fields, "
                 f"expected {len(TileMeta._fields)} — written by an older tile "
                 "compiler; recompile the network with compile_network()")
+        if raw.get("schema", 1) != 2:
+            raise ValueError(
+                f"{path}: tileset schema {raw.get('schema', 1)} predates the "
+                "node-keyed reach tables; recompile with compile_network()")
         go, cs, gd, ol, ir = raw["meta"]
         meta = TileMeta(tuple(go), float(cs), tuple(gd), tuple(ol), float(ir))
         return cls(name=raw["name"], meta=meta, stats=raw.get("stats", {}), **arrays)
@@ -203,6 +215,7 @@ class TileSet:
             "seg_pack": jnp.asarray(sp.pack),
             "seg_bbox": jnp.asarray(sp.bbox),
             "edge_len": jnp.asarray(self.edge_len),
+            "edge_dst": jnp.asarray(self.edge_dst),
             "edge_osmlr": jnp.asarray(self.edge_osmlr),
             "reach_to": jnp.asarray(self.reach_to),
             "reach_dist": jnp.asarray(self.reach_dist),

@@ -1,0 +1,69 @@
+"""Reach-table truncation audit regression (VERDICT r1 weak item 2).
+
+The node-keyed [N, M] reach tables keep the M nearest targets per node; a
+too-small M silently rejects transitions the exact-Dijkstra oracle accepts
+(spurious chain breaks at sparse sampling). These tests pin both
+directions: with the default CompilerParams the measured miss rate is zero
+even at 5× subsampled traces, and the audit tool actually detects misses
+when the table is deliberately starved.
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import CompilerParams
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.tiles.compiler import compile_network
+from reporter_tpu.tiles.reach_audit import audit_reach, node_coverage_radii
+
+
+@pytest.fixture(scope="module")
+def audit_city():
+    return generate_city("tiny", seed=5, nx=8, ny=8)
+
+
+@pytest.fixture(scope="module")
+def audit_tiles(audit_city):
+    return compile_network(audit_city, CompilerParams())
+
+
+@pytest.fixture(scope="module")
+def audit_fleet(audit_tiles):
+    return [p.xy for p in synthesize_fleet(audit_tiles, 8, num_points=100,
+                                           seed=5)]
+
+
+def test_default_tables_miss_nothing_even_sparse(audit_tiles, audit_fleet):
+    """Default reach_max: zero oracle-accepted transitions rejected, at
+    native sampling and at 3× / 5× subsampling (larger gc ⇒ longer
+    accepted routes ⇒ the regime where truncation would bite)."""
+    for stride in (1, 3, 5):
+        audit = audit_reach(audit_tiles, [xy[::stride] for xy in audit_fleet])
+        assert audit.pairs_accepted_exact > 100, "audit exercised too little"
+        assert audit.pairs_missed == 0, (
+            f"stride {stride}: {audit.pairs_missed} transitions truncated "
+            f"away (gaps {audit.missed_gaps[:5]}...)")
+        assert audit.steps_missed == 0
+
+
+def test_starved_tables_are_detected(audit_city, audit_fleet):
+    """Sanity of the tool itself: an M far below the default must produce
+    measurable pair misses on subsampled traces (if it doesn't, the audit
+    is vacuous and the zero above proves nothing)."""
+    starved = compile_network(audit_city, CompilerParams(reach_max=4))
+    audit = audit_reach(starved, [xy[::5] for xy in audit_fleet])
+    assert audit.pairs_missed > 0
+    assert audit.pair_miss_rate > 0.01
+
+
+def test_coverage_radii_shape_and_truncation_stat(audit_tiles):
+    cov = node_coverage_radii(audit_tiles)
+    assert cov.shape == (audit_tiles.num_nodes,)
+    # not-full rows report +inf; full rows a finite radius > 0. Every
+    # truncated node's row is full (the converse needn't hold: a row can
+    # hold exactly M targets without anything having been cut).
+    finite = cov[np.isfinite(cov)]
+    assert (finite > 0).all()
+    assert (np.isfinite(cov).sum()
+            >= audit_tiles.stats["reach_truncated_nodes"])

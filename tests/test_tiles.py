@@ -86,18 +86,19 @@ def test_reach_tables_match_brute_dijkstra(tiny_tiles, rng):
         e1 = int(e1)
         u = int(ts.edge_dst[e1])
         reached = node_dijkstra(u, ts.node_out, ts.edge_dst, ts.edge_len, 500.0)
-        row = ts.reach_to[e1]
+        row = ts.reach_to[u]                # node-keyed rows
         # row distances must agree with brute node distances
         for slot, e2 in enumerate(row):
             if e2 < 0:
                 continue
             v = int(ts.edge_src[e2])
             assert v in reached
-            assert np.isclose(ts.reach_dist[e1, slot], reached[v][0], atol=1e-3)
+            assert np.isclose(ts.reach_dist[u, slot], reached[v][0], atol=1e-3)
         # adjacency (dist 0) always present
         for e2 in ts.node_out[u]:
             if e2 >= 0:
-                assert reach_lookup(ts.reach_to, ts.reach_dist, e1, int(e2)) == 0.0
+                assert reach_lookup(ts.reach_to, ts.reach_dist, ts.edge_dst,
+                                    e1, int(e2)) == 0.0
 
 
 def test_reach_next_hop_walk(tiny_tiles, rng):
@@ -106,17 +107,19 @@ def test_reach_next_hop_walk(tiny_tiles, rng):
     checked = 0
     for e1 in rng.integers(0, ts.num_edges, size=30):
         e1 = int(e1)
+        u1 = int(ts.edge_dst[e1])
         for slot in (1, 3, 7, 15):
-            if slot >= ts.reach_to.shape[1] or ts.reach_to[e1, slot] < 0:
+            if slot >= ts.reach_to.shape[1] or ts.reach_to[u1, slot] < 0:
                 continue
-            e2 = int(ts.reach_to[e1, slot])
-            want = float(ts.reach_dist[e1, slot])
+            e2 = int(ts.reach_to[u1, slot])
+            want = float(ts.reach_dist[u1, slot])
             cur, total, hops = e1, 0.0, 0
             while int(ts.edge_dst[cur]) != int(ts.edge_src[e2]) and hops < 64:
-                row = ts.reach_to[cur]
+                u = int(ts.edge_dst[cur])
+                row = ts.reach_to[u]
                 hit = np.nonzero(row == e2)[0]
                 assert len(hit), "intermediate edge lost the target"
-                nxt = int(ts.reach_next[cur, hit[0]])
+                nxt = int(ts.reach_next[u, hit[0]])
                 total += float(ts.edge_len[nxt])
                 cur = nxt
                 hops += 1
