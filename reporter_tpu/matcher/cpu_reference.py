@@ -146,6 +146,29 @@ def route_between(ts: TileSet, e1: int, o1: float, e2: int, o2: float,
     return dist, walk_prev(reached, e2)
 
 
+def interpolation_keep(xy: np.ndarray, interpolation_distance: float,
+                       ) -> list[bool]:
+    """Host mirror of ops.hmm's interpolation keep mask: points within
+    ``interpolation_distance`` of the last KEPT point do not vote in the
+    HMM. Shared by the oracle matcher and the reach audit so they can
+    never drift apart on which transitions exist."""
+    T = len(xy)
+    keep = [True] * T
+    if interpolation_distance <= 0.0 or not T:
+        return keep
+    last = None
+    for t in range(T):
+        if last is None:
+            last = t
+            continue
+        if (float(np.linalg.norm(xy[t] - xy[last]))
+                < interpolation_distance):
+            keep[t] = False
+        else:
+            last = t
+    return keep
+
+
 def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
                     dij_cache: DijkstraCache | None = None,
                     ) -> list[tuple[int, float, bool]]:
@@ -161,21 +184,7 @@ def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
     def emit(c: _Cand) -> float:
         return c.dist ** 2 / (2.0 * params.sigma_z ** 2)
 
-    # Input interpolation (mirror of ops.hmm.interpolation_keep_mask):
-    # points within interpolation_distance of the last kept point do not
-    # vote in the HMM.
-    keep = [True] * T
-    if params.interpolation_distance > 0.0 and T:
-        last = None
-        for t in range(T):
-            if last is None:
-                last = t
-                continue
-            if (float(np.linalg.norm(xy[t] - xy[last]))
-                    < params.interpolation_distance):
-                keep[t] = False
-            else:
-                last = t
+    keep = interpolation_keep(xy, params.interpolation_distance)
 
     # Forward pass over active points (those kept, with candidates).
     if dij_cache is None:

@@ -25,6 +25,10 @@ from reporter_tpu.tiles.tileset import TileSet
 # matched path, or None when e2 is unreachable (forces a path break).
 RouteFn = Callable[[int, int], "list[int] | None"]
 
+# Minimum observed span (m) for a record to exist: one wire offset quantum
+# (ops.match.OFFSET_QUANTUM). Must match kMinSpan in native/walker.cc.
+MIN_RECORD_SPAN = 0.25
+
 
 @dataclass
 class SegmentRecord:
@@ -184,7 +188,10 @@ def _path_to_records(ts: TileSet, path: list[int],
         d_lo, d_hi = float(cum[i]), float(cum[j + 1])
         # clip to the observed span: beyond it there is no time basis at all
         c_lo, c_hi = max(d_lo, observed_lo), min(d_hi, observed_hi)
-        if c_hi > c_lo + 1e-6:
+        # Spans below the wire offset quantum (0.25 m, ops/match.py) are not
+        # representable device-side and are pure float noise against 4 m GPS
+        # sigma; emitting them makes backends diverge on boundary slivers.
+        if c_hi > c_lo + MIN_RECORD_SPAN:
             way_ids: list[int] = []
             for e in path[i:j + 1]:
                 w = int(ts.edge_way[e])

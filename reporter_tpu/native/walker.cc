@@ -12,7 +12,7 @@
 //   - route expansion via reach_to/reach_dist/reach_next with the same
 //     first-hit / monotone-gap / next<0 bail-outs
 //   - _time_at: searchsorted-left with index clamped to [1, len-1]
-//   - record emission thresholds (1e-6 span, 1.0 m origin/tail tolerance)
+//   - record emission thresholds (kMinSpan, 1.0 m origin/tail tolerance)
 //
 // Build: via reporter_tpu/native/build.py (g++ -O3 -shared -fPIC).
 
@@ -25,6 +25,10 @@
 #include <vector>
 
 namespace {
+
+// matcher/segments.MIN_RECORD_SPAN: spans below one wire offset quantum
+// are float noise; both walkers must agree on the emission threshold.
+constexpr double kMinSpan = 0.25;
 
 struct Record {
   int64_t seg_id;
@@ -114,7 +118,7 @@ void path_to_records(const Tile& t, const std::vector<int32_t>& path,
     double d_lo = cum[i], d_hi = cum[j + 1];
     double c_lo = std::max(d_lo, observed_lo);
     double c_hi = std::min(d_hi, observed_hi);
-    if (c_hi > c_lo + 1e-6) {
+    if (c_hi > c_lo + kMinSpan) {
       Record r;
       for (size_t e = i; e <= j; ++e) {
         int64_t w = t.edge_way[path[e]];

@@ -112,19 +112,8 @@ def audit_reach(ts: TileSet, traces_xy: list[np.ndarray],
         T = len(xy)
         cands = [cpu_reference.find_candidates_cpu(ts, xy[t], params)
                  for t in range(T)]
-        # interpolation keep mask (mirror of match_trace_cpu)
-        keep = [True] * T
-        if params.interpolation_distance > 0.0 and T:
-            last = None
-            for t in range(T):
-                if last is None:
-                    last = t
-                    continue
-                if (float(np.linalg.norm(xy[t] - xy[last]))
-                        < params.interpolation_distance):
-                    keep[t] = False
-                else:
-                    last = t
+        keep = cpu_reference.interpolation_keep(
+            xy, params.interpolation_distance)
         act = [t for t in range(T) if keep[t] and cands[t]]
         for prev_t, t in zip(act, act[1:]):
             gc = float(np.linalg.norm(xy[t] - xy[prev_t]))

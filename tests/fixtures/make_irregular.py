@@ -1,0 +1,115 @@
+"""Deterministic generator for irregular.osm — a hand-designed OSM XML
+extract with the real-world geometry classes grid-synthetic cities lack
+(VERDICT r1 "What's weak" item 3): a dual-carriageway motorway (one-way
+pair), a diamond interchange with four *curved* ramps, grade-separated
+crossings (ways crossing without shared nodes), a diagonal connector,
+wiggly residential streets, two cul-de-sacs and a closed residential loop.
+
+Run ``python tests/fixtures/make_irregular.py`` to (re)write irregular.osm.
+The output is committed; this script exists so the fixture is reviewable
+and reproducible, not hand-edited XML.
+"""
+
+import os
+
+import numpy as np
+
+ORIGIN = (-122.41, 37.75)            # lon, lat — SF-ish so cos(lat) matters
+EARTH_RADIUS_M = 6_371_008.8         # keep in sync with reporter_tpu.geometry
+
+
+def to_lonlat(x: float, y: float) -> tuple[float, float]:
+    k = np.pi / 180.0 * EARTH_RADIUS_M
+    lon = x / (k * np.cos(np.deg2rad(ORIGIN[1]))) + ORIGIN[0]
+    lat = y / k + ORIGIN[1]
+    return lon, lat
+
+
+# (way_id, [(x, y) meters...], {tags})
+WAYS = [
+    # Dual carriageway: east- and westbound one-way motorways 35 m apart.
+    (101, [(-400, 0), (200, 0), (520, 0), (900, 0), (1400, 0)],
+     {"highway": "motorway", "oneway": "yes", "maxspeed": "65 mph",
+      "name": "Skyline Freeway EB"}),
+    (102, [(1400, 35), (620, 35), (180, 35), (-400, 35)],
+     {"highway": "motorway", "oneway": "yes", "maxspeed": "65 mph",
+      "name": "Skyline Freeway WB"}),
+    # Turnaround links so drives can continue at the map edge.
+    (108, [(1400, 0), (1450, 20), (1400, 35)],
+     {"highway": "trunk_link", "oneway": "yes"}),
+    (109, [(-400, 35), (-450, 15), (-400, 0)],
+     {"highway": "trunk_link", "oneway": "yes"}),
+    # Diamond interchange: four curved one-way ramps meeting the arterial
+    # at A1 = (400, 250).
+    (111, [(200, 0), (270, 25), (330, 90), (370, 170), (400, 250)],
+     {"highway": "motorway_link", "oneway": "yes"}),          # EB off
+    (112, [(400, 250), (430, 160), (460, 80), (490, 20), (520, 0)],
+     {"highway": "motorway_link", "oneway": "yes"}),          # EB on
+    (113, [(620, 35), (560, 75), (500, 145), (440, 205), (400, 250)],
+     {"highway": "motorway_link", "oneway": "yes"}),          # WB off
+    (114, [(400, 250), (340, 205), (280, 140), (220, 70), (180, 35)],
+     {"highway": "motorway_link", "oneway": "yes"}),          # WB on
+    # North-south arterial, grade-separated over the motorway (crosses
+    # y=0 and y=35 with no shared nodes).
+    (201, [(400, -350), (400, -100), (400, 250), (400, 500), (400, 800)],
+     {"highway": "primary", "maxspeed": "45 mph", "name": "Grand Ave"}),
+    # Wiggly residential east-west street.
+    (301, [(400, 500), (620, 510), (850, 490), (1050, 520)],
+     {"highway": "residential", "name": "Alder St"}),
+    # Diagonal secondary connector.
+    (302, [(400, 800), (700, 650), (1050, 520)],
+     {"highway": "secondary", "name": "Crescent Blvd"}),
+    # Cul-de-sac north from Alder St.
+    (303, [(620, 510), (610, 700), (630, 870)],
+     {"highway": "residential", "name": "Fern Ct"}),
+    # Dead-end service alley south from Alder St.
+    (304, [(850, 490), (860, 350), (840, 230)],
+     {"highway": "service"}),
+    # Closed residential loop (first node == last node).
+    (305, [(1050, 520), (1150, 540), (1230, 620), (1200, 760),
+           (1080, 790), (1000, 700), (1050, 520)],
+     {"highway": "residential", "name": "Orchard Loop"}),
+    # Southern tertiary + a north-south link grade-separated over the
+    # motorway, joining the loop.
+    (306, [(400, -350), (700, -340), (1000, -330), (1300, -320)],
+     {"highway": "tertiary", "name": "Quarry Rd"}),
+    (307, [(1000, -330), (1010, -80), (990, 150), (1000, 400), (1000, 700)],
+     {"highway": "tertiary", "name": "Bridge Way"}),
+]
+
+
+def main() -> None:
+    node_ids: dict[tuple[float, float], int] = {}
+
+    def nid(pt):
+        if pt not in node_ids:
+            node_ids[pt] = 1000 + len(node_ids)
+        return node_ids[pt]
+
+    for _, pts, _ in WAYS:
+        for p in pts:
+            nid(p)
+
+    lines = ['<?xml version="1.0" encoding="UTF-8"?>',
+             '<osm version="0.6" generator="make_irregular.py">']
+    for (x, y), i in node_ids.items():
+        lon, lat = to_lonlat(x, y)
+        lines.append(f'  <node id="{i}" lon="{lon:.7f}" lat="{lat:.7f}"/>')
+    for way_id, pts, tags in WAYS:
+        lines.append(f'  <way id="{way_id}">')
+        for p in pts:
+            lines.append(f'    <nd ref="{nid(p)}"/>')
+        for k, v in tags.items():
+            lines.append(f'    <tag k="{k}" v="{v}"/>')
+        lines.append('  </way>')
+    lines.append('</osm>')
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "irregular.osm")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out}: {len(node_ids)} nodes, {len(WAYS)} ways")
+
+
+if __name__ == "__main__":
+    main()

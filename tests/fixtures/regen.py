@@ -14,6 +14,7 @@ jax.config.update("jax_platforms", "cpu")
 
 from reporter_tpu.config import CompilerParams, Config          # noqa: E402
 from reporter_tpu.matcher.api import SegmentMatcher             # noqa: E402
+from reporter_tpu.netgen.osm_xml import parse_osm_xml           # noqa: E402
 from reporter_tpu.netgen.synthetic import generate_city         # noqa: E402
 from reporter_tpu.netgen.traces import synthesize_probe         # noqa: E402
 from reporter_tpu.tiles.compiler import compile_network         # noqa: E402
@@ -21,28 +22,49 @@ from reporter_tpu.tiles.compiler import compile_network         # noqa: E402
 COMPILER = {"reach_radius": 500.0, "osmlr_max_length": 200.0}
 SEEDS = (11, 23, 37)
 
+# Irregular-geometry extract (make_irregular.py): dual carriageway, curved
+# ramps, overpasses, cul-de-sacs, a loop — where HMM matchers get stressed.
+IRREGULAR_COMPILER = {"osmlr_max_length": 200.0}
+IRREGULAR_SEEDS = (3, 17, 29, 41)
 
-def main() -> None:
-    ts = compile_network(generate_city("tiny"), CompilerParams(**COMPILER))
+
+def _write(path: str, fixtures: list) -> None:
+    with open(path, "w") as f:
+        json.dump(fixtures, f, indent=1)
+    print(f"wrote {path}: {[fx['name'] for fx in fixtures]}")
+
+
+def _fixtures(ts, city: str, compiler: dict, seeds) -> list:
     m = SegmentMatcher(ts, Config(matcher_backend="jax"))
     fixtures = []
-    for seed in SEEDS:
+    for seed in seeds:
         p = synthesize_probe(ts, seed=seed, num_points=80, gps_sigma=3.0)
         payload = p.to_report_json()
         res = m.match(payload)
         fixtures.append({
-            "name": f"tiny-seed{seed}",
-            "city": "tiny",
-            "compiler": COMPILER,
+            "name": f"{city}-seed{seed}",
+            "city": city,
+            "compiler": compiler,
             "request": payload,
             "expected_segment_ids": [s["segment_id"]
                                      for s in res["segments"]],
             "expected_way_ids": [s["way_ids"] for s in res["segments"]],
         })
-    out = os.path.join(os.path.dirname(__file__), "golden_traces.json")
-    with open(out, "w") as f:
-        json.dump(fixtures, f, indent=1)
-    print(f"wrote {out}: {[f['name'] for f in fixtures]}")
+    return fixtures
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    ts = compile_network(generate_city("tiny"), CompilerParams(**COMPILER))
+    _write(os.path.join(here, "golden_traces.json"),
+           _fixtures(ts, "tiny", COMPILER, SEEDS))
+
+    net = parse_osm_xml(os.path.join(here, "irregular.osm"),
+                        name="irregular")
+    ts_irr = compile_network(net, CompilerParams(**IRREGULAR_COMPILER))
+    _write(os.path.join(here, "golden_irregular.json"),
+           _fixtures(ts_irr, "irregular", IRREGULAR_COMPILER,
+                     IRREGULAR_SEEDS))
 
 
 if __name__ == "__main__":

@@ -5,6 +5,8 @@ The analog of the reference's native/C++ test coverage living in Valhalla
 builders remain the executable spec.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -78,6 +80,23 @@ class TestCompilerIntegration:
         np.testing.assert_array_equal(py.reach_dist, cc.reach_dist)
         np.testing.assert_array_equal(py.reach_next, cc.reach_next)
         np.testing.assert_array_equal(py.grid, cc.grid)
+
+
+def test_min_record_span_constants_agree():
+    """MIN_RECORD_SPAN must equal the wire quantum (its rationale) and the
+    C++ walker's kMinSpan, or boundary-sliver divergence returns."""
+    import re
+
+    from reporter_tpu.matcher.segments import MIN_RECORD_SPAN
+    from reporter_tpu.ops.match import OFFSET_QUANTUM
+
+    assert MIN_RECORD_SPAN == OFFSET_QUANTUM
+    src = os.path.join(os.path.dirname(__file__), "..", "reporter_tpu",
+                       "native", "walker.cc")
+    with open(src) as f:
+        m = re.search(r"kMinSpan\s*=\s*([0-9.]+)", f.read())
+    assert m, "kMinSpan not found in walker.cc"
+    assert float(m.group(1)) == MIN_RECORD_SPAN
 
 
 class TestNativeWalker:
