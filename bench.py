@@ -144,14 +144,10 @@ def main() -> None:
     dt_cpu = time.perf_counter() - t0
 
     rj = jax_matcher.match_many(traces[:n_cpu])
-    from collections import Counter
-    disagreements = []
-    for a, b in zip(rj, rc):
-        ia = Counter(r.segment_id for r in a)
-        ib = Counter(r.segment_id for r in b)
-        denom = max(sum(ia.values()), sum(ib.values()), 1)
-        disagreements.append(1.0 - sum((ia & ib).values()) / denom)
-    disagreement = sum(disagreements) / max(len(disagreements), 1)
+    # Length-weighted segment-ID disagreement — the shared fidelity metric
+    # (matcher/fidelity.py), identical to what the CI gates enforce.
+    from reporter_tpu.matcher.fidelity import mean_disagreement
+    disagreement = mean_disagreement(rj, rc)
 
     probes = n_traces * n_points
     jax_pps = probes / dt_jax

@@ -1,0 +1,50 @@
+"""Backend-agreement fidelity metric (the BASELINE "<5% segment-ID
+disagreement vs Meili" proxy), shared by bench.py and the test gates so
+the number CI enforces is the number the bench reports.
+
+Length-weighted: per segment id, the covered meters both backends agree
+on. Count-based metrics let a ~5 m junction sliver (equal-length parallel
+routes — genuinely ambiguous) weigh as much as a 500 m segment; meters
+measure what the downstream speed histograms actually see.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterable, Sequence
+
+
+def length_weighted_agreement(results_a: Iterable[Sequence],
+                              results_b: Iterable[Sequence],
+                              ) -> tuple[float, float]:
+    """(agree_meters, total_meters) over paired per-trace record lists.
+
+    Records need ``segment_id`` and ``length`` attributes (SegmentRecord).
+    A trace where BOTH backends emit nothing is perfect agreement and
+    contributes (1, 1), not (0, 1).
+    """
+    agree = total = 0.0
+    for a, b in zip(results_a, results_b):
+        la: Counter = Counter()
+        lb: Counter = Counter()
+        for r in a:
+            la[r.segment_id] += r.length
+        for r in b:
+            lb[r.segment_id] += r.length
+        if not la and not lb:
+            agree += 1.0
+            total += 1.0
+            continue
+        total += max(sum(la.values()), sum(lb.values()), 1.0)
+        agree += sum(min(la[k], lb[k]) for k in la.keys() & lb.keys())
+    return agree, total
+
+
+def mean_disagreement(results_a: Iterable[Sequence],
+                      results_b: Iterable[Sequence]) -> float:
+    """Per-trace length-weighted disagreement, averaged (bench headline)."""
+    vals = []
+    for a, b in zip(results_a, results_b):
+        agree, total = length_weighted_agreement([a], [b])
+        vals.append(1.0 - agree / total)
+    return sum(vals) / max(len(vals), 1)

@@ -66,52 +66,121 @@ def _build_edges(net: RoadNetwork, node_xy: np.ndarray, origin: np.ndarray):
     )
 
 
-def _chain_osmlr(net: RoadNetwork, edge_len: np.ndarray, fwd_of_leg, rev_of_leg,
+def _chain_osmlr(net: RoadNetwork, edge_len: np.ndarray,
+                 edge_src: np.ndarray, edge_dst: np.ndarray,
+                 edge_opp: np.ndarray, fwd_of_leg, rev_of_leg,
                  max_len: float):
-    """Directional OSMLR chaining: consecutive edges of a way (per direction)
-    are grouped into segments of ~max_len meters. Stable id packs
-    (way_id, direction, chunk). Real OSMLR can cross way boundaries; chaining
-    within a way preserves the association *behavior* (stable ≤~1 km linear
-    references with per-edge offsets, SURVEY.md §2.2 "OSMLR segments")."""
+    """Directional OSMLR chaining with cross-way continuation.
+
+    Real OSMLR merges short ways into ~1 km linear references (SURVEY.md
+    §2.2 "OSMLR segments"): a residential street mapped as five OSM ways
+    is still ONE segment. Rules, mirroring that behavior:
+
+      1. within a way, consecutive legs always chain (a way may pass
+         through intersections);
+      2. across a way boundary, the chain continues iff the joint node has
+         geometric degree 2 (exactly two incident undirected legs) — i.e.
+         the road merely changes way id there, nothing joins or leaves;
+      3. chains split greedily into chunks of ≤ ``max_len`` meters.
+
+    Stable ids pack (first edge's way_id << 20) | (direction << 19) | chunk,
+    where ``chunk`` counts chunks per (way_id, direction) base in first-edge
+    order — deterministic for a given network, and unchanged from the
+    round-1 scheme for chains that do not cross ways. Every directed edge
+    belongs to exactly one chain; pure cycles (a block perimeter of
+    degree-2 corners) start at their lowest edge id.
+    """
     E = len(edge_len)
     edge_osmlr = np.full(E, -1, dtype=np.int32)
     edge_osmlr_off = np.zeros(E, dtype=np.float32)
     osmlr_ids: list[int] = []
     osmlr_lens: list[float] = []
 
-    def chain(edge_ids: list[int], way_id: int, direction: int) -> None:
-        chunk = 0
+    # edge → (way index, leg, direction); direction 1 = against the way
+    edge_leg: dict[int, tuple[int, int, int]] = {}
+    for (wi, leg), e in fwd_of_leg.items():
+        edge_leg[e] = (wi, leg, 0)
+    for (wi, leg), e in rev_of_leg.items():
+        edge_leg[e] = (wi, leg, 1)
+
+    # geometric node degree = number of incident undirected legs
+    num_nodes = net.num_nodes
+    node_deg = np.zeros(num_nodes, dtype=np.int32)
+    for (wi, leg), e in fwd_of_leg.items():
+        node_deg[edge_src[e]] += 1
+        node_deg[edge_dst[e]] += 1
+
+    out_edges: dict[int, list[int]] = {}
+    for e in range(E):
+        out_edges.setdefault(int(edge_src[e]), []).append(e)
+
+    def succ(e: int) -> int | None:
+        wi, leg, d = edge_leg[e]
+        nxt = (fwd_of_leg.get((wi, leg + 1)) if d == 0
+               else rev_of_leg.get((wi, leg - 1)))
+        if nxt is not None:
+            return nxt                      # rule 1: same way continues
+        u = int(edge_dst[e])
+        if node_deg[u] != 2:
+            return None                     # junction: chain ends
+        cands = [x for x in out_edges.get(u, ())
+                 if x != e and x != int(edge_opp[e])]
+        return cands[0] if len(cands) == 1 else None
+
+    preds = set()
+    for e in range(E):
+        s = succ(e)
+        if s is not None:
+            preds.add(s)
+
+    def walk(start: int, visited: np.ndarray) -> list[int]:
+        chain = []
+        e = start
+        while e is not None and not visited[e]:
+            visited[e] = True
+            chain.append(e)
+            e = succ(e)
+        return chain
+
+    visited = np.zeros(E, dtype=bool)
+    chains: list[list[int]] = []
+    for e in range(E):                      # chain heads first…
+        if e not in preds and not visited[e]:
+            chains.append(walk(e, visited))
+    for e in range(E):                      # …then pure cycles
+        if not visited[e]:
+            chains.append(walk(e, visited))
+
+    chunk_counter: dict[tuple[int, int], int] = {}
+    for chain in chains:                    # chains are in first-edge order
+        wi, _, d = edge_leg[chain[0]]
+        base = (net.ways[wi].way_id, d)
         cur: list[int] = []
         cur_len = 0.0
+
         def flush() -> None:
-            nonlocal chunk, cur, cur_len
+            nonlocal cur, cur_len
             if not cur:
                 return
+            chunk = chunk_counter.get(base, 0)
+            chunk_counter[base] = chunk + 1
             row = len(osmlr_ids)
-            osmlr_ids.append((way_id << 20) | (direction << 19) | chunk)
+            osmlr_ids.append((base[0] << 20) | (base[1] << 19) | chunk)
             off = 0.0
             for e in cur:
                 edge_osmlr[e] = row
                 edge_osmlr_off[e] = off
                 off += float(edge_len[e])
             osmlr_lens.append(off)
-            chunk += 1
             cur = []
             cur_len = 0.0
-        for e in edge_ids:
+
+        for e in chain:
             if cur and cur_len + float(edge_len[e]) > max_len:
                 flush()
             cur.append(e)
             cur_len += float(edge_len[e])
         flush()
-
-    for wi, w in enumerate(net.ways):
-        legs = range(len(w.nodes) - 1)
-        fwd = [fwd_of_leg[(wi, leg)] for leg in legs]
-        chain(fwd, w.way_id, 0)
-        if not w.oneway:
-            rev = [rev_of_leg[(wi, leg)] for leg in reversed(list(legs))]
-            chain(rev, w.way_id, 1)
 
     return (edge_osmlr, edge_osmlr_off,
             np.asarray(osmlr_ids, np.int64), np.asarray(osmlr_lens, np.float32))
@@ -218,7 +287,8 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None) -> T
     seg_a, seg_b, seg_edge, seg_off, seg_len, edge_len = _decompose_segments(shapes)
 
     edge_osmlr, edge_osmlr_off, osmlr_id, osmlr_len = _chain_osmlr(
-        net, edge_len, fwd_of_leg, rev_of_leg, params.osmlr_max_length)
+        net, edge_len, edge_src, edge_dst, edge_opp, fwd_of_leg, rev_of_leg,
+        params.osmlr_max_length)
 
     grid, grid_dims, grid_origin, overflow = _build_grid(
         seg_a, seg_b, params.cell_size, params.cell_capacity,

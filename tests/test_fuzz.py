@@ -2,13 +2,12 @@
 inputs. The jax and CPU-oracle backends must stay within the BASELINE
 disagreement budget on every seed, and nothing may crash on garbage."""
 
-from collections import Counter
-
 import numpy as np
 import pytest
 
 from reporter_tpu.config import CompilerParams, Config
 from reporter_tpu.matcher.api import SegmentMatcher, Trace
+from reporter_tpu.matcher.fidelity import length_weighted_agreement
 from reporter_tpu.netgen.synthetic import generate_city
 from reporter_tpu.netgen.traces import synthesize_fleet
 from reporter_tpu.tiles.compiler import compile_network
@@ -26,17 +25,12 @@ def test_random_city_backend_agreement(seed):
     rj = m_jax.match_many(traces)
     rc = m_cpu.match_many(traces)
 
-    agree = total = 0
-    for a, b in zip(rj, rc):
-        ia = Counter(r.segment_id for r in a)
-        ib = Counter(r.segment_id for r in b)
-        total += max(sum(ia.values()), sum(ib.values()), 1)
-        # multiset agreement: a legitimately re-traversed segment counts
-        # once per traversal on both sides (a set metric would punish it)
-        agree += sum((ia & ib).values()) if ia or ib else 1
-    # Gate at the BASELINE north-star budget (<5% disagreement), not a
-    # looser stand-in — a fidelity regression past the budget must fail CI.
-    assert agree / total >= 0.95, f"seed {seed}: {agree}/{total}"
+    # Length-weighted segment-ID agreement (matcher/fidelity.py — the same
+    # metric bench.py reports), gated at the BASELINE north-star budget
+    # (<5% disagreement), not a looser stand-in — a fidelity regression
+    # past the budget must fail CI.
+    agree, total = length_weighted_agreement(rj, rc)
+    assert agree / total >= 0.95, f"seed {seed}: {agree:.1f}/{total:.1f}"
 
 
 def test_degenerate_inputs_do_not_crash():

@@ -80,6 +80,58 @@ def test_grid_covers_radius(tiny_tiles, rng):
         assert not missing, f"grid missed segments {missing} near {p}"
 
 
+def test_osmlr_chains_cross_way_boundaries():
+    """Real OSMLR merges short ways into ~1 km references: a road mapped
+    as three consecutive ways through degree-2 joints must be ONE chain
+    per direction, broken only where a side street makes a real junction
+    (SURVEY.md §2.2 "OSMLR segments"; VERDICT r1 missing item 4)."""
+    from reporter_tpu.config import CompilerParams
+    from reporter_tpu.netgen.network import RoadNetwork, Way
+    from reporter_tpu.tiles.compiler import compile_network
+
+    k = 100.0 / 111319.49079327358          # ~100 m in degrees at lat 0
+    nodes = np.array([[i * k, 0.0] for i in range(4)] + [[2 * k, k]])
+    ways = [Way(way_id=1, nodes=[0, 1], oneway=False, name="a", speed_mps=13.4),
+            Way(way_id=2, nodes=[1, 2], oneway=False, name="b", speed_mps=13.4),
+            Way(way_id=3, nodes=[2, 3], oneway=False, name="c", speed_mps=13.4),
+            Way(way_id=9, nodes=[2, 4], oneway=False, name="s", speed_mps=13.4)]
+    ts = compile_network(RoadNetwork(node_lonlat=nodes, ways=ways, name="x"),
+                         CompilerParams(osmlr_max_length=1000.0))
+    # edges interleave fwd/rev per leg: 0/1 = way1, 2/3 = way2, 4/5 = way3
+    assert ts.edge_osmlr[0] == ts.edge_osmlr[2], "fwd chain must cross ways"
+    assert ts.edge_osmlr[1] == ts.edge_osmlr[3], "rev chain must cross ways"
+    assert ts.edge_osmlr[2] != ts.edge_osmlr[4], (
+        "chain must break at the degree-3 junction")
+    # association stays exact across the boundary
+    assert np.isclose(ts.edge_osmlr_off[2],
+                      ts.edge_len[0], atol=1e-3)
+    merged = int(ts.edge_osmlr[0])
+    assert np.isclose(ts.osmlr_len[merged],
+                      ts.edge_len[0] + ts.edge_len[2], atol=1e-2)
+    # ids unique and chunk scheme stable
+    assert len(np.unique(ts.osmlr_id)) == len(ts.osmlr_id)
+
+
+def test_osmlr_max_length_still_splits_merged_chains():
+    """Cross-way merging must not defeat the ~max_len chunking."""
+    from reporter_tpu.config import CompilerParams
+    from reporter_tpu.netgen.network import RoadNetwork, Way
+    from reporter_tpu.tiles.compiler import compile_network
+
+    k = 100.0 / 111319.49079327358
+    n = 12                                   # 1.1 km of 100 m ways
+    nodes = np.array([[i * k, 0.0] for i in range(n + 1)])
+    ways = [Way(way_id=i + 1, nodes=[i, i + 1], oneway=True, name="",
+                speed_mps=13.4) for i in range(n)]
+    ts = compile_network(RoadNetwork(node_lonlat=nodes, ways=ways, name="x"),
+                         CompilerParams(osmlr_max_length=400.0))
+    rows = ts.edge_osmlr
+    # one long chain, chunked: ~3 chunks of <=400 m, in drive order
+    assert len(ts.osmlr_id) == 3
+    assert (ts.osmlr_len <= 400.0 + 1.0).all()
+    assert (np.diff(rows) >= 0).all(), "chunks must be contiguous runs"
+
+
 def test_reach_tables_match_brute_dijkstra(tiny_tiles, rng):
     ts = tiny_tiles
     for e1 in rng.integers(0, ts.num_edges, size=20):
