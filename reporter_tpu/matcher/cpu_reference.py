@@ -47,12 +47,17 @@ def find_candidates_cpu(ts: TileSet, pt: np.ndarray,
 
 def edge_dijkstra(ts: TileSet, e_from: int, bound: float,
                   ) -> dict[int, tuple[float, int]]:
-    """Bounded Dijkstra over edges: distance from END of ``e_from`` to the
-    START of every edge within ``bound`` meters.
+    """Bounded Dijkstra: distance from END of ``e_from`` to the START of
+    every edge within ``bound`` meters.
 
     Returns {edge: (dist, prev_edge)}; prev_edge = -1 for direct successors.
     The meili/routing label-set analog (exact, unlike the reach tables).
+    Tiles with turn restrictions route in EDGE space (label = edge) so the
+    arriving edge's bans — at the source and at every via node — are
+    honored; unrestricted tiles keep the cheaper node-space labels.
     """
+    if ts.ban_set:
+        return _edge_dijkstra_banned(ts, e_from, bound, ts.ban_set)
     out: dict[int, tuple[float, int]] = {}
     u0 = int(ts.edge_dst[e_from])
     dist: dict[int, float] = {u0: 0.0}
@@ -74,6 +79,22 @@ def edge_dijkstra(ts: TileSet, e_from: int, bound: float,
                 prev_edge[w] = e
                 heapq.heappush(pq, (nd, w))
     return out
+
+
+def _edge_dijkstra_banned(ts: TileSet, e_from: int, bound: float,
+                          banned: set[tuple[int, int]],
+                          ) -> dict[int, tuple[float, int]]:
+    """Edge-space twin of edge_dijkstra for restricted tiles: delegates to
+    the SAME search the reach-table builder uses (tiles.reach
+    .edge_space_targets) with seeds filtered by ``e_from``'s own bans, so
+    oracle and tables cannot diverge on ban semantics."""
+    from reporter_tpu.tiles.reach import edge_space_targets
+
+    seeds = [int(e) for e in ts.node_out[int(ts.edge_dst[e_from])]
+             if e >= 0 and (e_from, int(e)) not in banned]
+    targets = edge_space_targets(seeds, ts.node_out, ts.edge_dst,
+                                 ts.edge_len, bound, banned)
+    return {e: (d, prev) for e, (d, _first, prev) in targets.items()}
 
 
 def walk_prev(reached: dict[int, tuple[float, int]], e2: int) -> list[int]:

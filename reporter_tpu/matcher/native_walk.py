@@ -34,7 +34,7 @@ class NativeWalker:
                                                     np.float32)
         self._osmlr_id = np.ascontiguousarray(ts.osmlr_id, np.int64)
         self._osmlr_len = np.ascontiguousarray(ts.osmlr_len, np.float32)
-        self._edge_dst = np.ascontiguousarray(ts.edge_dst, np.int32)
+        self._reach_row = np.ascontiguousarray(ts.edge_reach_row, np.int32)
         self._reach_to = np.ascontiguousarray(ts.reach_to, np.int32)
         self._reach_dist = np.ascontiguousarray(ts.reach_dist, np.float32)
         self._reach_next = np.ascontiguousarray(ts.reach_next, np.int32)
@@ -75,7 +75,7 @@ class NativeWalker:
                 _ptr(self._edge_osmlr_off, ctypes.c_float),
                 _ptr(self._osmlr_id, ctypes.c_int64),
                 _ptr(self._osmlr_len, ctypes.c_float),
-                _ptr(self._edge_dst, ctypes.c_int32),
+                _ptr(self._reach_row, ctypes.c_int32),
                 _ptr(self._reach_to, ctypes.c_int32),
                 _ptr(self._reach_dist, ctypes.c_float),
                 _ptr(self._reach_next, ctypes.c_int32), self._m,

@@ -77,7 +77,7 @@ def reach_route_fn(ts: TileSet) -> RouteFn:
         e = e1
         gap = np.inf
         while True:
-            u = int(ts.edge_dst[e])     # reach rows are node-keyed
+            u = int(ts.edge_reach_row[e])   # edge → governing reach row
             row = ts.reach_to[u]
             hit = np.nonzero(row == e2)[0]
             if not len(hit):

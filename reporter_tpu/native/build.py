@@ -97,7 +97,7 @@ def load_native_lib() -> "ctypes.CDLL | None":
         ctypes.c_int64, ctypes.c_int64,              # B, T
         f32p, i64p, i32p, f32p,                      # edge_{len,way,osmlr,osmlr_off}
         i64p, f32p,                                  # osmlr_{id,len}
-        i32p,                                        # edge_dst (node-keyed reach)
+        i32p,                                        # reach_row (edge → row)
         i32p, f32p, i32p, ctypes.c_int32,            # reach_{to,dist,next}, M
         ctypes.c_double, ctypes.c_int32,             # backward_slack, n_threads
         i32p, i64p, f64p, f64p, f64p, u8p,           # record columns

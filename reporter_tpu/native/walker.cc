@@ -44,7 +44,8 @@ struct Tile {
   const float* edge_osmlr_off;
   const int64_t* osmlr_id;
   const float* osmlr_len;
-  const int32_t* edge_dst;    // reach rows are node-keyed: row edge_dst[e]
+  const int32_t* reach_row;   // edge → governing reach row (node row, or a
+                              // private ban-aware row for restricted edges)
   const int32_t* reach_to;
   const float* reach_dist;
   const int32_t* reach_next;
@@ -60,7 +61,7 @@ bool route_between(const Tile& t, int32_t e1, int32_t e2,
   int32_t e = e1;
   double gap = std::numeric_limits<double>::infinity();
   while (true) {
-    int64_t u = t.edge_dst[e];
+    int64_t u = t.reach_row[e];
     const int32_t* row = t.reach_to + u * t.reach_m;
     int32_t hit = -1;
     for (int32_t i = 0; i < t.reach_m; ++i) {
@@ -230,7 +231,7 @@ int64_t reporter_walk_segments(
     const float* edge_len, const int64_t* edge_way, const int32_t* edge_osmlr,
     const float* edge_osmlr_off,
     const int64_t* osmlr_id, const float* osmlr_len,
-    const int32_t* edge_dst,
+    const int32_t* reach_row,
     const int32_t* reach_to, const float* reach_dist,
     const int32_t* reach_next, int32_t reach_m,
     double backward_slack, int32_t n_threads,
@@ -238,8 +239,8 @@ int64_t reporter_walk_segments(
     double* rec_len, uint8_t* rec_internal, int64_t rec_cap,
     int32_t* way_off, int64_t* way_ids, int64_t way_cap,
     int64_t* n_ways_out) {
-  Tile tile{edge_len,  edge_way, edge_osmlr, edge_osmlr_off, osmlr_id,
-            osmlr_len, edge_dst, reach_to,   reach_dist,     reach_next,
+  Tile tile{edge_len,  edge_way,  edge_osmlr, edge_osmlr_off, osmlr_id,
+            osmlr_len, reach_row, reach_to,   reach_dist,     reach_next,
             reach_m};
 
   if (n_threads < 1) n_threads = 1;

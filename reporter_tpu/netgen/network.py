@@ -30,12 +30,34 @@ class Way:
 
 
 @dataclass
+class TurnRestriction:
+    """An OSM turn restriction with a via NODE (the overwhelmingly common
+    form; via-way restrictions are out of scope and dropped by parsers).
+
+    ``kind`` keeps the OSM vocabulary: prohibitory ``no_*`` (that one turn
+    is banned) or mandatory ``only_*`` (every OTHER turn from from_way at
+    the via node is banned). The compiler resolves ways to directed edges,
+    so a PBF reader producing these same records slots straight in.
+    """
+
+    from_way: int                        # OSM way id the vehicle arrives on
+    via_node: int                        # node index into node_lonlat
+    to_way: int                          # OSM way id of the (dis)allowed exit
+    kind: str = "no_turn"                # "no_*" or "only_*"
+
+    @property
+    def mandatory(self) -> bool:
+        return self.kind.startswith("only_")
+
+
+@dataclass
 class RoadNetwork:
     """Graph-agnostic road network: nodes in lon/lat + ways."""
 
     node_lonlat: np.ndarray              # [N, 2] float64 (lon, lat) degrees
     ways: list[Way]
     name: str = "net"
+    restrictions: list[TurnRestriction] = field(default_factory=list)
 
     @property
     def num_nodes(self) -> int:

@@ -14,7 +14,7 @@ import xml.etree.ElementTree as ET
 
 import numpy as np
 
-from reporter_tpu.netgen.network import RoadNetwork, Way
+from reporter_tpu.netgen.network import RoadNetwork, TurnRestriction, Way
 
 DRIVABLE_HIGHWAY = {
     "motorway", "trunk", "primary", "secondary", "tertiary", "unclassified",
@@ -76,6 +76,7 @@ def parse_osm_xml(source: str, name: str = "osm") -> RoadNetwork:
         lonlat[idx] = node_pos[osm_id]
 
     ways: list[Way] = []
+    drivable_way_ids = set()
     for way_id, refs, tags in raw_ways:
         ow = tags.get("oneway", "no") in ("yes", "true", "1")
         nodes = [used[r] for r in refs]
@@ -86,4 +87,33 @@ def parse_osm_xml(source: str, name: str = "osm") -> RoadNetwork:
             Way(way_id=way_id, nodes=nodes, oneway=ow,
                 name=tags.get("name", ""), speed_mps=_speed_mps(tags))
         )
-    return RoadNetwork(node_lonlat=lonlat, ways=ways, name=name)
+        drivable_way_ids.add(way_id)
+
+    # Turn restrictions: <relation> tagged type=restriction with way/from,
+    # node/via, way/to members (SURVEY.md §3.4 — Valhalla's complex
+    # restrictions; via-WAY relations are rare and dropped here).
+    restrictions: list[TurnRestriction] = []
+    for rel in root.iter("relation"):
+        tags = {t.get("k"): t.get("v") for t in rel.findall("tag")}
+        if tags.get("type") != "restriction":
+            continue
+        kind = tags.get("restriction", "")
+        if not (kind.startswith("no_") or kind.startswith("only_")):
+            continue
+        frm = via = to = None
+        for m in rel.findall("member"):
+            role, mtype = m.get("role"), m.get("type")
+            ref = int(m.get("ref"))
+            if role == "from" and mtype == "way":
+                frm = ref
+            elif role == "via" and mtype == "node":
+                via = ref
+            elif role == "to" and mtype == "way":
+                to = ref
+        if (frm in drivable_way_ids and to in drivable_way_ids
+                and via in used):
+            restrictions.append(TurnRestriction(
+                from_way=frm, via_node=used[via], to_way=to, kind=kind))
+
+    return RoadNetwork(node_lonlat=lonlat, ways=ways, name=name,
+                       restrictions=restrictions)

@@ -43,13 +43,15 @@ def route_distance(e1, off1, e2, off2, tables, backward_slack: float = 10.0):
     within the reach radius.
     """
     edge_len = tables["edge_len"]
-    edge_dst = tables["edge_dst"]      # i32 [E] — reach rows are node-keyed
-    reach_to = tables["reach_to"]      # [N, M]
-    reach_dist = tables["reach_dist"]  # [N, M]
+    reach_row = tables["reach_row"]    # i32 [E] — edge → reach row (node
+                                       # rows; private rows for restricted
+                                       # from-edges, tiles/reach.py)
+    reach_to = tables["reach_to"]      # [R, M]
+    reach_dist = tables["reach_dist"]  # [R, M]
 
     e1s = jnp.maximum(e1, 0)
     e2s = jnp.maximum(e2, 0)
-    n1 = edge_dst[e1s]
+    n1 = reach_row[e1s]
     row_to = reach_to[n1]              # [..., M]
     row_d = reach_dist[n1]
     hit = row_to == e2s[..., None]
@@ -139,7 +141,7 @@ def viterbi_decode_batched(cands: CandidateSet, points, valid_pt, tables,
     k_iota = jnp.arange(K, dtype=jnp.int32)
 
     edge_len = tables["edge_len"]
-    edge_dst = tables["edge_dst"]
+    reach_row = tables["reach_row"]
     reach_to = tables["reach_to"]
     reach_dist = tables["reach_dist"]
 
@@ -147,7 +149,7 @@ def viterbi_decode_batched(cands: CandidateSet, points, valid_pt, tables,
         """[K, K, B] transition costs (mirror of transition_costs)."""
         e1 = jnp.maximum(pe, 0)                         # [K, B]
         e2 = jnp.maximum(e, 0)
-        n1 = edge_dst[e1]                               # node-keyed reach rows
+        n1 = reach_row[e1]                              # edge → reach row
         rows_to = reach_to[n1]                          # [K, B, M]
         rows_d = reach_dist[n1]
         hit = rows_to[:, None] == e2[None, :, :, None]  # [K, K, B, M]

@@ -45,7 +45,7 @@ class ShardedTables(NamedTuple):
     seg_pack: jnp.ndarray    # [8, S_pad] — sharded over columns
     seg_bbox: jnp.ndarray    # [nblocks, 4] — sharded over rows
     edge_len: jnp.ndarray    # replicated
-    edge_dst: jnp.ndarray    # replicated (reach rows are node-keyed)
+    reach_row: jnp.ndarray   # replicated (edge → governing reach row)
     reach_to: jnp.ndarray
     reach_dist: jnp.ndarray
 
@@ -73,8 +73,8 @@ def shard_tables(mesh: Mesh, ts: TileSet, axis: str = "tile",
                                 NamedSharding(mesh, P(axis))),
         edge_len=jax.device_put(jnp.asarray(ts.edge_len),
                                 NamedSharding(mesh, P())),
-        edge_dst=jax.device_put(jnp.asarray(ts.edge_dst),
-                                NamedSharding(mesh, P())),
+        reach_row=jax.device_put(jnp.asarray(ts.edge_reach_row),
+                                 NamedSharding(mesh, P())),
         reach_to=jax.device_put(jnp.asarray(ts.reach_to),
                                 NamedSharding(mesh, P())),
         reach_dist=jax.device_put(jnp.asarray(ts.reach_dist),
@@ -118,7 +118,7 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     tables = shard_tables(mesh, ts, axis)
     radius, k = params.search_radius, params.max_candidates
 
-    def local(points, valid, seg_pack, seg_bbox, edge_len, edge_dst,
+    def local(points, valid, seg_pack, seg_bbox, edge_len, reach_row,
               reach_to, reach_dist):
         B, T = points.shape[:2]
         flat = find_candidates_dense(
@@ -135,7 +135,7 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
                              valid=(me >= 0).reshape(B, T, k))
         vit = viterbi_decode_batched(
             cands, points, valid,
-            {"edge_len": edge_len, "edge_dst": edge_dst,
+            {"edge_len": edge_len, "reach_row": reach_row,
              "reach_to": reach_to, "reach_dist": reach_dist},
             params.sigma_z, params.beta, params.max_route_distance_factor,
             params.breakage_distance, params.backward_slack,
@@ -155,7 +155,7 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     @jax.jit
     def step(points, valid) -> MatchOutput:
         return sharded(points, valid, tables.seg_pack, tables.seg_bbox,
-                       tables.edge_len, tables.edge_dst,
+                       tables.edge_len, tables.reach_row,
                        tables.reach_to, tables.reach_dist)
 
     return step

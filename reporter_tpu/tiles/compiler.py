@@ -296,8 +296,12 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None) -> T
 
     node_out = _build_node_out(net.num_nodes, edge_src)
 
-    reach_to, reach_dist, reach_next, reach_truncated = _build_reach(
-        node_out, edge_src, edge_dst, edge_len, params)
+    banned_pairs = _resolve_restrictions(net, edge_src, edge_dst, edge_way,
+                                         node_out)
+
+    (reach_to, reach_dist, reach_next, reach_truncated,
+     edge_reach_row) = _build_reach(
+        node_out, edge_src, edge_dst, edge_len, node_xy, banned_pairs, params)
 
     if overflow:
         import warnings
@@ -324,20 +328,68 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None) -> T
         seg_a=seg_a, seg_b=seg_b, seg_edge=seg_edge, seg_off=seg_off, seg_len=seg_len,
         grid=grid,
         reach_to=reach_to, reach_dist=reach_dist, reach_next=reach_next,
+        edge_reach_row=edge_reach_row,
+        ban_from=banned_pairs[:, 0].copy() if len(banned_pairs)
+        else np.zeros(0, np.int32),
+        ban_to=banned_pairs[:, 1].copy() if len(banned_pairs)
+        else np.zeros(0, np.int32),
         stats={
             "nodes": int(net.num_nodes), "edges": int(len(edge_len)),
             "line_segments": int(len(seg_a)), "osmlr_segments": int(len(osmlr_id)),
             "grid_cells": int(grid_dims[0] * grid_dims[1]),
             "grid_overflow": int(overflow),
             "reach_truncated_nodes": int(reach_truncated),
+            "restrictions": len(net.restrictions),
+            "banned_turn_pairs": int(len(banned_pairs)),
             "compile_seconds": round(time.time() - t0, 3),
         },
     )
     return ts
 
 
-def _build_reach(node_out, edge_src, edge_dst, edge_len, params: CompilerParams):
-    """Reach tables via the native C++ builder when available, else Python."""
+def _resolve_restrictions(net: RoadNetwork, edge_src, edge_dst, edge_way,
+                          node_out) -> np.ndarray:
+    """TurnRestrictions (way ids + via node) → banned directed-edge pairs
+    [B, 2]. ``no_*`` bans the named (from, to) pairs; ``only_*`` bans every
+    OTHER exit from the from-edge at the via node. Unresolvable relations
+    (way not incident to the via node in the needed direction) are dropped
+    with a warning, like the reference's graph builder does."""
+    banned: set[tuple[int, int]] = set()
+    dropped = 0
+    if not net.restrictions:
+        return np.zeros((0, 2), np.int32)
+    by_way: dict[int, list[int]] = {}
+    for e, w in enumerate(edge_way):
+        by_way.setdefault(int(w), []).append(e)
+    for r in net.restrictions:
+        u = int(r.via_node)
+        from_edges = [e for e in by_way.get(r.from_way, ())
+                      if int(edge_dst[e]) == u]
+        to_edges = {e for e in by_way.get(r.to_way, ())
+                    if int(edge_src[e]) == u}
+        if not from_edges or not to_edges:
+            dropped += 1
+            continue
+        outs = [int(e) for e in node_out[u] if e >= 0]
+        for ef in from_edges:
+            if r.mandatory:
+                banned.update((ef, x) for x in outs if x not in to_edges)
+            else:
+                banned.update((ef, int(t)) for t in to_edges)
+    if dropped:
+        import warnings
+
+        warnings.warn(f"{net.name}: dropped {dropped} unresolvable turn "
+                      "restrictions", stacklevel=2)
+    if not banned:
+        return np.zeros((0, 2), np.int32)
+    return np.asarray(sorted(banned), np.int32)
+
+
+def _node_space_reach(node_out, edge_src, edge_dst, edge_len,
+                      params: CompilerParams):
+    """Unrestricted node rows: native C++ builder when available, Python
+    fallback (bit-identical). Returns (to, dist, next, truncated)."""
     if params.use_native:
         try:
             from reporter_tpu.tiles.native import build_reach_native
@@ -346,7 +398,7 @@ def _build_reach(node_out, edge_src, edge_dst, edge_len, params: CompilerParams)
                 node_out, edge_src, edge_dst, edge_len,
                 params.reach_radius, params.reach_max)
             if out is not None:
-                return out  # (reach_to, reach_dist, reach_next, truncated)
+                return out
         except ImportError:
             pass
     from reporter_tpu.tiles.reach import build_reach_tables
@@ -354,3 +406,21 @@ def _build_reach(node_out, edge_src, edge_dst, edge_len, params: CompilerParams)
     return build_reach_tables(
         node_out, edge_src, edge_dst, edge_len,
         params.reach_radius, params.reach_max)
+
+
+def _build_reach(node_out, edge_src, edge_dst, edge_len, node_xy,
+                 banned_pairs, params: CompilerParams):
+    """Reach tables + edge→row map. The fast (native) node-space build
+    always runs; tiles with turn restrictions then recompute only the
+    ban-affected ball of node rows + the private from-edge rows in the
+    Python edge-space builder (restrictions are sparse, so metro compiles
+    stay on the multithreaded path)."""
+    base = _node_space_reach(node_out, edge_src, edge_dst, edge_len, params)
+    if not len(banned_pairs):
+        return (*base, edge_dst.astype(np.int32).copy())
+    from reporter_tpu.tiles.reach import build_reach_tables_restricted
+
+    return build_reach_tables_restricted(
+        node_out, edge_src, edge_dst, edge_len,
+        params.reach_radius, params.reach_max, banned_pairs,
+        base=base[:3], node_xy=node_xy)

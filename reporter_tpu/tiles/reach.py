@@ -13,10 +13,20 @@ gather + compare — exactly what the TPU is good at. ``reach_next``
 by repeated next-hop lookup, replacing Meili's edge walk.
 
 Tables are keyed by NODE ([N, M]): every in-edge of a node shares one target
-row, so the row for edge ``e`` is ``reach_*[edge_dst[e]]`` (one extra tiny
-gather on device). Node-keying cuts the footprint ~E/N (≈3×) versus the
+row, so the row for edge ``e`` is ``reach_*[edge_reach_row[e]]`` (one extra
+tiny gather on device). Node-keying cuts the footprint ~E/N (≈3×) versus the
 per-edge broadcast, which is what makes a wide M (deep truncation coverage —
 see tiles/reach_audit.py) affordable at metro scale.
+
+Turn restrictions (banned from-edge → to-edge pairs at a node) make
+reachability depend on the ARRIVING edge, not just the node. Rather than
+falling back to per-edge rows everywhere, restriction from-edges get
+PRIVATE rows appended after the N node rows (``build_reach_tables_restricted``)
+and ``edge_reach_row`` points them there; every other edge keeps its node
+row. All searches on a restricted tile run in EDGE space (label = edge) so
+paths *through* a restricted node also respect its bans. Unrestricted
+tiles keep the plain node-space build (bit-identical to the native C++
+builder, which handles only that case).
 
 A C++ builder (native/reach.cc) accelerates this for large metros; this module
 is the reference implementation and fallback.
@@ -109,10 +119,152 @@ def build_reach_tables(
     return reach_to, reach_dist, reach_next, truncated
 
 
+def edge_space_targets(
+    seeds: list[int],
+    node_out: np.ndarray,
+    edge_dst: np.ndarray,
+    edge_len: np.ndarray,
+    radius: float,
+    banned: set[tuple[int, int]],
+) -> dict[int, tuple[float, int, int]]:
+    """Bounded Dijkstra over EDGES: {edge e': (dist to start of e', seed
+    edge beginning the path, previous edge on the path — -1 for seeds)}.
+    Seeds start at dist 0 (their own start). Expansion e → e2 at dst(e) is
+    skipped when (e, e2) is banned, so paths through restricted nodes stay
+    legal no matter the source. Shared by the reach-table builder and the
+    CPU oracle (matcher/cpu_reference) so the two can never diverge on ban
+    semantics — the <5% disagreement gate depends on that.
+    """
+    dist: dict[int, float] = {}
+    first: dict[int, int] = {}
+    prev: dict[int, int] = {}
+    pq: list[tuple[float, int]] = []
+    for e in seeds:
+        if 0.0 < dist.get(e, np.inf):
+            dist[e] = 0.0
+            first[e] = e
+            prev[e] = -1
+            heapq.heappush(pq, (0.0, e))
+    while pq:
+        d, e = heapq.heappop(pq)
+        if d > dist.get(e, np.inf):
+            continue
+        nd = d + float(edge_len[e])
+        if nd > radius:
+            continue
+        v = int(edge_dst[e])
+        for e2 in node_out[v]:
+            if e2 < 0:
+                break
+            e2 = int(e2)
+            if (e, e2) in banned:
+                continue
+            if nd < dist.get(e2, np.inf):
+                dist[e2] = nd
+                first[e2] = first[e]
+                prev[e2] = e
+                heapq.heappush(pq, (nd, e2))
+    return {e: (dist[e], first[e], prev[e]) for e in dist}
+
+
+def _pack_rows(targets: dict[int, tuple[float, int, int]], seeds: set[int],
+               max_targets: int,
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray, bool]:
+    """Sort targets by (dist, edge id), truncate to max_targets; next-hop
+    is the target itself for direct successors (seed edges), else the
+    path's first edge."""
+    tos = np.fromiter(targets.keys(), np.int64, len(targets))
+    dists = np.asarray([targets[int(e)][0] for e in tos])
+    nexts = np.asarray([int(e) if int(e) in seeds else targets[int(e)][1]
+                        for e in tos], np.int32)
+    order = np.lexsort((tos, dists))
+    cut = len(order) > max_targets
+    order = order[:max_targets]
+    return (tos[order].astype(np.int32), dists[order].astype(np.float32),
+            nexts[order], cut)
+
+
+def build_reach_tables_restricted(
+    node_out: np.ndarray,
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    edge_len: np.ndarray,
+    radius: float,
+    max_targets: int,
+    banned_pairs: "np.ndarray | list[tuple[int, int]]",
+    base: "tuple[np.ndarray, np.ndarray, np.ndarray] | None" = None,
+    node_xy: "np.ndarray | None" = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int, np.ndarray]:
+    """Ban-aware build: (reach_to, reach_dist, reach_next, truncated,
+    edge_reach_row). Rows are [N + F, max_targets]: node rows first, then
+    one private row per restriction from-edge (ascending edge id);
+    edge_reach_row[e] picks the row governing transitions out of e.
+
+    With ``base`` (the unrestricted node rows, e.g. from the multithreaded
+    native builder) and ``node_xy``, only AFFECTED node rows are recomputed
+    in Python edge space: nodes within straight-line ``radius`` of a ban's
+    via node (network distance ≥ euclidean, so this ball is a conservative
+    superset of every row a ban could change). Restrictions are sparse in
+    real extracts, so this keeps metro compiles on the fast path. The
+    returned ``truncated`` stat then counts rows at capacity (a superset
+    of truly-truncated rows — diagnostic only).
+    """
+    banned = {(int(a), int(b)) for a, b in banned_pairs}
+    from_edges = sorted({a for a, _ in banned})
+    num_nodes = len(node_out)
+    rows = num_nodes + len(from_edges)
+    reach_to = np.full((rows, max_targets), -1, dtype=np.int32)
+    reach_dist = np.full((rows, max_targets), np.inf, dtype=np.float32)
+    reach_next = np.full((rows, max_targets), -1, dtype=np.int32)
+    exact_cut = 0
+
+    if base is not None:
+        reach_to[:num_nodes] = base[0]
+        reach_dist[:num_nodes] = base[1]
+        reach_next[:num_nodes] = base[2]
+
+    if base is not None and node_xy is not None:
+        via = np.asarray(sorted({int(edge_dst[a]) for a, _ in banned}))
+        d2 = ((node_xy[:, None, :] - node_xy[via][None, :, :]) ** 2).sum(-1)
+        affected = np.nonzero((d2.min(axis=1) <= radius * radius))[0]
+    else:
+        affected = np.arange(num_nodes)
+
+    def fill(row: int, seeds: list[int]) -> None:
+        nonlocal exact_cut
+        reach_to[row] = -1
+        reach_dist[row] = np.inf
+        reach_next[row] = -1
+        targets = edge_space_targets(seeds, node_out, edge_dst, edge_len,
+                                     radius, banned)
+        if not targets:
+            return
+        tos, dists, nexts, cut = _pack_rows(targets, set(seeds), max_targets)
+        exact_cut += bool(cut)
+        reach_to[row, :len(tos)] = tos
+        reach_dist[row, :len(tos)] = dists
+        reach_next[row, :len(tos)] = nexts
+
+    for u in affected:
+        fill(int(u), [int(e) for e in node_out[u] if e >= 0])
+    edge_reach_row = edge_dst.astype(np.int32).copy()
+    for i, e_f in enumerate(from_edges):
+        u = int(edge_dst[e_f])
+        seeds = [int(e) for e in node_out[u]
+                 if e >= 0 and (e_f, int(e)) not in banned]
+        fill(num_nodes + i, seeds)
+        edge_reach_row[e_f] = num_nodes + i
+    if base is not None and len(affected) < num_nodes:
+        truncated = int((reach_to[:, -1] >= 0).sum())   # rows at capacity
+    else:
+        truncated = exact_cut
+    return reach_to, reach_dist, reach_next, truncated, edge_reach_row
+
+
 def reach_lookup(reach_to: np.ndarray, reach_dist: np.ndarray,
-                 edge_dst: np.ndarray, e1: int, e2: int) -> float:
+                 edge_reach_row: np.ndarray, e1: int, e2: int) -> float:
     """Network distance end-of-e1 → start-of-e2, inf if outside the table."""
-    u = int(edge_dst[e1])
+    u = int(edge_reach_row[e1])
     row = reach_to[u]
     hit = np.nonzero(row == e2)[0]
     return float(reach_dist[u, hit[0]]) if len(hit) else float(np.inf)

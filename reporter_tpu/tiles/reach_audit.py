@@ -144,7 +144,7 @@ def audit_reach(ts: TileSet, traces_xy: list[np.ndarray],
                     audit.pairs_accepted_exact += 1
                     step_exact += 1
                     if row_to is None:
-                        u = int(ts.edge_dst[cj.edge])   # node-keyed rows
+                        u = int(ts.edge_reach_row[cj.edge])
                         row_to = reach_to[u]
                         row_d = reach_dist[u]
                     idx = np.nonzero(row_to == ck.edge)[0]

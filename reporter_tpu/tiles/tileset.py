@@ -24,14 +24,18 @@ segments, C grid cell capacity, M reach-table width):
   seg_off        f32 [S]     distance along edge at seg_a
   seg_len        f32 [S]     |seg_b - seg_a|
   grid           i32 [ncells,C]  line-segment ids per spatial cell, -1 padded
-  reach_to       i32 [N,M]   nearby reachable target edges, -1 padded
-  reach_dist     f32 [N,M]   network distance node → start-of-target (m)
-  reach_next     i32 [N,M]   first edge of that path (next-hop, for host walk)
+  reach_to       i32 [R,M]   nearby reachable target edges, -1 padded
+  reach_dist     f32 [R,M]   network distance row-source → start-of-target (m)
+  reach_next     i32 [R,M]   first edge of that path (next-hop, for host walk)
+  edge_reach_row i32 [E]     reach row governing transitions out of edge e
+  ban_from/ban_to i32 [B]    banned turn pairs (from edge → to edge)
 
-Reach tables are node-keyed: the row governing transitions out of edge e is
-row edge_dst[e] (all in-edges of a node share targets), ~3× smaller than a
-per-edge broadcast — which pays for a wide M (tiles/reach_audit.py measures
-what truncation would cost).
+Reach tables are node-keyed: R = N rows, edge_reach_row[e] == edge_dst[e]
+(all in-edges of a node share targets), ~3× smaller than a per-edge
+broadcast — which pays for a wide M (tiles/reach_audit.py measures what
+truncation would cost). Turn restrictions add private ban-aware rows for
+their from-edges (R = N + F) and repoint edge_reach_row there
+(tiles/reach.py).
 
 Device-side the grid + per-segment arrays are fused into ``cell_pack``
 (build_cell_pack below): one f32 [ncells, 8*C] row per cell holding every
@@ -88,7 +92,8 @@ _ARRAY_FIELDS = (
     "osmlr_id", "osmlr_len",
     "seg_a", "seg_b", "seg_edge", "seg_off", "seg_len",
     "grid",
-    "reach_to", "reach_dist", "reach_next",
+    "reach_to", "reach_dist", "reach_next", "edge_reach_row",
+    "ban_from", "ban_to",
 )
 
 
@@ -129,7 +134,22 @@ class TileSet:
     reach_to: np.ndarray
     reach_dist: np.ndarray
     reach_next: np.ndarray
+    edge_reach_row: np.ndarray
+    ban_from: np.ndarray
+    ban_to: np.ndarray
     stats: dict[str, Any] = field(default_factory=dict)
+
+    _ban_set_cache: "set[tuple[int, int]] | None" = field(
+        default=None, repr=False, compare=False)
+
+    @property
+    def ban_set(self) -> set[tuple[int, int]]:
+        """Banned (from_edge, to_edge) pairs as a set (lazy; oracle + audit)."""
+        if self._ban_set_cache is None:
+            object.__setattr__(self, "_ban_set_cache",
+                               {(int(a), int(b)) for a, b
+                                in zip(self.ban_from, self.ban_to)})
+        return self._ban_set_cache
 
     @property
     def num_edges(self) -> int:
@@ -150,8 +170,9 @@ class TileSet:
         payload["_meta"] = np.frombuffer(
             json.dumps({"name": self.name, "meta": list(self.meta),
                         "stats": self.stats,
-                        # schema 2: reach tables node-keyed [N, M]
-                        "schema": 2}).encode(),
+                        # schema 3: node-keyed reach rows + edge_reach_row
+                        # indirection + banned turn pairs
+                        "schema": 3}).encode(),
             dtype=np.uint8,
         )
         np.savez_compressed(path, **payload)
@@ -164,19 +185,21 @@ class TileSet:
             path += ".npz"
         with np.load(path) as z:
             raw = json.loads(bytes(z["_meta"]).decode())
+            if raw.get("schema", 1) != 3:
+                raise ValueError(
+                    f"{path}: tileset schema {raw.get('schema', 1)} predates "
+                    "the node-keyed reach tables + turn restrictions; "
+                    "recompile with compile_network()")
             arrays = {f: z[f] for f in _ARRAY_FIELDS}
         if len(raw["meta"]) != len(TileMeta._fields):
             raise ValueError(
                 f"{path}: tileset metadata has {len(raw['meta'])} fields, "
                 f"expected {len(TileMeta._fields)} — written by an older tile "
                 "compiler; recompile the network with compile_network()")
-        if raw.get("schema", 1) != 2:
-            raise ValueError(
-                f"{path}: tileset schema {raw.get('schema', 1)} predates the "
-                "node-keyed reach tables; recompile with compile_network()")
         go, cs, gd, ol, ir = raw["meta"]
         meta = TileMeta(tuple(go), float(cs), tuple(gd), tuple(ol), float(ir))
-        return cls(name=raw["name"], meta=meta, stats=raw.get("stats", {}), **arrays)
+        return cls(name=raw["name"], meta=meta, stats=raw.get("stats", {}),
+                   **arrays)
 
     # ---- device staging --------------------------------------------------
 
@@ -215,7 +238,7 @@ class TileSet:
             "seg_pack": jnp.asarray(sp.pack),
             "seg_bbox": jnp.asarray(sp.bbox),
             "edge_len": jnp.asarray(self.edge_len),
-            "edge_dst": jnp.asarray(self.edge_dst),
+            "reach_row": jnp.asarray(self.edge_reach_row),
             "edge_osmlr": jnp.asarray(self.edge_osmlr),
             "reach_to": jnp.asarray(self.reach_to),
             "reach_dist": jnp.asarray(self.reach_dist),

@@ -77,7 +77,7 @@ class TestRouteDistance:
             got = float(route_distance(
                 jnp.int32(e1), jnp.float32(o1), jnp.int32(e2), jnp.float32(o2),
                 tables, backward_slack=0.0))
-            gap = reach_lookup(ts.reach_to, ts.reach_dist, ts.edge_dst, e1, e2)
+            gap = reach_lookup(ts.reach_to, ts.reach_dist, ts.edge_reach_row, e1, e2)
             cross = (float(ts.edge_len[e1]) - o1) + gap + o2
             want = min(o2 - o1, cross) if (e1 == e2 and o2 >= o1) else cross
             if want == np.inf:

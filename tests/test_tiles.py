@@ -149,7 +149,7 @@ def test_reach_tables_match_brute_dijkstra(tiny_tiles, rng):
         # adjacency (dist 0) always present
         for e2 in ts.node_out[u]:
             if e2 >= 0:
-                assert reach_lookup(ts.reach_to, ts.reach_dist, ts.edge_dst,
+                assert reach_lookup(ts.reach_to, ts.reach_dist, ts.edge_reach_row,
                                     e1, int(e2)) == 0.0
 
 
