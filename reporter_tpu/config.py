@@ -119,6 +119,8 @@ class StreamingConfig:
     flush_max_age: float = 30.0    # seconds a buffer may age before forced flush
     speed_bins: tuple[float, ...] = (0., 2.5, 5., 7.5, 10., 12.5, 15., 17.5,
                                      20., 25., 30., 40.)  # m/s histogram edges
+    queue_bins: tuple[float, ...] = (0., 10., 25., 50., 100., 200.,
+                                     400.)  # meters-of-queue histogram edges
     hist_flush_interval: float = 60.0  # seconds between per-segment speed
                                        # histogram flushes to the datastore
                                        # (0 = manual flush only)
@@ -164,9 +166,10 @@ class Config:
                 "flush_min_points must all be >= 1")
         if s.flush_max_age <= 0:
             raise ValueError("streaming.flush_max_age must be > 0")
-        if (len(s.speed_bins) < 1
-                or list(s.speed_bins) != sorted(set(s.speed_bins))):
-            raise ValueError("streaming.speed_bins must be strictly ascending")
+        for bins in ("speed_bins", "queue_bins"):
+            edges = getattr(s, bins)
+            if len(edges) < 1 or list(edges) != sorted(set(edges)):
+                raise ValueError(f"streaming.{bins} must be strictly ascending")
         return self
 
     def to_json(self) -> str:
@@ -176,8 +179,9 @@ class Config:
     def from_json(cls, text: str) -> "Config":
         raw = json.loads(text)
         streaming = dict(raw.get("streaming", {}))
-        if "speed_bins" in streaming:
-            streaming["speed_bins"] = tuple(streaming["speed_bins"])
+        for bins in ("speed_bins", "queue_bins"):
+            if bins in streaming:
+                streaming[bins] = tuple(streaming[bins])
         return cls(
             matcher=MatcherParams(**raw.get("matcher", {})),
             compiler=CompilerParams(**raw.get("compiler", {})),

@@ -60,6 +60,7 @@ class NativeWalker:
             rec_t0 = np.empty(rec_cap, np.float64)
             rec_t1 = np.empty(rec_cap, np.float64)
             rec_len = np.empty(rec_cap, np.float64)
+            rec_queue = np.empty(rec_cap, np.float64)
             rec_internal = np.empty(rec_cap, np.uint8)
             way_off = np.empty(rec_cap + 1, np.int32)
             way_ids = np.empty(way_cap, np.int64)
@@ -83,6 +84,7 @@ class NativeWalker:
                 _ptr(rec_trace, ctypes.c_int32), _ptr(rec_seg, ctypes.c_int64),
                 _ptr(rec_t0, ctypes.c_double), _ptr(rec_t1, ctypes.c_double),
                 _ptr(rec_len, ctypes.c_double),
+                _ptr(rec_queue, ctypes.c_double),
                 _ptr(rec_internal, ctypes.c_uint8), rec_cap,
                 _ptr(way_off, ctypes.c_int32), _ptr(way_ids, ctypes.c_int64),
                 way_cap, ctypes.byref(n_ways))
@@ -100,6 +102,7 @@ class NativeWalker:
         t0_l = rec_t0[:n].tolist()
         t1_l = rec_t1[:n].tolist()
         len_l = rec_len[:n].tolist()
+        queue_l = rec_queue[:n].tolist()
         int_l = rec_internal[:n].tolist()
         off_l = way_off[:n + 1].tolist()
         ways_l = way_ids[:off_l[-1]].tolist() if n else []
@@ -108,7 +111,7 @@ class NativeWalker:
         for r in range(n):
             out[trace_l[r]].append(SegmentRecord(
                 seg_l[r], ways_l[off_l[r]:off_l[r + 1]],
-                t0_l[r], t1_l[r], len_l[r], bool(int_l[r])))
+                t0_l[r], t1_l[r], len_l[r], bool(int_l[r]), queue_l[r]))
         return out
 
 

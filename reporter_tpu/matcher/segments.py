@@ -14,6 +14,7 @@ exactly what the <5% disagreement target compares.
 
 from __future__ import annotations
 
+import bisect
 from dataclasses import dataclass
 from typing import Callable, Iterable
 
@@ -29,6 +30,15 @@ RouteFn = Callable[[int, int], "list[int] | None"]
 # (ops.match.OFFSET_QUANTUM). Must match kMinSpan in native/walker.cc.
 MIN_RECORD_SPAN = 0.25
 
+# Queue dwell model: movement slower than QUEUE_SPEED averaged over a
+# QUEUE_WINDOW trailing span counts as queued traffic. The window absorbs
+# the plateau-then-pulse shape of matched queue points (the decoder snaps
+# creeping points onto one candidate offset, then jumps ~10 m at once —
+# adjacent-pair speeds misread the jump as free flow). Must match
+# kQueueSpeed / kQueueWindow in native/walker.cc.
+QUEUE_SPEED = 2.0    # m/s (~7 km/h stop-and-go creep)
+QUEUE_WINDOW = 10.0  # seconds of trailing window for the speed average
+
 
 @dataclass
 class SegmentRecord:
@@ -40,7 +50,8 @@ class SegmentRecord:
     end_time: float          # -1.0 ⇒ exit not observed yet (partial)
     length: float            # meters of the segment covered by this traversal
     internal: bool           # True for unassociated connector edges
-    queue_length: float = 0.0  # reference schema field; 0 (no signal-queue model)
+    queue_length: float = 0.0  # meters of queued (sub-QUEUE_SPEED) traffic
+    #                            backed up from the segment end (_queue_length)
 
     @property
     def complete(self) -> bool:
@@ -166,6 +177,46 @@ def build_segments(ts: TileSet, chains: Iterable[MatchedChain],
     return records
 
 
+def _queue_length(pts: list[tuple[float, float]], d_tail: float,
+                  seg_len: float) -> float:
+    """Dwell-at-the-stop-line queue model (reference `queue_length` field).
+
+    The reference derives queue signal from probe dwell near segment ends
+    (SURVEY.md §2.2 row 1, §0 item 5): vehicles creeping toward a signal at
+    the end of a segment reveal the queue backed up from the stop line. Walk
+    consecutive matched-point movements backward from the segment tail (path
+    distance ``d_tail``); while each pair moves slower than QUEUE_SPEED the
+    queue extends back to the earlier point. Returns the distance from the
+    segment end to the upstream end of the slow run, clamped to the segment.
+
+    A point extends the queue when the average speed from it to the point
+    QUEUE_WINDOW seconds later (capped at the anchor) stays below
+    QUEUE_SPEED — tested as ``dd < QUEUE_SPEED * dt`` (no division, so
+    dt<=0 spans are never slow). Must stay bit-identical to
+    queue_length() in native/walker.cc.
+    """
+    # Anchor at the LAST point at/before the tail: dwell is evidence about
+    # the approach to the stop line — a point past it is already back in
+    # free flow and would mask the queue. Point distances are monotone
+    # (the walker clamps them), so bisect instead of a linear scan.
+    i = max(0, bisect.bisect_right(pts, d_tail + 1e-6,
+                                   key=lambda p: p[0]) - 1)
+    q_start = d_tail
+    j = i          # window end: min index with time >= cand time + WINDOW
+    k = i
+    while k >= 1:
+        cand = k - 1
+        while j > cand + 1 and pts[j - 1][1] - pts[cand][1] >= QUEUE_WINDOW:
+            j -= 1
+        dd = pts[j][0] - pts[cand][0]
+        dt = pts[j][1] - pts[cand][1]
+        if not dd < QUEUE_SPEED * dt:
+            break
+        q_start = pts[cand][0]
+        k = cand
+    return min(max(d_tail - q_start, 0.0), seg_len)
+
+
 def _path_to_records(ts: TileSet, path: list[int],
                      pts: list[tuple[float, float]]) -> list[SegmentRecord]:
     # cum[i] = path distance at start of path[i]
@@ -210,10 +261,15 @@ def _path_to_records(ts: TileSet, path: list[int],
                 covered_hi = o_start + (c_hi - d_lo)
                 starts_at_origin = covered_lo <= 1.0
                 ends_at_tail = covered_hi >= seg_len - 1.0
+                # Queue needs the stop line observed: only tail-reaching
+                # records carry dwell evidence about the segment end.
+                queue = (_queue_length(pts, d_lo + (seg_len - o_start), seg_len)
+                         if ends_at_tail else 0.0)
                 records.append(SegmentRecord(
                     segment_id=int(ts.osmlr_id[row]), way_ids=way_ids,
                     start_time=_time_at(pts, c_lo) if starts_at_origin else -1.0,
                     end_time=_time_at(pts, c_hi) if ends_at_tail else -1.0,
-                    length=covered_hi - covered_lo, internal=False))
+                    length=covered_hi - covered_lo, internal=False,
+                    queue_length=queue))
         i = j + 1
     return records

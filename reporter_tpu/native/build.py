@@ -100,7 +100,7 @@ def load_native_lib() -> "ctypes.CDLL | None":
         i32p,                                        # reach_row (edge → row)
         i32p, f32p, i32p, ctypes.c_int32,            # reach_{to,dist,next}, M
         ctypes.c_double, ctypes.c_int32,             # backward_slack, n_threads
-        i32p, i64p, f64p, f64p, f64p, u8p,           # record columns
+        i32p, i64p, f64p, f64p, f64p, f64p, u8p,     # record columns
         ctypes.c_int64,                              # rec_cap
         i32p, i64p, ctypes.c_int64,                  # way_off, way_ids, way_cap
         i64p,                                        # n_ways_out

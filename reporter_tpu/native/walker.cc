@@ -30,12 +30,46 @@ namespace {
 // are float noise; both walkers must agree on the emission threshold.
 constexpr double kMinSpan = 0.25;
 
+// matcher/segments.QUEUE_SPEED / QUEUE_WINDOW: movement slower than
+// kQueueSpeed averaged over a kQueueWindow trailing span counts as queued
+// traffic (dwell-at-the-stop-line model; the window absorbs the decoder's
+// plateau-then-pulse shape for creeping points).
+constexpr double kQueueSpeed = 2.0;
+constexpr double kQueueWindow = 10.0;
+
 struct Record {
   int64_t seg_id;
-  double t0, t1, length;
+  double t0, t1, length, queue;
   bool internal;
   std::vector<int64_t> way_ids;
 };
+
+// matcher/segments._queue_length: queue backed up from the segment tail.
+// Walk points backward from the tail anchor; a point extends the queue when
+// the average speed over the kQueueWindow span after it (capped at the
+// anchor) is below kQueueSpeed (dd < kQueueSpeed*dt — divisionless, so
+// dt<=0 spans are never slow). Clamped to [0, seg_len].
+double queue_length(const std::vector<double>& pd,
+                    const std::vector<double>& pt, double d_tail,
+                    double seg_len) {
+  // Anchor at the LAST point at/before the tail (segments.py parity);
+  // point distances are monotone, so binary-search the anchor.
+  size_t i = std::upper_bound(pd.begin(), pd.end(), d_tail + 1e-6) -
+             pd.begin();
+  i = (i == 0) ? 0 : i - 1;
+  double q_start = d_tail;
+  size_t j = i, k = i;  // j: min index with time >= cand time + window
+  while (k >= 1) {
+    size_t cand = k - 1;
+    while (j > cand + 1 && pt[j - 1] - pt[cand] >= kQueueWindow) --j;
+    double dd = pd[j] - pd[cand];
+    double dt = pt[j] - pt[cand];
+    if (!(dd < kQueueSpeed * dt)) break;
+    q_start = pd[cand];
+    k = cand;
+  }
+  return std::min(std::max(d_tail - q_start, 0.0), seg_len);
+}
 
 struct Tile {
   const float* edge_len;
@@ -130,6 +164,7 @@ void path_to_records(const Tile& t, const std::vector<int32_t>& path,
         r.t0 = time_at(pd, pt, c_lo);
         r.t1 = time_at(pd, pt, c_hi);
         r.length = c_hi - c_lo;
+        r.queue = 0.0;
         r.internal = true;
       } else {
         double o_start = static_cast<double>(t.edge_osmlr_off[path[i]]);
@@ -142,6 +177,11 @@ void path_to_records(const Tile& t, const std::vector<int32_t>& path,
         r.t0 = starts_at_origin ? time_at(pd, pt, c_lo) : -1.0;
         r.t1 = ends_at_tail ? time_at(pd, pt, c_hi) : -1.0;
         r.length = covered_hi - covered_lo;
+        // Queue needs the stop line observed (matcher/segments.py parity).
+        r.queue = ends_at_tail
+                      ? queue_length(pd, pt, d_lo + (seg_len - o_start),
+                                     seg_len)
+                      : 0.0;
         r.internal = false;
       }
       out.push_back(std::move(r));
@@ -236,7 +276,8 @@ int64_t reporter_walk_segments(
     const int32_t* reach_next, int32_t reach_m,
     double backward_slack, int32_t n_threads,
     int32_t* rec_trace, int64_t* rec_seg, double* rec_t0, double* rec_t1,
-    double* rec_len, uint8_t* rec_internal, int64_t rec_cap,
+    double* rec_len, double* rec_queue, uint8_t* rec_internal,
+    int64_t rec_cap,
     int32_t* way_off, int64_t* way_ids, int64_t way_cap,
     int64_t* n_ways_out) {
   Tile tile{edge_len,  edge_way,  edge_osmlr, edge_osmlr_off, osmlr_id,
@@ -273,6 +314,7 @@ int64_t reporter_walk_segments(
           rec_t0[nrec] = r.t0;
           rec_t1[nrec] = r.t1;
           rec_len[nrec] = r.length;
+          rec_queue[nrec] = r.queue;
           rec_internal[nrec] = r.internal ? 1 : 0;
           way_off[nrec] = static_cast<int32_t>(nway);
           std::memcpy(way_ids + nway, r.way_ids.data(),

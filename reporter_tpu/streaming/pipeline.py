@@ -70,9 +70,14 @@ class StreamPipeline:
         self._consumed = [0] * sc.num_partitions   # read position (ahead of committed)
         self._buffers: dict[str, _Buffer] = {}
         self.hist = SpeedHistogram(len(tileset.osmlr_id), sc.speed_bins)
+        # Same device-resident accumulator, binned by queue_length (meters
+        # backed up from the stop line) — every report contributes one
+        # observation, so bin 0 counts queue-free traversals too.
+        self.qhist = SpeedHistogram(len(tileset.osmlr_id), sc.queue_bins)
         self._row_of = {int(sid): i for i, sid in enumerate(tileset.osmlr_id)}
         self._osmlr_ids = np.asarray(tileset.osmlr_id)
         self._hist_flushed = self.hist.snapshot()   # delta-flush baseline
+        self._qhist_flushed = self.qhist.snapshot()
         self._hist_flush_at = self.clock()
         self.hist_flushes = 0
         self.steps = 0
@@ -147,6 +152,7 @@ class StreamPipeline:
         n = 0
         rows: list[int] = []
         speeds: list[float] = []
+        queues: list[float] = []
         for res in results:
             reports = res["reports"]
             n += len(reports)
@@ -156,8 +162,10 @@ class StreamPipeline:
                     continue
                 rows.append(self._row_of.get(int(r["id"]), -1))
                 speeds.append(r["length"] / dur)
-        self.hist.update(np.asarray(rows, np.int32),
-                         np.asarray(speeds, np.float64))
+                queues.append(r["queue_length"])
+        rows_arr = np.asarray(rows, np.int32)
+        self.hist.update(rows_arr, np.asarray(speeds, np.float64))
+        self.qhist.update(rows_arr, np.asarray(queues, np.float64))
         return n
 
     def _commit(self) -> None:
@@ -176,10 +184,13 @@ class StreamPipeline:
         of segments flushed. The baseline advances only on successful
         publish, so a failed POST retries the same delta next interval."""
         snap = self.hist.snapshot()
+        qsnap = self.qhist.snapshot()
         delta = snap - self._hist_flushed
+        qdelta = qsnap - self._qhist_flushed
         rows = np.nonzero(delta.sum(axis=1))[0]
+        qrows = np.nonzero(qdelta.sum(axis=1))[0]
         self._hist_flush_at = self.clock()
-        if not len(rows):
+        if not len(rows) and not len(qrows):
             return 0
         payload = {
             "mode": self.config.service.mode,
@@ -189,9 +200,16 @@ class StreamPipeline:
                  "counts": delta[r].astype(int).tolist()}
                 for r in rows
             ],
+            "queue_bin_edges_m": list(self.config.streaming.queue_bins),
+            "queue_histograms": [
+                {"segment_id": int(self._osmlr_ids[r]),
+                 "counts": qdelta[r].astype(int).tolist()}
+                for r in qrows
+            ],
         }
         if self.app.publisher.publish_json(payload):
             self._hist_flushed = snap
+            self._qhist_flushed = qsnap
             self.hist_flushes += 1
             return int(len(rows))
         return 0
@@ -232,7 +250,9 @@ class StreamPipeline:
             path,
             state=np.frombuffer(json.dumps(state).encode(), dtype=np.uint8),
             hist=self.hist.snapshot(),
-            hist_flushed=self._hist_flushed)
+            hist_flushed=self._hist_flushed,
+            qhist=self.qhist.snapshot(),
+            qhist_flushed=self._qhist_flushed)
 
     def restore(self, path: str) -> None:
         """Reset to a checkpoint; consumption resumes at the committed
@@ -248,6 +268,12 @@ class StreamPipeline:
                 self._hist_flushed = z["hist_flushed"]
             else:   # older checkpoint: re-flush everything (at-least-once)
                 self._hist_flushed = np.zeros_like(self.hist.snapshot())
+            if "qhist" in z.files:
+                self.qhist.load(z["qhist"])
+                self._qhist_flushed = z["qhist_flushed"]
+            else:   # pre-queue checkpoint: start the queue track empty
+                self.qhist.load(np.zeros_like(self.qhist.snapshot()))
+                self._qhist_flushed = self.qhist.snapshot()
         self.committed = list(state["committed"])
         self._consumed = list(state["committed"])
         self._buffers = {}

@@ -225,8 +225,14 @@ def build_reach_tables_restricted(
 
     if base is not None and node_xy is not None:
         via = np.asarray(sorted({int(edge_dst[a]) for a, _ in banned}))
-        d2 = ((node_xy[:, None, :] - node_xy[via][None, :, :]) ** 2).sum(-1)
-        affected = np.nonzero((d2.min(axis=1) <= radius * radius))[0]
+        # Running min over via nodes: O(N) memory (an [N, V, 2] broadcast
+        # would peak at tens of GB on a metro extract with thousands of
+        # restrictions — the exact compiles this fast path exists for).
+        d2_min = np.full(len(node_xy), np.inf)
+        for v in via:
+            dv = node_xy - node_xy[int(v)]
+            np.minimum(d2_min, (dv * dv).sum(-1), out=d2_min)
+        affected = np.nonzero(d2_min <= radius * radius)[0]
     else:
         affected = np.arange(num_nodes)
 

@@ -196,3 +196,110 @@ class TestMatchTopK:
         # quantization; topk reports raw lattice choices — the best
         # alternate's edges must all appear in the primary decode
         assert best <= primary
+
+
+class TestQueueLength:
+    """Dwell-at-the-stop-line queue model (reference schema queue_length)."""
+
+    @staticmethod
+    def _profile_probe(ts, path, speeds_and_spans, uuid, sigma=0.5):
+        """Sample a drive whose speed varies along the path.
+
+        speeds_and_spans: list of (speed m/s, span meters) phases; samples at
+        dt=1s with small GPS noise so the matched offsets track ground truth.
+        """
+        from reporter_tpu.geometry import xy_to_lonlat
+        from reporter_tpu.netgen.traces import _EdgeShapeCache
+
+        cum = np.concatenate(
+            [[0.0], np.cumsum(ts.edge_len[path].astype(np.float64))])
+        cache = _EdgeShapeCache(ts)
+        rng = np.random.default_rng(99)
+        d, dists = 0.0, [0.0]
+        for speed, span in speeds_and_spans:
+            end = min(d + span, float(cum[-1]) - 1e-3)
+            while d < end:
+                d = min(d + speed, end)
+                dists.append(d)
+        xs = []
+        for s in dists:
+            k = int(np.searchsorted(cum, s, side="right") - 1)
+            k = max(0, min(k, len(path) - 1))
+            xs.append(cache.point_at(path[k], s - cum[k]))
+        xy = np.asarray(xs, np.float64) + rng.normal(0.0, sigma, (len(xs), 2))
+        times = np.arange(len(dists), dtype=np.float64)
+        lonlat = xy_to_lonlat(xy, np.asarray(ts.meta.origin_lonlat))
+        return {"uuid": uuid,
+                "trace": [{"lat": float(la), "lon": float(lo),
+                           "time": float(t)}
+                          for (lo, la), t in zip(lonlat, times)]}
+
+    @staticmethod
+    def _tail_boundary(ts, path):
+        """(d_tail, segment_id, seg_len) of the first OSMLR segment whose
+        tail falls mid-path (far enough in for a fast approach phase)."""
+        cum = np.concatenate(
+            [[0.0], np.cumsum(ts.edge_len[path].astype(np.float64))])
+        for k, e in enumerate(path):
+            row = int(ts.edge_osmlr[e])
+            if row < 0:
+                continue
+            at_tail = (float(ts.edge_osmlr_off[e]) + float(ts.edge_len[e])
+                       >= float(ts.osmlr_len[row]) - 1.0)
+            if at_tail and 250.0 <= cum[k + 1] <= cum[-1] - 120.0:
+                return float(cum[k + 1]), int(ts.osmlr_id[row]), float(
+                    ts.osmlr_len[row])
+        return None
+
+    def test_stop_and_go_reports_queue(self, matchers, short_seg_tiles):
+        from reporter_tpu.netgen.traces import random_walk_edges
+
+        ts = short_seg_tiles
+        mj, mc = matchers
+        rng = np.random.default_rng(31)
+        for attempt in range(20):
+            path = random_walk_edges(ts, rng, 900.0)
+            hit = self._tail_boundary(ts, path)
+            if hit:
+                break
+        assert hit, "no usable mid-path segment tail found"
+        d_tail, seg_id, seg_len = hit
+        crawl = 80.0
+
+        # Fast approach, crawl (1 m/s < QUEUE_SPEED) through the last 80 m
+        # before the stop line and a little past it, then fast again.
+        jam = self._profile_probe(ts, path, [
+            (12.0, d_tail - crawl), (1.0, crawl + 10.0), (12.0, 1e9)], "jam")
+        free = self._profile_probe(ts, path, [(12.0, 1e9)], "free")
+
+        expect = min(crawl, seg_len)
+        for m in (mj, mc):
+            segs = {s["segment_id"]: s for s in m.match(jam)["segments"]}
+            assert seg_id in segs, "jam drive must report the tail segment"
+            q = segs[seg_id]["queue_length"]
+            assert 0.5 * expect <= q <= 1.5 * expect + 5.0, (
+                f"queue {q:.1f}m vs expected ~{expect:.0f}m")
+            free_segs = {s["segment_id"]: s
+                         for s in m.match(free)["segments"]}
+            assert free_segs[seg_id]["queue_length"] == 0.0
+
+    def test_queue_clamped_to_segment(self, matchers, short_seg_tiles):
+        """A crawl longer than the segment cannot report more queue than
+        the segment has length."""
+        from reporter_tpu.netgen.traces import random_walk_edges
+
+        ts = short_seg_tiles
+        mj, _ = matchers
+        rng = np.random.default_rng(77)
+        for attempt in range(20):
+            path = random_walk_edges(ts, rng, 900.0)
+            hit = self._tail_boundary(ts, path)
+            if hit:
+                break
+        assert hit
+        d_tail, seg_id, seg_len = hit
+        jam = self._profile_probe(ts, path, [(1.5, d_tail + 10.0),
+                                             (12.0, 1e9)], "alljam")
+        segs = {s["segment_id"]: s for s in mj.match(jam)["segments"]}
+        assert seg_id in segs
+        assert segs[seg_id]["queue_length"] <= seg_len + 1e-6

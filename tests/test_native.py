@@ -99,6 +99,25 @@ def test_min_record_span_constants_agree():
     assert float(m.group(1)) == MIN_RECORD_SPAN
 
 
+def test_queue_speed_constants_agree():
+    """The queue dwell threshold must match across walkers or queue_length
+    diverges between the native and Python paths."""
+    import re
+
+    from reporter_tpu.matcher.segments import QUEUE_SPEED, QUEUE_WINDOW
+
+    src = os.path.join(os.path.dirname(__file__), "..", "reporter_tpu",
+                       "native", "walker.cc")
+    with open(src) as f:
+        text = f.read()
+    m = re.search(r"kQueueSpeed\s*=\s*([0-9.]+)", text)
+    assert m, "kQueueSpeed not found in walker.cc"
+    assert float(m.group(1)) == QUEUE_SPEED
+    m = re.search(r"kQueueWindow\s*=\s*([0-9.]+)", text)
+    assert m, "kQueueWindow not found in walker.cc"
+    assert float(m.group(1)) == QUEUE_WINDOW
+
+
 class TestNativeWalker:
     """walker.cc vs the Python segment walk — exact record parity."""
 
@@ -136,6 +155,6 @@ class TestNativeWalker:
                 assert a.way_ids == c.way_ids, f"trace {b}"
                 assert a.internal == c.internal, f"trace {b}"
                 np.testing.assert_allclose(
-                    [a.start_time, a.end_time, a.length],
-                    [c.start_time, c.end_time, c.length],
+                    [a.start_time, a.end_time, a.length, a.queue_length],
+                    [c.start_time, c.end_time, c.length, c.queue_length],
                     rtol=1e-9, atol=1e-9, err_msg=f"trace {b}")
