@@ -149,10 +149,16 @@ class SegmentMatcher:
     def match_topk(self, trace: Trace,
                    ) -> list[tuple[float, list[MatchedPoint]]]:
         """K-best path interpretations of one trace (Meili TopKSearch
-        analog; see ops.hmm.viterbi_topk_paths for the exact semantics).
-        Returns (score, per-point matches) ranked best-first; jax backend
-        only. Diagnostic surface — the reporting pipeline uses the best
-        path."""
+        analog). Contract (oracle-pinned by tests/test_topk_oracle.py):
+        the best path is the exact global optimum; each alternate is the
+        exact optimal path ending at one of the final chain's terminal
+        candidates, ranked by cost — a subset of true K-best (alternates
+        that differ only before the terminal are not enumerated; Meili's
+        penalized re-search can return those). jax backend only — the
+        reference_cpu backend raises NotImplementedError by contract (it
+        exists as a fidelity oracle for the primary path, and its own
+        oracle for TopK is the exact list-Viterbi in the test above).
+        Diagnostic surface — the reporting pipeline uses the best path."""
         if self.backend != "jax":
             raise NotImplementedError("match_topk requires the jax backend")
         import jax.numpy as jnp

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Any, NamedTuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -32,7 +33,12 @@ from reporter_tpu.tiles.tileset import (
     PACK_AX, PACK_AY, PACK_BX, PACK_BY, PACK_EDGE, PACK_LEN, PACK_NCOMP,
     PACK_OFF, TileMeta)
 
-BIG = jnp.float32(1e30)   # "infinity" that survives subtraction without NaNs
+# "infinity" that survives subtraction without NaNs. A numpy scalar, NOT a
+# jnp array: materializing a device array at import time initializes the
+# XLA backend, which breaks jax.distributed.initialize() for any process
+# that imports this package before joining the process group
+# (parallel/multihost.py). Behaves identically inside jitted code.
+BIG = np.float32(1e30)
 
 
 class GridMeta(NamedTuple):

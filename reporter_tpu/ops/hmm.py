@@ -424,7 +424,10 @@ def viterbi_topk_paths(cands: CandidateSet, points, valid_pt, tables,
     terminal completion is the standard single-pass K-best Viterbi
     approximation — alternates differ in the suffix, which for map matching
     is where the ambiguity that TopK serves lives: parallel roads at the
-    trace's end.)
+    trace's end.) tests/test_topk_oracle.py pins this contract against an
+    exact list-Viterbi: rank 0 is the global optimum, every alternate is
+    the exact optimal completion for its terminal, and true K-best
+    dominates the returned ranking element-wise.
 
     Returns (choice [K, T] i32 candidate slots (-1 unmatched), score [K]
     f32 accumulated cost, valid [K] bool), ranked best-first.

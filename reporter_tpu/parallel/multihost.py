@@ -1,0 +1,81 @@
+"""Multi-host bootstrap — the process-group half of DISTRIBUTED.md.
+
+The reference scales out as independent Kafka consumers; the TPU build
+scales out as JAX processes whose devices join ONE global mesh (DISTRIBUTED
+.md "Multi-host"): `jax.distributed.initialize()` per host, then the same
+`parallel.mesh.make_mesh` axes — `jax.devices()` spans every host's chips
+after initialization, so the sharded programs in `parallel/` run unchanged.
+
+This module is the bootstrap seam: explicit args, or environment variables
+(the k8s/compose shape — each replica gets the same manifest plus its
+ordinal):
+
+  REPORTER_TPU_COORDINATOR    host:port of process 0 (e.g. "tpu-0:8476")
+  REPORTER_TPU_NUM_PROCESSES  total process count
+  REPORTER_TPU_PROCESS_ID     this process's ordinal (0-based)
+
+Single-process (none of the above set) is a no-op — the local devices
+already form the whole mesh. tests/test_parallel.py exercises the real
+single-process initialize() path in a subprocess (coordinator service,
+client handshake, mesh over the global device list); multi-host needs real
+DCN and is design-validated only (STATUS.md limitation).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("reporter_tpu.multihost")
+
+
+def initialize_multihost(coordinator: "str | None" = None,
+                         num_processes: "int | None" = None,
+                         process_id: "int | None" = None) -> bool:
+    """Join (or host) the JAX process group; True iff initialized.
+
+    Falls back to REPORTER_TPU_* env vars for unset args. Returns False in
+    single-process mode (nothing to join). Must run before the first
+    device query in the process (jax.distributed's own requirement).
+    """
+    env = os.environ
+    coordinator = coordinator or env.get("REPORTER_TPU_COORDINATOR") or None
+    if num_processes is None and env.get("REPORTER_TPU_NUM_PROCESSES"):
+        num_processes = int(env["REPORTER_TPU_NUM_PROCESSES"])
+    if process_id is None and env.get("REPORTER_TPU_PROCESS_ID"):
+        process_id = int(env["REPORTER_TPU_PROCESS_ID"])
+
+    if coordinator is None:
+        if num_processes not in (None, 1):
+            raise ValueError(
+                f"num_processes={num_processes} but no coordinator address "
+                "(set REPORTER_TPU_COORDINATOR on every process)")
+        return False
+    # jax can infer num_processes/process_id from TPU pod metadata, but
+    # this deployment shape has none (remote-attached chips / CPU hosts) —
+    # require both explicitly so a mis-templated manifest fails HERE with
+    # a clear message, not deep inside the JAX handshake.
+    if num_processes is None or process_id is None:
+        raise ValueError(
+            "coordinator set but num_processes/process_id missing (set "
+            "REPORTER_TPU_NUM_PROCESSES and the per-replica "
+            "REPORTER_TPU_PROCESS_ID)")
+
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    log.info("joined process group: process %d/%d via %s",
+             jax.process_index(), jax.process_count(), coordinator)
+    return True
+
+
+def shutdown_multihost() -> None:
+    """Leave the process group (idempotent; no-op when never joined)."""
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError:
+        pass  # never initialized

@@ -1,0 +1,59 @@
+"""ProbeConsumer — the broker-consumer protocol StreamPipeline depends on.
+
+The reference consumes probe records from Kafka (SURVEY.md §3.3); this
+environment has no broker, so the in-proc ``IngestQueue`` stands in. The
+seam between the two is this protocol: everything the matcher worker needs
+from a broker is offset-addressed polling over a fixed partition count.
+An external adapter (kafka-python / confluent-kafka / PubSub) plugs into
+``StreamPipeline(queue=...)`` by implementing these three members — no
+pipeline changes:
+
+  =================  ================================================
+  protocol member    Kafka equivalent
+  =================  ================================================
+  num_partitions     partition count of the subscribed topic
+  poll(p, off, n)    seek(TopicPartition(p), off) + poll(max_records=n)
+  end_offset(p)      end_offsets([TopicPartition(p)])
+  =================  ================================================
+
+Offset semantics the pipeline relies on (contract-tested by
+tests/test_broker_contract.py, which external adapters should reuse):
+
+- Offsets are per-partition, dense, and stable: the record first seen at
+  (p, off) is returned for every later poll covering off (replay is the
+  recovery mechanism — at-least-once delivery).
+- ``poll`` returns records in offset order, at most ``max_records``,
+  starting at exactly ``offset``; an empty list past the end.
+- ``end_offset`` is one past the last record (== the next offset to be
+  assigned), so ``end_offset - committed`` is the lag.
+- Polling below the retention floor raises ``LookupError`` (Kafka's
+  OffsetOutOfRange) — the pipeline treats that as unrecoverable data loss
+  rather than silently skipping.
+
+Commit state intentionally lives in StreamPipeline (its commit floor is
+the oldest *unflushed* record, a property of the matcher's buffers, not of
+the broker); an adapter that mirrors commits to the broker's consumer
+group can read ``pipeline.committed`` after each step.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class ProbeConsumer(Protocol):
+    """What StreamPipeline polls (see module docstring for semantics)."""
+
+    num_partitions: int
+
+    def poll(self, partition: int, offset: int,
+             max_records: int) -> "list[tuple[int, dict]]":
+        """Records at/after ``offset`` as [(offset, record)...], in offset
+        order, at most ``max_records``; raises LookupError below the
+        retention floor."""
+        ...
+
+    def end_offset(self, partition: int) -> int:
+        """One past the last record of the partition."""
+        ...

@@ -25,6 +25,7 @@ import numpy as np
 from reporter_tpu.config import Config
 from reporter_tpu.service.app import ReporterApp
 from reporter_tpu.service.datastore import Transport
+from reporter_tpu.streaming.broker import ProbeConsumer
 from reporter_tpu.streaming.histogram import SpeedHistogram
 from reporter_tpu.streaming.queue import IngestQueue
 from reporter_tpu.tiles.tileset import TileSet
@@ -43,13 +44,16 @@ class StreamPipeline:
     """Single-worker streaming matcher over an IngestQueue."""
 
     def __init__(self, tileset: TileSet, config: Config | None = None,
-                 queue: IngestQueue | None = None,
+                 queue: ProbeConsumer | None = None,
                  transport: Transport | None = None,
                  clock=time.monotonic,
                  partitions: "Sequence[int] | None" = None):
         self.config = (config or Config()).validate()
         sc = self.config.streaming
-        self.queue = queue or IngestQueue(sc.num_partitions)
+        # Any ProbeConsumer works here (streaming/broker.py): the in-proc
+        # IngestQueue is the default; an external Kafka/PubSub adapter
+        # implementing the same poll/end_offset surface drops in.
+        self.queue: ProbeConsumer = queue or IngestQueue(sc.num_partitions)
         if self.queue.num_partitions != sc.num_partitions:
             raise ValueError("queue/config partition count mismatch")
         # Partition assignment (Kafka consumer-group analog, SURVEY.md §3.3):
@@ -211,7 +215,9 @@ class StreamPipeline:
             self._hist_flushed = snap
             self._qhist_flushed = qsnap
             self.hist_flushes += 1
-            return int(len(rows))
+            # Count any segment with a published delta (speed OR queue):
+            # callers use 0 to mean "nothing flushed / publish failed".
+            return int(len(np.union1d(rows, qrows)))
         return 0
 
     # ---- observability ---------------------------------------------------

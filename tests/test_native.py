@@ -141,6 +141,11 @@ class TestNativeWalker:
         # teleport a jump into a few traces to force chain breaks
         for tr in traces[::4]:
             tr.xy[len(tr.xy) // 2:] += np.float32(2500.0)
+        # stretch some traces' timestamps so they crawl below QUEUE_SPEED:
+        # the parity sweep must cover NONZERO queue_length too, or the two
+        # queue implementations could diverge unnoticed.
+        for tr in traces[1::4]:
+            tr.times = tr.times * 25.0
 
         m = SegmentMatcher(ts, Config(matcher_backend="jax"))
         native = m.match_many(traces)              # native walker path
@@ -148,6 +153,8 @@ class TestNativeWalker:
         python = m.match_many(traces)              # python walk fallback
 
         assert len(native) == len(python)
+        assert any(r.queue_length > 0 for recs in python for r in recs), \
+            "sweep exercised no nonzero queue — queue parity untested"
         for b, (rn, rp) in enumerate(zip(native, python)):
             assert len(rn) == len(rp), f"trace {b}: {len(rn)} vs {len(rp)}"
             for a, c in zip(rn, rp):

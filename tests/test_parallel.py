@@ -259,3 +259,68 @@ class TestDenseBackendSharded:
         out, hist = step(jnp.asarray(points), jnp.asarray(valid))
         assert bool(np.asarray(out.matched).any())
         assert int(np.asarray(hist).sum()) > 0
+
+
+class TestMultihostBootstrap:
+    """parallel/multihost.py — the DISTRIBUTED.md process-group seam."""
+
+    def test_single_process_is_noop(self, monkeypatch):
+        from reporter_tpu.parallel.multihost import initialize_multihost
+
+        for var in ("REPORTER_TPU_COORDINATOR", "REPORTER_TPU_NUM_PROCESSES",
+                    "REPORTER_TPU_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+        assert initialize_multihost() is False
+
+    def test_num_processes_without_coordinator_rejected(self, monkeypatch):
+        from reporter_tpu.parallel.multihost import initialize_multihost
+
+        monkeypatch.delenv("REPORTER_TPU_COORDINATOR", raising=False)
+        with pytest.raises(ValueError):
+            initialize_multihost(num_processes=4)
+
+    def test_real_initialize_and_mesh(self):
+        """Exercise the REAL jax.distributed.initialize() path (coordinator
+        service + client handshake) in a subprocess: a 1-process group over
+        8 virtual devices must build the mesh and run the histogram psum.
+        Subprocess because initialize() permanently binds the process's
+        runtime state."""
+        import os
+        import subprocess
+        import sys
+
+        code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["REPORTER_TPU_COORDINATOR"] = "localhost:18476"
+os.environ["REPORTER_TPU_NUM_PROCESSES"] = "1"
+os.environ["REPORTER_TPU_PROCESS_ID"] = "0"
+from reporter_tpu.parallel.multihost import initialize_multihost
+assert initialize_multihost() is True
+import jax
+jax.config.update("jax_platforms", "cpu")
+assert jax.process_count() == 1
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+from reporter_tpu.parallel.mesh import make_mesh
+mesh = make_mesh(tile=2, dp=4)
+f = shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+              in_specs=P("dp"), out_specs=P())
+out = f(jnp.ones((8, 4), jnp.int32))
+assert int(out.sum()) == 8 * 4
+from reporter_tpu.parallel.multihost import shutdown_multihost
+shutdown_multihost()
+print("MULTIHOST-OK")
+"""
+        # PYTHONPATH: repo root ONLY — the image's axon sitecustomize
+        # initializes the XLA backend at interpreter start, which
+        # jax.distributed.initialize() forbids; a CPU-only process group
+        # doesn't need the TPU tunnel anyway.
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=180,
+            env={**os.environ, "PYTHONPATH": os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))})
+        assert "MULTIHOST-OK" in proc.stdout, proc.stderr[-2000:]
