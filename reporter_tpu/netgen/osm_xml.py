@@ -1,11 +1,12 @@
-"""Minimal OSM XML parser → RoadNetwork.
+"""OSM XML parser → RoadNetwork (+ the element→graph builder PBF shares).
 
 Capability-parity stand-in for the front of the reference's offline pipeline
 (SURVEY.md §3.4: OSM extract → valhalla_build_tiles). Supports the subset
 needed to build a drivable graph: <node> elements and <way> elements tagged
 ``highway=*`` from a drivable whitelist, with ``oneway`` and ``maxspeed``
-handling. PBF input is out of scope (no protobuf OSM fixtures available here);
-the XML path exercises the same compiler.
+handling, plus ``type=restriction`` relations. ``build_network`` is the
+format-independent half: netgen/pbf.py decodes .osm.pbf into the same raw
+elements and builds through it, so both formats produce identical graphs.
 """
 
 from __future__ import annotations
@@ -52,18 +53,43 @@ def parse_osm_xml(source: str, name: str = "osm") -> RoadNetwork:
     for nd in root.iter("node"):
         node_pos[int(nd.get("id"))] = (float(nd.get("lon")), float(nd.get("lat")))
 
-    raw_ways: list[tuple[int, list[int], dict[str, str]]] = []
-    for w in root.iter("way"):
-        tags = {t.get("k"): t.get("v") for t in w.findall("tag")}
+    raw_ways = [(int(w.get("id")),
+                 [int(nd.get("ref")) for nd in w.findall("nd")],
+                 {t.get("k"): t.get("v") for t in w.findall("tag")})
+                for w in root.iter("way")]
+
+    raw_relations = []
+    for rel in root.iter("relation"):
+        tags = {t.get("k"): t.get("v") for t in rel.findall("tag")}
+        members = [(m.get("role"), m.get("type"), int(m.get("ref")))
+                   for m in rel.findall("member")]
+        raw_relations.append((tags, members))
+
+    return build_network(node_pos, raw_ways, raw_relations, name)
+
+
+def build_network(
+    node_pos: "dict[int, tuple[float, float]]",
+    raw_ways: "list[tuple[int, list[int], dict[str, str]]]",
+    raw_relations: "list[tuple[dict[str, str], list[tuple[str, str, int]]]]",
+    name: str = "osm",
+) -> RoadNetwork:
+    """Raw OSM elements → RoadNetwork (shared by the XML and PBF parsers).
+
+    node_pos: osm node id → (lon, lat); raw_ways: (way id, node refs,
+    tags); raw_relations: (tags, [(role, member type, ref)...]).
+    """
+    drivable: list[tuple[int, list[int], dict[str, str]]] = []
+    for way_id, refs, tags in raw_ways:
         if tags.get("highway") not in DRIVABLE_HIGHWAY:
             continue
-        refs = [int(nd.get("ref")) for nd in w.findall("nd")]
         refs = [r for r in refs if r in node_pos]
         # Real extracts contain duplicate consecutive refs; they would become
         # zero-length edges, which the compiler forbids (edge_len > 0).
         refs = [r for i, r in enumerate(refs) if i == 0 or r != refs[i - 1]]
         if len(refs) >= 2:
-            raw_ways.append((int(w.get("id")), refs, tags))
+            drivable.append((way_id, refs, tags))
+    raw_ways = drivable
 
     # Keep only nodes referenced by drivable ways; remap to dense indices.
     used: dict[int, int] = {}
@@ -89,21 +115,18 @@ def parse_osm_xml(source: str, name: str = "osm") -> RoadNetwork:
         )
         drivable_way_ids.add(way_id)
 
-    # Turn restrictions: <relation> tagged type=restriction with way/from,
+    # Turn restrictions: relations tagged type=restriction with way/from,
     # node/via, way/to members (SURVEY.md §3.4 — Valhalla's complex
     # restrictions; via-WAY relations are rare and dropped here).
     restrictions: list[TurnRestriction] = []
-    for rel in root.iter("relation"):
-        tags = {t.get("k"): t.get("v") for t in rel.findall("tag")}
+    for tags, members in raw_relations:
         if tags.get("type") != "restriction":
             continue
         kind = tags.get("restriction", "")
         if not (kind.startswith("no_") or kind.startswith("only_")):
             continue
         frm = via = to = None
-        for m in rel.findall("member"):
-            role, mtype = m.get("role"), m.get("type")
-            ref = int(m.get("ref"))
+        for role, mtype, ref in members:
             if role == "from" and mtype == "way":
                 frm = ref
             elif role == "via" and mtype == "node":

@@ -46,10 +46,14 @@ def initialize_multihost(coordinator: "str | None" = None,
         process_id = int(env["REPORTER_TPU_PROCESS_ID"])
 
     if coordinator is None:
-        if num_processes not in (None, 1):
+        # Half-configured is the dangerous state: any group-shaped setting
+        # without a coordinator means a typoed manifest, and silently
+        # booting N disjoint single-process meshes would hide it.
+        if num_processes not in (None, 1) or process_id is not None:
             raise ValueError(
-                f"num_processes={num_processes} but no coordinator address "
-                "(set REPORTER_TPU_COORDINATOR on every process)")
+                f"num_processes={num_processes} / process_id={process_id} "
+                "but no coordinator address (set REPORTER_TPU_COORDINATOR "
+                "on every process)")
         return False
     # jax can infer num_processes/process_id from TPU pod metadata, but
     # this deployment shape has none (remote-attached chips / CPU hosts) —

@@ -51,8 +51,9 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m reporter_tpu.tiles")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    b = sub.add_parser("build", help="compile an OSM XML extract")
-    b.add_argument("--osm", required=True, help="OSM XML file (.osm/.xml)")
+    b = sub.add_parser("build", help="compile an OSM extract (XML or PBF)")
+    b.add_argument("--osm", required=True,
+                   help="OSM file (.osm/.xml or .osm.pbf/.pbf)")
     b.add_argument("--name", default=None, help="tileset name")
     _add_compiler_flags(b)
 
@@ -91,10 +92,15 @@ def main(argv: list[str] | None = None) -> int:
     from reporter_tpu.tiles.compiler import compile_network
 
     if args.cmd == "build":
-        from reporter_tpu.netgen.osm_xml import parse_osm_xml
-
         name = args.name or args.osm.rsplit("/", 1)[-1].split(".")[0]
-        net = parse_osm_xml(args.osm, name=name)
+        if args.osm.endswith(".pbf"):
+            from reporter_tpu.netgen.pbf import parse_osm_pbf
+
+            net = parse_osm_pbf(args.osm, name=name)
+        else:
+            from reporter_tpu.netgen.osm_xml import parse_osm_xml
+
+            net = parse_osm_xml(args.osm, name=name)
     else:
         from reporter_tpu.netgen.synthetic import generate_city
 

@@ -1,0 +1,171 @@
+"""OSM PBF reader/writer (netgen/pbf.py) vs the XML parser.
+
+The contract: an extract serialized as .osm.pbf parses to the SAME
+RoadNetwork as its XML form — both feed osm_xml.build_network, so the test
+surface is the wire codec (varints, zigzag, deltas, string table, blob
+framing, compression), proven by element-level round trips and a full
+XML-vs-PBF compile of the irregular-geometry fixture.
+"""
+
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from reporter_tpu.netgen.osm_xml import parse_osm_xml
+from reporter_tpu.netgen.pbf import parse_osm_pbf, write_osm_pbf
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "irregular.osm")
+
+
+def _xml_elements(path):
+    """Raw (node_pos, ways, relations) straight off an XML file — the
+    writer's input shape."""
+    root = ET.parse(path).getroot()
+    node_pos = {int(n.get("id")): (float(n.get("lon")), float(n.get("lat")))
+                for n in root.iter("node")}
+    ways = [(int(w.get("id")),
+             [int(nd.get("ref")) for nd in w.findall("nd")],
+             {t.get("k"): t.get("v") for t in w.findall("tag")})
+            for w in root.iter("way")]
+    relations = [({t.get("k"): t.get("v") for t in r.findall("tag")},
+                  [(m.get("role"), m.get("type"), int(m.get("ref")))
+                   for m in r.findall("member")])
+                 for r in root.iter("relation")]
+    return node_pos, ways, relations
+
+
+def _assert_networks_equal(a, b):
+    # 1e-12 deg ≈ 0.1 µm: the decode arithmetic (1e-9 * gran * raw) can
+    # land 1 ULP off the XML float parse; anything beyond is a codec bug.
+    np.testing.assert_allclose(a.node_lonlat, b.node_lonlat, atol=1e-12)
+    assert len(a.ways) == len(b.ways)
+    for wa, wb in zip(a.ways, b.ways):
+        assert (wa.way_id, wa.nodes, wa.oneway) == (
+            wb.way_id, wb.nodes, wb.oneway)
+        assert wa.speed_mps == pytest.approx(wb.speed_mps)
+    assert [(r.from_way, r.via_node, r.to_way, r.kind)
+            for r in a.restrictions] == \
+           [(r.from_way, r.via_node, r.to_way, r.kind)
+            for r in b.restrictions]
+
+
+class TestRoundTrip:
+    def test_irregular_fixture_pbf_equals_xml(self, tmp_path):
+        """The full irregular-geometry fixture (ramps, dual carriageways,
+        restrictions-capable relations) through the PBF codec compiles to
+        the identical network. Fixture coords are 7-decimal → exact on the
+        PBF 1e-7 degree grid, so equality is exact, not approximate."""
+        node_pos, ways, relations = _xml_elements(FIXTURE)
+        pbf = str(tmp_path / "irregular.osm.pbf")
+        write_osm_pbf(pbf, node_pos, ways, relations)
+        _assert_networks_equal(parse_osm_xml(FIXTURE, name="x"),
+                               parse_osm_pbf(pbf, name="x"))
+
+    def test_compiles_to_identical_tileset(self, tmp_path):
+        from reporter_tpu.config import CompilerParams
+        from reporter_tpu.tiles.compiler import compile_network
+
+        node_pos, ways, relations = _xml_elements(FIXTURE)
+        pbf = str(tmp_path / "irregular.osm.pbf")
+        write_osm_pbf(pbf, node_pos, ways, relations)
+        cp = CompilerParams(reach_radius=400.0)
+        ta = compile_network(parse_osm_xml(FIXTURE, name="x"), cp)
+        tb = compile_network(parse_osm_pbf(pbf, name="x"), cp)
+        np.testing.assert_array_equal(ta.osmlr_id, tb.osmlr_id)
+        np.testing.assert_array_equal(ta.edge_dst, tb.edge_dst)
+        np.testing.assert_array_equal(ta.edge_len, tb.edge_len)
+        np.testing.assert_array_equal(ta.reach_to, tb.reach_to)
+
+    def test_uncompressed_blobs(self, tmp_path):
+        node_pos, ways, relations = _xml_elements(FIXTURE)
+        pbf = str(tmp_path / "raw.pbf")
+        write_osm_pbf(pbf, node_pos, ways, relations, compress=False)
+        _assert_networks_equal(parse_osm_xml(FIXTURE, name="x"),
+                               parse_osm_pbf(pbf, name="x"))
+
+    def test_custom_granularity(self, tmp_path):
+        """granularity=1000 (1e-6 deg grid): decode must scale raw values
+        by the block's granularity field, not assume the default."""
+        node_pos = {1: (-122.414100, 37.750000), 2: (-122.413200, 37.750100),
+                    3: (-122.412300, 37.750200)}
+        ways = [(7, [1, 2, 3], {"highway": "residential"})]
+        pbf = str(tmp_path / "gran.pbf")
+        write_osm_pbf(pbf, node_pos, ways, granularity=1000)
+        net = parse_osm_pbf(pbf)
+        np.testing.assert_allclose(
+            net.node_lonlat,
+            [[-122.414100, 37.750000], [-122.413200, 37.750100],
+             [-122.412300, 37.750200]], atol=1.1e-6)
+
+    def test_negative_and_large_ids(self, tmp_path):
+        """Zigzag + delta coding across sign changes and 2^40-scale ids
+        (planet-size id space)."""
+        big = 1 << 40
+        node_pos = {big + 5: (0.001, 0.001), big + 1: (0.002, 0.001),
+                    big + 9: (0.002, 0.002), big + 2: (0.001, 0.002)}
+        ways = [(big + 77, [big + 5, big + 1, big + 9, big + 2],
+                 {"highway": "residential", "oneway": "yes"})]
+        pbf = str(tmp_path / "big.pbf")
+        write_osm_pbf(pbf, node_pos, ways)
+        net = parse_osm_pbf(pbf)
+        assert len(net.ways) == 1
+        assert net.ways[0].way_id == big + 77
+        assert net.ways[0].oneway
+        assert len(net.node_lonlat) == 4
+
+    def test_southern_western_hemisphere(self, tmp_path):
+        """Negative lat/lon exercise signed dense-node deltas."""
+        node_pos = {1: (-70.6506000, -33.4372000),
+                    2: (-70.6505000, -33.4371000),
+                    3: (-70.6504000, -33.4370000)}
+        ways = [(3, [1, 2, 3], {"highway": "primary"})]
+        pbf = str(tmp_path / "south.pbf")
+        write_osm_pbf(pbf, node_pos, ways)
+        net = parse_osm_pbf(pbf)
+        np.testing.assert_allclose(
+            net.node_lonlat,
+            [[-70.6506, -33.4372], [-70.6505, -33.4371],
+             [-70.6504, -33.4370]], atol=1e-12)
+
+
+class TestErrors:
+    def test_unsupported_required_feature(self, tmp_path):
+        from reporter_tpu.netgen.pbf import _ld, _write_blob
+
+        path = str(tmp_path / "hist.pbf")
+        with open(path, "wb") as f:
+            _write_blob(f, "OSMHeader", _ld(4, b"HistoricalInformation"),
+                        compress=True)
+        with pytest.raises(ValueError, match="required feature"):
+            parse_osm_pbf(path)
+
+    def test_unknown_blob_type_skipped(self, tmp_path):
+        """Per spec, readers skip blob types they don't know."""
+        from reporter_tpu.netgen.pbf import _write_blob
+
+        node_pos = {1: (0.001, 0.001), 2: (0.002, 0.002)}
+        ways = [(1, [1, 2], {"highway": "residential"})]
+        pbf = str(tmp_path / "extra.pbf")
+        write_osm_pbf(pbf, node_pos, ways)
+        with open(pbf, "ab") as f:
+            _write_blob(f, "SomeVendorExtension", b"\x08\x01", compress=False)
+        net = parse_osm_pbf(pbf)
+        assert len(net.ways) == 1
+
+
+class TestCLI:
+    def test_build_from_pbf(self, tmp_path):
+        from reporter_tpu.tiles.__main__ import main
+        from reporter_tpu.tiles.tileset import TileSet
+
+        node_pos, ways, relations = _xml_elements(FIXTURE)
+        pbf = str(tmp_path / "city.osm.pbf")
+        write_osm_pbf(pbf, node_pos, ways, relations)
+        out = str(tmp_path / "city.npz")
+        assert main(["build", "--osm", pbf, "-o", out]) == 0
+        ts = TileSet.load(out)
+        assert ts.name == "city"
+        assert ts.num_edges > 0
