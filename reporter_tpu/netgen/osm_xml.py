@@ -23,6 +23,20 @@ DRIVABLE_HIGHWAY = {
     "secondary_link", "tertiary_link", "living_street",
 }
 
+# Access values that exclude general motor traffic (Valhalla's auto costing
+# analog, SURVEY.md §3.4). Checked most-specific-first per the OSM access
+# hierarchy: motor_vehicle overrides vehicle overrides access.
+_NO_ACCESS = {"no", "private", "agricultural", "forestry", "delivery",
+              "emergency", "military"}
+
+
+def _motor_access(tags: "dict[str, str]") -> bool:
+    for key in ("motor_vehicle", "vehicle", "access"):
+        v = tags.get(key)
+        if v is not None:
+            return v not in _NO_ACCESS
+    return True
+
 _DEFAULT_SPEED = {  # m/s by highway class
     "motorway": 29.0, "trunk": 24.5, "primary": 17.9, "secondary": 15.6,
     "tertiary": 13.4, "residential": 11.2, "service": 6.7, "living_street": 4.5,
@@ -82,6 +96,8 @@ def build_network(
     drivable: list[tuple[int, list[int], dict[str, str]]] = []
     for way_id, refs, tags in raw_ways:
         if tags.get("highway") not in DRIVABLE_HIGHWAY:
+            continue
+        if not _motor_access(tags):
             continue
         refs = [r for r in refs if r in node_pos]
         # Real extracts contain duplicate consecutive refs; they would become

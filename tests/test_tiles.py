@@ -251,3 +251,41 @@ def test_osm_xml_parser_roundtrip():
     # way 100 two-way (4 directed edges), way 101 one-way (1 edge)
     assert ts.num_edges == 5
     assert (ts.edge_osmlr >= 0).all()
+
+
+def test_access_tags_filter_motor_traffic():
+    """OSM access hierarchy (motor_vehicle > vehicle > access): private and
+    no-access ways drop; explicit motor_vehicle=yes overrides access=no."""
+    xml = """<?xml version='1.0'?>
+    <osm>
+      <node id='1' lat='37.700' lon='-122.400'/>
+      <node id='2' lat='37.701' lon='-122.400'/>
+      <node id='3' lat='37.702' lon='-122.401'/>
+      <node id='4' lat='37.703' lon='-122.402'/>
+      <node id='5' lat='37.704' lon='-122.403'/>
+      <way id='200'>
+        <nd ref='1'/><nd ref='2'/>
+        <tag k='highway' v='service'/>
+        <tag k='access' v='private'/>
+      </way>
+      <way id='201'>
+        <nd ref='2'/><nd ref='3'/>
+        <tag k='highway' v='residential'/>
+        <tag k='vehicle' v='no'/>
+      </way>
+      <way id='202'>
+        <nd ref='3'/><nd ref='4'/>
+        <tag k='highway' v='residential'/>
+        <tag k='access' v='no'/>
+        <tag k='motor_vehicle' v='yes'/>
+      </way>
+      <way id='203'>
+        <nd ref='4'/><nd ref='5'/>
+        <tag k='highway' v='residential'/>
+      </way>
+    </osm>"""
+    from reporter_tpu.netgen.osm_xml import parse_osm_xml
+
+    net = parse_osm_xml(xml, name="access")
+    got = sorted(w.way_id for w in net.ways)
+    assert got == [202, 203], got
