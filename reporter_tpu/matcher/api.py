@@ -206,13 +206,18 @@ class SegmentMatcher:
         return build_segments(self.ts, chains, self._route_fn,
                               self.params.backward_slack)
 
-    def _decode_many(self, traces: Sequence[Trace]):
-        """JAX decode for a list of traces → per-trace (edges, offsets,
-        chain_starts) numpy triples, bucketed by padded length."""
+    def _submit_many(self, traces: Sequence[Trace]):
+        """Submit every trace slice to the device (async dispatches).
+
+        Returns (work, inflight): work[w] = (trace index, chunk offset,
+        xy); inflight = [(slice work indices, wire device array)] in
+        submission order. Harvesting an inflight wire (np.asarray) blocks
+        on the link; callers decide what to overlap with that wait.
+        """
         import jax.numpy as jnp
 
         from reporter_tpu.ops.match import (OFFSET_QUANTUM, match_batch_wire,
-                                            match_batch_wire_q, unpack_wire)
+                                            match_batch_wire_q)
 
         max_b = _BUCKETS[-1]
         # Traces beyond the largest bucket are decoded in consecutive chunks
@@ -226,7 +231,6 @@ class SegmentMatcher:
                 for lo in range(0, len(t.xy), max_b):
                     work.append((i, lo, t.xy[lo:lo + max_b]))
 
-        per_trace: list[list[tuple[int, Any]]] = [[] for _ in traces]
         by_bucket: dict[int, list[int]] = {}
         for w, (_, _, xy) in enumerate(work):
             by_bucket.setdefault(_bucket_len(len(xy)), []).append(w)
@@ -271,6 +275,15 @@ class SegmentMatcher:
                     jnp.asarray(pts), jnp.asarray(lens),
                     self._tables, self.ts.meta, self.params)
             inflight.append((ws, wire))
+        return work, inflight
+
+    def _decode_many(self, traces: Sequence[Trace]):
+        """JAX decode for a list of traces → per-trace (edges, offsets,
+        chain_starts) numpy triples, bucketed by padded length."""
+        from reporter_tpu.ops.match import unpack_wire
+
+        work, inflight = self._submit_many(traces)
+        per_trace: list[list[tuple[int, Any]]] = [[] for _ in traces]
         for ws, wire in inflight:
             edges, offs, starts = unpack_wire(np.asarray(wire))
             for r, w in enumerate(ws):
@@ -290,12 +303,56 @@ class SegmentMatcher:
         return out
 
     def _match_jax_many(self, traces: Sequence[Trace]) -> list[list[SegmentRecord]]:
+        # Interleaved harvest + walk: np.asarray on the next slice blocks
+        # on the LINK (remote-attached chip) with the GIL released, and the
+        # C++ walk is a GIL-releasing ctypes call — so a one-worker thread
+        # walks slice k's records while slice k+1's wire bytes stream back.
+        # On a one-core host this hides most of the walk behind the
+        # transfer wait. Falls back to decode-then-walk when there is no
+        # native walker or a trace needs cross-slice chunk reassembly.
+        interleave = (self._native_walker is not None and len(traces) > 1
+                      and all(len(t.xy) <= _BUCKETS[-1] for t in traces))
+        if not interleave:
+            with self.metrics.stage("decode"):
+                decoded = self._decode_many(traces)
+            unmatched = sum(int((e < 0).sum()) for e, _, _ in decoded)
+            self.metrics.count("unmatched_points", unmatched)
+            with self.metrics.stage("walk"):
+                return self._walk_decoded(traces, decoded)
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        from reporter_tpu.ops.match import unpack_wire
+
         with self.metrics.stage("decode"):
-            decoded = self._decode_many(traces)
-        unmatched = sum(int((e < 0).sum()) for e, _, _ in decoded)
-        self.metrics.count("unmatched_points", unmatched)
+            work, inflight = self._submit_many(traces)
+        results: list = [None] * len(traces)
+        unmatched = 0
+
+        def walk_slice(ws, arr):
+            nonlocal unmatched
+            edges, offs, starts = unpack_wire(arr)
+            B, T = edges.shape
+            times = np.zeros((B, T), np.float64)
+            pad = 0
+            for r, w in enumerate(ws):
+                i, _, xy = work[w]
+                times[r, :len(xy)] = traces[i].times[:len(xy)]
+                pad += T - len(xy)      # padded tail decodes unmatched
+            unmatched += int((edges < 0).sum()) - pad
+            recs = self._native_walker.walk(
+                edges, offs, starts, times, self.params.backward_slack)
+            for r, w in enumerate(ws):
+                results[work[w][0]] = recs[r]
+
         with self.metrics.stage("walk"):
-            return self._walk_decoded(traces, decoded)
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                futs = [pool.submit(walk_slice, ws, np.asarray(wire))
+                        for ws, wire in inflight]
+                for f in futs:
+                    f.result()
+        self.metrics.count("unmatched_points", unmatched)
+        return results
 
     def _walk_decoded(self, traces: Sequence[Trace],
                       decoded) -> list[list[SegmentRecord]]:

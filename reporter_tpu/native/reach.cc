@@ -8,7 +8,9 @@
 // contract and is what tests/test_native.py asserts:
 //   - distances accumulate in double, stored as float (same as numpy path)
 //   - the heap pops (dist, node) in tuple order, matching Python's heapq
-//   - targets sort by (dist, edge id), matching np.lexsort((tos, dists))
+//   - targets sort by (dist, edge id) for nearest-M truncation, matching
+//     np.lexsort((tos, dists)); the KEPT entries then re-sort ascending by
+//     target id (schema-4 layout, binary-searched by walker.cc)
 //
 // Build: g++ -O3 -shared -fPIC -o _libreporter.so reach.cc -lpthread
 // (driven by reporter_tpu/native/build.py; no external deps).
@@ -131,6 +133,10 @@ int64_t reporter_build_reach(const int32_t* node_out, int64_t num_nodes,
         truncated.fetch_add(1);
         targets.resize(max_targets);
       }
+      // Schema-4 row layout: kept entries ascend by target edge id so the
+      // walker can binary-search (matches _pack_rows in tiles/reach.py).
+      std::sort(targets.begin(), targets.end(),
+                [](const Target& a, const Target& b) { return a.to < b.to; });
       int32_t* rt = reach_to + u * max_targets;
       float* rd = reach_dist + u * max_targets;
       int32_t* rn = reach_next + u * max_targets;

@@ -97,11 +97,17 @@ bool route_between(const Tile& t, int32_t e1, int32_t e2,
   while (true) {
     int64_t u = t.reach_row[e];
     const int32_t* row = t.reach_to + u * t.reach_m;
-    int32_t hit = -1;
-    for (int32_t i = 0; i < t.reach_m; ++i) {
-      if (row[i] == e2) { hit = i; break; }
-    }
-    if (hit < 0) return false;
+    // Rows are laid out ascending by target id with -1 padding at the end
+    // (schema 4, tiles/reach._pack_rows) — binary search with -1 mapped
+    // past every real id, instead of an O(M) scan per hop.
+    auto key = [](int32_t v) {
+      return v < 0 ? std::numeric_limits<int64_t>::max() : int64_t(v);
+    };
+    const int32_t* lo = std::lower_bound(
+        row, row + t.reach_m, e2,
+        [&](int32_t a, int32_t b) { return key(a) < key(b); });
+    if (lo == row + t.reach_m || *lo != e2) return false;
+    int32_t hit = int32_t(lo - row);
     double new_gap = t.reach_dist[u * t.reach_m + hit];
     if (new_gap >= gap) return false;  // no progress ⇒ inconsistent tables
     gap = new_gap;

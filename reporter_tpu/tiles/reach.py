@@ -84,8 +84,10 @@ def build_reach_tables(
     For node u, targets are out-edges e' of every node v with
     d(u, v) <= radius; reach_dist = d(u, src(e')), reach_next = first edge of
     the u→v path (or e' itself when v == u, i.e. e' directly follows an
-    in-edge of u). Rows are sorted by distance; -1/inf padded. The row that
-    governs transitions out of edge e is row edge_dst[e].
+    in-edge of u). The nearest max_targets by (dist, id) are kept, then laid
+    out ascending by target id (schema-4 invariant — the native walker
+    binary-searches rows); -1/inf padded. The row that governs transitions
+    out of edge e is row edge_dst[e].
     """
     num_nodes = len(node_out)
     reach_to = np.full((num_nodes, max_targets), -1, dtype=np.int32)
@@ -107,10 +109,12 @@ def build_reach_tables(
                 nexts.append(int(e2) if v == u else fe)
         if not tos:
             continue
-        order = np.lexsort((np.asarray(tos), np.asarray(dists)))
+        tos_a = np.asarray(tos)
+        order = np.lexsort((tos_a, np.asarray(dists)))
         if len(order) > max_targets:
             truncated += 1
             order = order[:max_targets]
+        order = order[np.argsort(tos_a[order], kind="stable")]
         k = len(order)
         reach_to[u, :k] = np.asarray(tos, np.int32)[order]
         reach_dist[u, :k] = np.asarray(dists, np.float32)[order]
@@ -170,9 +174,11 @@ def edge_space_targets(
 def _pack_rows(targets: dict[int, tuple[float, int, int]], seeds: set[int],
                max_targets: int,
                ) -> tuple[np.ndarray, np.ndarray, np.ndarray, bool]:
-    """Sort targets by (dist, edge id), truncate to max_targets; next-hop
-    is the target itself for direct successors (seed edges), else the
-    path's first edge."""
+    """Keep the nearest max_targets by (dist, edge id), then lay the kept
+    entries out sorted by TARGET EDGE ID: the native walker binary-searches
+    rows by target (route_between in walker.cc), so ascending ids are a
+    schema invariant (tileset schema 4). Next-hop is the target itself for
+    direct successors (seed edges), else the path's first edge."""
     tos = np.fromiter(targets.keys(), np.int64, len(targets))
     dists = np.asarray([targets[int(e)][0] for e in tos])
     nexts = np.asarray([int(e) if int(e) in seeds else targets[int(e)][1]
@@ -180,6 +186,7 @@ def _pack_rows(targets: dict[int, tuple[float, int, int]], seeds: set[int],
     order = np.lexsort((tos, dists))
     cut = len(order) > max_targets
     order = order[:max_targets]
+    order = order[np.argsort(tos[order], kind="stable")]
     return (tos[order].astype(np.int32), dists[order].astype(np.float32),
             nexts[order], cut)
 

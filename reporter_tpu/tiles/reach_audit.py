@@ -75,12 +75,14 @@ class ReachAudit:
 
 
 def node_coverage_radii(ts: TileSet) -> np.ndarray:
-    """Per-node truncation coverage D_M: network distance of the last kept
-    reach target (rows are distance-sorted, so this is the radius beyond
-    which the table is blind), +inf when the row is not full (nothing was
-    cut)."""
+    """Per-node truncation coverage D_M: network distance of the FARTHEST
+    kept reach target (the radius beyond which the table is blind), +inf
+    when the row is not full (nothing was cut). Schema-4 rows are laid out
+    by target id, not distance, so take a masked max — the valid prefix is
+    contiguous but unordered in distance."""
     full = ts.reach_to[:, -1] >= 0          # [N] row is full ⇒ maybe cut
-    return np.where(full, ts.reach_dist[:, -1], np.inf)
+    far = np.where(ts.reach_to >= 0, ts.reach_dist, -np.inf).max(axis=1)
+    return np.where(full, far, np.inf)
 
 
 def audit_reach(ts: TileSet, traces_xy: list[np.ndarray],

@@ -170,9 +170,11 @@ class TileSet:
         payload["_meta"] = np.frombuffer(
             json.dumps({"name": self.name, "meta": list(self.meta),
                         "stats": self.stats,
-                        # schema 3: node-keyed reach rows + edge_reach_row
+                        # schema 4: reach rows laid out ascending by
+                        # target edge id (binary-searchable) on top of
+                        # schema 3's node-keyed rows + edge_reach_row
                         # indirection + banned turn pairs
-                        "schema": 3}).encode(),
+                        "schema": 4}).encode(),
             dtype=np.uint8,
         )
         np.savez_compressed(path, **payload)
@@ -185,11 +187,11 @@ class TileSet:
             path += ".npz"
         with np.load(path) as z:
             raw = json.loads(bytes(z["_meta"]).decode())
-            if raw.get("schema", 1) != 3:
+            if raw.get("schema", 1) != 4:
                 raise ValueError(
                     f"{path}: tileset schema {raw.get('schema', 1)} predates "
-                    "the node-keyed reach tables + turn restrictions; "
-                    "recompile with compile_network()")
+                    "the id-sorted reach rows (binary-searched by the "
+                    "native walker); recompile with compile_network()")
             arrays = {f: z[f] for f in _ARRAY_FIELDS}
         if len(raw["meta"]) != len(TileMeta._fields):
             raise ValueError(

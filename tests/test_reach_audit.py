@@ -67,3 +67,29 @@ def test_coverage_radii_shape_and_truncation_stat(audit_tiles):
     assert (finite > 0).all()
     assert (np.isfinite(cov).sum()
             >= audit_tiles.stats["reach_truncated_nodes"])
+
+
+def test_coverage_radii_are_true_farthest_kept_distance(audit_city):
+    """D_M must equal the M-th nearest target distance from an independent
+    Dijkstra — schema-4 rows are id-ordered, so reading any fixed column
+    (e.g. the last) understates coverage."""
+    from reporter_tpu.config import CompilerParams
+    from reporter_tpu.tiles.compiler import compile_network
+    from reporter_tpu.tiles.reach import node_dijkstra
+
+    ts = compile_network(audit_city, CompilerParams(reach_max=8))
+    cov = node_coverage_radii(ts)
+    checked = 0
+    for u in range(ts.num_nodes):
+        if not np.isfinite(cov[u]):
+            continue
+        reached = node_dijkstra(u, ts.node_out, ts.edge_dst, ts.edge_len,
+                                ts.meta.index_radius * 100)
+        dists = sorted(d for v, (d, _) in reached.items()
+                       for e in ts.node_out[v] if e >= 0)
+        want = dists[ts.reach_to.shape[1] - 1]
+        assert cov[u] == pytest.approx(want, abs=1e-3), f"node {u}"
+        checked += 1
+        if checked >= 25:
+            break
+    assert checked >= 10, "starved table should have many full rows"
