@@ -4,17 +4,23 @@ Reference pipeline:  probe producer → topic "raw" → formatter worker →
 topic "formatted" (partitioned by uuid) → matcher workers (consumer group,
 per-uuid buffers) → datastore.
 
-Here the broker becomes an in-process partitioned log with replayable
-offsets (queue.IngestQueue); the matcher worker becomes StreamPipeline,
+Here the broker becomes a partitioned log with replayable offsets behind
+the ProbeConsumer protocol (broker.py) — in-memory (queue.IngestQueue) or
+file-backed so the log survives the process (durable_queue.
+DurableIngestQueue, Kafka's durability role); the matcher worker becomes
+StreamPipeline,
 which buffers per uuid, flushes ripe buffers through the batched device
 matcher, accumulates per-segment speed histograms in device memory, and
 checkpoints offsets + buffers + histograms for crash recovery
 (at-least-once, like the reference's consumer groups).
 """
 
+from reporter_tpu.streaming.broker import ProbeConsumer
 from reporter_tpu.streaming.queue import IngestQueue
+from reporter_tpu.streaming.durable_queue import DurableIngestQueue
 from reporter_tpu.streaming.histogram import SpeedHistogram
 from reporter_tpu.streaming.pipeline import StreamPipeline
 from reporter_tpu.streaming.worker import StreamWorker
 
-__all__ = ["IngestQueue", "SpeedHistogram", "StreamPipeline"]
+__all__ = ["DurableIngestQueue", "IngestQueue", "ProbeConsumer",
+           "SpeedHistogram", "StreamPipeline", "StreamWorker"]

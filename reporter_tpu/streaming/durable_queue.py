@@ -1,0 +1,124 @@
+"""DurableIngestQueue — file-backed probe log (Kafka's durability role).
+
+The recovery model (SURVEY.md §5, streaming/pipeline.py) is "replay from
+committed offsets: the buffer is derived state, the log is the truth". The
+in-proc IngestQueue plays the broker for tests and single-process serving,
+but it dies with the process — after a crash there is nothing to replay
+FROM. This subclass persists the same offset-addressed log to disk, so a
+restarted worker constructs its pipeline over the same directory and
+replays the unflushed tail exactly like a Kafka consumer rejoining its
+group. All offset/retention semantics live in IngestQueue (one source of
+truth, contract-tested for both classes); this class only adds the
+persistence hooks.
+
+Layout under ``dir/``: one append-only JSON-lines file per partition
+(``p0.log`` …). After a retention rewrite the first line is a header
+``{"_floor": N}`` recording the partition's base offset — INSIDE the log,
+so content and floor change in one atomic ``os.replace`` (a sidecar floor
+file could desync from the log on a crash between two renames, silently
+re-keying surviving records to wrong offsets).
+
+Durability: appends are flushed to the OS on every call (crash-safe
+against process death); ``fsync=True`` additionally fsyncs per append for
+power-loss safety at a large throughput cost. A torn final line (killed
+mid-write) is dropped on reload AND truncated from the file before the
+append handle reopens — otherwise the next acked record would concatenate
+onto the fragment and take every later record down with it on the
+following reload.
+
+Implements the ProbeConsumer protocol (streaming/broker.py);
+contract-tested by tests/test_broker_contract.py alongside the in-proc
+implementation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from reporter_tpu.streaming.queue import IngestQueue
+
+
+def _encode(record: dict) -> bytes:
+    return json.dumps(record, separators=(",", ":")).encode() + b"\n"
+
+
+class DurableIngestQueue(IngestQueue):
+    """IngestQueue whose log survives the process."""
+
+    def __init__(self, dir: str, num_partitions: int = 4,
+                 fsync: bool = False):
+        super().__init__(num_partitions)
+        self.dir = dir
+        self._fsync = bool(fsync)
+        os.makedirs(dir, exist_ok=True)
+        self._files = []
+        for p in range(self.num_partitions):
+            base, records, good_bytes = self._load_partition(p)
+            self._base[p] = base
+            self._parts[p] = records
+            path = self._log_path(p)
+            if os.path.exists(path) and os.path.getsize(path) > good_bytes:
+                # torn/corrupt tail: cut it from the FILE too, or the next
+                # acked append merges into the fragment and poisons the
+                # line after it on the following reload
+                with open(path, "rb+") as f:
+                    f.truncate(good_bytes)
+            self._files.append(open(path, "ab"))
+
+    # ---- persistence ----------------------------------------------------
+
+    def _log_path(self, p: int) -> str:
+        return os.path.join(self.dir, f"p{p}.log")
+
+    def _load_partition(self, p: int) -> "tuple[int, list, int]":
+        """(base offset, records, byte length of the valid prefix)."""
+        base, records, good = 0, [], 0
+        path = self._log_path(p)
+        if not os.path.exists(path):
+            return base, records, good
+        with open(path, "rb") as f:
+            first = True
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break               # torn tail from a mid-write crash
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    break               # corrupt tail: stop at last good
+                if first and isinstance(obj, dict) and set(obj) == {"_floor"}:
+                    base = int(obj["_floor"])
+                else:
+                    records.append(obj)
+                first = False
+                good += len(line)
+        return base, records, good
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._files:
+                f.close()
+            self._files = []
+
+    # ---- IngestQueue durability hooks (run under the lock) ---------------
+
+    def _persist(self, p: int, record: dict) -> None:
+        f = self._files[p]
+        f.write(_encode(record))
+        f.flush()
+        if self._fsync:
+            os.fsync(f.fileno())
+
+    def _persist_truncate(self, p: int) -> None:
+        """Rewrite the partition log as header + surviving records, in one
+        atomic rename — base and content can never desync."""
+        self._files[p].close()
+        tmp = self._log_path(p) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_encode({"_floor": self._base[p]}))
+            for r in self._parts[p]:
+                f.write(_encode(r))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._log_path(p))
+        self._files[p] = open(self._log_path(p), "ab")

@@ -32,8 +32,14 @@ class IngestQueue:
         """Producer API: route by record["uuid"], return (partition, offset)."""
         p = partition_of(str(record.get("uuid", "")), self.num_partitions)
         with self._lock:
+            self._persist(p, record)
             self._parts[p].append(record)
             return p, self._base[p] + len(self._parts[p]) - 1
+
+    def _persist(self, p: int, record: dict) -> None:
+        """Durability hook (DurableIngestQueue): runs under the lock BEFORE
+        the in-memory append, so on-disk line order always matches offset
+        order even with concurrent producers. No-op in-proc."""
 
     def append_many(self, records: Sequence[dict]) -> None:
         for r in records:
@@ -69,3 +75,8 @@ class IngestQueue:
                 if drop:
                     self._parts[p] = self._parts[p][drop:]
                     self._base[p] += drop
+                    self._persist_truncate(p)
+
+    def _persist_truncate(self, p: int) -> None:
+        """Durability hook: rewrite partition p's backing store to match
+        the truncated in-memory state. Runs under the lock. No-op in-proc."""

@@ -111,3 +111,136 @@ class TestIngestQueueContract:
         pipe.step(force_flush=True)
         assert wrapped.polls >= 1
         assert pipe.stats()["lag"] == 0
+
+
+class TestDurableIngestQueue:
+    """File-backed log: same contract, survives the process."""
+
+    def test_contract(self, tmp_path):
+        from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+
+        q = DurableIngestQueue(str(tmp_path / "log"), num_partitions=4)
+        check_probe_consumer(q, q.append)
+        q.close()
+
+    def test_reopen_preserves_offsets_and_records(self, tmp_path):
+        from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+
+        d = str(tmp_path / "log")
+        q = DurableIngestQueue(d, num_partitions=2)
+        for i in range(30):
+            q.append({"uuid": f"v{i % 5}", "lat": float(i), "lon": 0.0,
+                      "time": float(i)})
+        want = [q.poll(p, 0, 1000) for p in range(2)]
+        ends = [q.end_offset(p) for p in range(2)]
+        q.close()
+
+        q2 = DurableIngestQueue(d, num_partitions=2)
+        assert [q2.end_offset(p) for p in range(2)] == ends
+        assert [q2.poll(p, 0, 1000) for p in range(2)] == want
+
+    def test_torn_tail_dropped_and_cut_from_disk(self, tmp_path):
+        from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+
+        d = str(tmp_path / "log")
+        q = DurableIngestQueue(d, num_partitions=1)
+        for i in range(5):
+            q.append({"uuid": "v", "lat": float(i), "lon": 0.0,
+                      "time": float(i)})
+        q.close()
+        with open(f"{d}/p0.log", "ab") as f:
+            f.write(b'{"uuid": "v", "lat": 9')    # killed mid-write
+        q2 = DurableIngestQueue(d, num_partitions=1)
+        assert q2.end_offset(0) == 5              # torn record never acked
+        # appends after the torn reload must NOT merge into the fragment:
+        # every record acked now has to survive the NEXT reload too
+        for i in range(5, 105):
+            q2.append({"uuid": "v", "lat": float(i), "lon": 0.0,
+                       "time": float(i)})
+        q2.close()
+        q3 = DurableIngestQueue(d, num_partitions=1)
+        assert q3.end_offset(0) == 105
+        assert [r["time"] for _, r in q3.poll(0, 0, 200)] == [
+            float(i) for i in range(105)]
+
+    def test_truncate_persists_floor(self, tmp_path):
+        from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+
+        d = str(tmp_path / "log")
+        q = DurableIngestQueue(d, num_partitions=1)
+        for i in range(10):
+            q.append({"uuid": "v", "lat": float(i), "lon": 0.0,
+                      "time": float(i)})
+        q.truncate([6])
+        q.close()
+        q2 = DurableIngestQueue(d, num_partitions=1)
+        with pytest.raises(LookupError):
+            q2.poll(0, 3, 10)
+        got = q2.poll(0, 6, 10)
+        assert [off for off, _ in got] == [6, 7, 8, 9]
+
+    def test_truncate_base_is_atomic_with_content(self, tmp_path):
+        """The floor lives INSIDE the rewritten log (header line), so the
+        on-disk state is one atomic file — there is no window where
+        surviving records could reload under wrong offsets. Verify the
+        single-file layout directly, then that offsets survive another
+        append+reload cycle."""
+        import os as _os
+
+        from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+
+        d = str(tmp_path / "log")
+        q = DurableIngestQueue(d, num_partitions=1)
+        for i in range(10):
+            q.append({"uuid": "v", "lat": float(i), "lon": 0.0,
+                      "time": float(i)})
+        q.truncate([6])
+        q.append({"uuid": "v", "lat": 10.0, "lon": 0.0, "time": 10.0})
+        q.close()
+        assert _os.listdir(d) == ["p0.log"]   # no sidecar to desync
+        q2 = DurableIngestQueue(d, num_partitions=1)
+        got = q2.poll(0, 6, 10)
+        assert [(off, r["time"]) for off, r in got] == [
+            (6, 6.0), (7, 7.0), (8, 8.0), (9, 9.0), (10, 10.0)]
+
+    def test_crash_restart_replays_unflushed_tail(self, tmp_path):
+        """The full recovery story across a simulated process death: a new
+        pipeline over the SAME directory + checkpoint replays the
+        unflushed tail — records are never lost (at-least-once)."""
+        from reporter_tpu.config import CompilerParams, Config
+        from reporter_tpu.netgen.synthetic import generate_city
+        from reporter_tpu.netgen.traces import synthesize_fleet
+        from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+        from reporter_tpu.streaming.pipeline import StreamPipeline
+        from reporter_tpu.tiles.compiler import compile_network
+
+        # short OSMLR segments so 40-point traces complete several
+        tiles = compile_network(generate_city("tiny"),
+                                CompilerParams(osmlr_max_length=250.0))
+        d = str(tmp_path / "log")
+        ckpt = str(tmp_path / "ckpt")
+        cfg = Config()
+        q = DurableIngestQueue(d, cfg.streaming.num_partitions)
+        pipe = StreamPipeline(tiles, cfg, queue=q)
+        fleet = synthesize_fleet(tiles, 4, num_points=40, seed=9)
+        records = [{"uuid": p.uuid, "lat": float(la), "lon": float(lo),
+                    "time": float(t)}
+                   for p in fleet
+                   for (lo, la), t in zip(p.lonlat, p.times)]
+        for r in records[:80]:
+            q.append(r)
+        n1 = pipe.step(force_flush=True)
+        pipe.checkpoint(ckpt)
+        for r in records[80:]:
+            q.append(r)          # arrives after the checkpoint
+        pipe.step()              # consumed but NOT flushed (buffers only)
+        q.close()
+        del pipe, q              # the "crash"
+
+        q2 = DurableIngestQueue(d, cfg.streaming.num_partitions)
+        pipe2 = StreamPipeline(tiles, cfg, queue=q2)
+        pipe2.restore(ckpt)
+        n2 = pipe2.drain()
+        assert n1 > 0 and n2 > 0
+        assert pipe2.stats()["lag"] == 0
+        q2.close()
