@@ -43,6 +43,8 @@ class Trace:
     uuid: str
     xy: np.ndarray       # [T, 2] float32 tile-local meters
     times: np.ndarray    # [T] float64 seconds
+    accuracy: "np.ndarray | None" = None  # [T] f32 reported GPS accuracy
+    #                                       (m); None ⇒ sigma_z everywhere
 
     @classmethod
     def from_json(cls, payload: dict, ts: TileSet) -> "Trace":
@@ -52,8 +54,14 @@ class Trace:
         if len(lonlat) == 0:
             lonlat = np.zeros((0, 2))
         xy = lonlat_to_xy(lonlat, np.asarray(ts.meta.origin_lonlat))
+        # Optional per-point accuracy (the reference schema's "(accuracy)"
+        # field): worse-than-sigma_z points get down-weighted emissions.
+        acc = None
+        if any("accuracy" in p for p in pts):
+            acc = np.array([float(p.get("accuracy", 0.0)) for p in pts],
+                           np.float32)
         return cls(uuid=str(payload.get("uuid", "")), xy=xy.astype(np.float32),
-                   times=times)
+                   times=times, accuracy=acc)
 
 
 @dataclass
@@ -201,7 +209,8 @@ class SegmentMatcher:
 
     def _match_cpu(self, trace: Trace) -> list[SegmentRecord]:
         pts = cpu_reference.match_trace_cpu(self.ts, trace.xy.astype(np.float64),
-                                            self.params, self._dij_cache)
+                                            self.params, self._dij_cache,
+                                            accuracy=trace.accuracy)
         chains = _to_chains(pts, trace.times)
         return build_segments(self.ts, chains, self._route_fn,
                               self.params.backward_slack)
@@ -262,6 +271,22 @@ class SegmentMatcher:
             # Quantized infeed (half the host→device bytes): i16 0.25 m
             # offsets from per-trace origins, unless some trace spans
             # beyond the i16 range (±8.19 km from its first point).
+            # Per-point GPS accuracy → emission distance scaling (see
+            # ops/match.match_traces). None for accuracy-less slices: the
+            # scale-free executable is traced separately, so the common
+            # case pays neither transfer nor compute for the feature.
+            scale = None
+            if any(traces[work[w][0]].accuracy is not None for w in ws):
+                scale = np.ones((B, b), np.float32)
+                sz = np.float32(self.params.sigma_z)
+                for r, w in enumerate(ws):
+                    i, lo, xy = work[w]
+                    a = traces[i].accuracy
+                    if a is None:
+                        continue
+                    a = np.asarray(a[lo:lo + len(xy)], np.float32)
+                    scale[r, :len(a)] = sz / np.maximum(sz, a)
+            acc_scale = None if scale is None else jnp.asarray(scale)
             origins = pts[:, 0, :].copy()
             dq = np.round((pts - origins[:, None, :])
                           * np.float32(1.0 / OFFSET_QUANTUM))
@@ -269,11 +294,11 @@ class SegmentMatcher:
                 wire = match_batch_wire_q(
                     jnp.asarray(dq.astype(np.int16)), jnp.asarray(origins),
                     jnp.asarray(lens), self._tables, self.ts.meta,
-                    self.params)
+                    self.params, acc_scale)
             else:
                 wire = match_batch_wire(
                     jnp.asarray(pts), jnp.asarray(lens),
-                    self._tables, self.ts.meta, self.params)
+                    self._tables, self.ts.meta, self.params, acc_scale)
             inflight.append((ws, wire))
         return work, inflight
 

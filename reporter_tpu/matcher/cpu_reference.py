@@ -192,18 +192,25 @@ def interpolation_keep(xy: np.ndarray, interpolation_distance: float,
 
 def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
                     dij_cache: DijkstraCache | None = None,
+                    accuracy: "np.ndarray | None" = None,
                     ) -> list[tuple[int, float, bool]]:
     """Match one trace; returns per-point (edge, offset, chain_start),
     edge = -1 for unmatched points. One forward Viterbi pass with exact
     routing, then one backpointer backtrack per chain. ``dij_cache`` may be
-    shared across traces on the same tile (see DijkstraCache)."""
+    shared across traces on the same tile (see DijkstraCache). ``accuracy``
+    [T] (m): per-point emission sigma = max(sigma_z, accuracy[t]) — the
+    same rule the device path implements by distance scaling
+    (ops/match.match_traces)."""
     T = len(xy)
     cands = [find_candidates_cpu(ts, xy[t], params) for t in range(T)]
     results: list[tuple[int, float, bool]] = [(-1, 0.0, False)] * T
     INF = float("inf")
 
-    def emit(c: _Cand) -> float:
-        return c.dist ** 2 / (2.0 * params.sigma_z ** 2)
+    def emit(c: _Cand, t: int) -> float:
+        sigma = params.sigma_z
+        if accuracy is not None:
+            sigma = max(sigma, float(accuracy[t]))
+        return c.dist ** 2 / (2.0 * sigma ** 2)
 
     keep = interpolation_keep(xy, params.interpolation_distance)
 
@@ -219,7 +226,7 @@ def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
     prev_t = -1
     for t in act:
         if prev_t < 0:
-            scores[t] = [emit(c) for c in cands[t]]
+            scores[t] = [emit(c, t) for c in cands[t]]
             bps[t] = [-1] * len(cands[t])
             chain_started[t] = True
             prev_t = t
@@ -249,11 +256,11 @@ def match_trace_cpu(ts: TileSet, xy: np.ndarray, params: MatcherParams,
                         ns[k] = cost
                         bp[k] = j
         if all(s == INF for s in ns):
-            scores[t] = [emit(c) for c in cands[t]]
+            scores[t] = [emit(c, t) for c in cands[t]]
             bps[t] = [-1] * len(cands[t])
             chain_started[t] = True
         else:
-            scores[t] = [s + emit(c) if s < INF else INF
+            scores[t] = [s + emit(c, t) if s < INF else INF
                          for s, c in zip(ns, cands[t])]
             bps[t] = bp
             chain_started[t] = False

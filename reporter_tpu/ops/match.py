@@ -86,10 +86,20 @@ def match_trace(points, valid_pt, tables, meta,
 
 
 def match_traces(points, valid_pt, tables, meta,
-                 params: MatcherParams) -> MatchOutput:
+                 params: MatcherParams, acc_scale=None) -> MatchOutput:
     """Match a batch (not jitted — compose under jit/vmap/shard_map):
-    points f32 [B, T, 2], valid_pt bool [B, T]."""
+    points f32 [B, T, 2], valid_pt bool [B, T].
+
+    acc_scale f32 [B, T] (optional): per-point GPS-accuracy emission
+    scaling. Meili scales the emission sigma by each point's reported
+    accuracy; since emission = d²/(2σ²) = (d·σ_z/σ)²/(2σ_z²), scaling the
+    candidate DISTANCES by σ_z/σ_point implements per-point σ without
+    touching the cost model or the wire format (scaling is uniform within
+    a point, so top-K candidate selection is unchanged).
+    """
     cands = batch_candidates(points, valid_pt, tables, meta, params)
+    if acc_scale is not None:
+        cands = cands._replace(dist=cands.dist * acc_scale[..., None])
     vit = viterbi_decode_batched(
         cands, points, valid_pt, tables,
         params.sigma_z, params.beta, params.max_route_distance_factor,
@@ -122,18 +132,20 @@ OFFSET_QUANTUM = 0.25
 
 @functools.partial(jax.jit, static_argnames=("meta", "params"))
 def match_batch_wire(points, lengths, tables: dict[str, Any], meta: TileMeta,
-                     params: MatcherParams):
+                     params: MatcherParams, acc_scale=None):
     """points f32 [B, T, 2], lengths i32 [B] (valid prefix per trace) →
-    u16 [B, 3, T] wire array; unpack with unpack_wire()."""
+    u16 [B, 3, T] wire array; unpack with unpack_wire(). acc_scale: see
+    match_traces (None traces a separate, scale-free executable, so
+    accuracy-less batches pay nothing)."""
     T = points.shape[1]
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
-    out = match_traces(points, valid, tables, meta, params)
+    out = match_traces(points, valid, tables, meta, params, acc_scale)
     return _pack_wire(out)
 
 
 @functools.partial(jax.jit, static_argnames=("meta", "params"))
 def match_batch_wire_q(points_q, origins, lengths, tables: dict[str, Any],
-                       meta: TileMeta, params: MatcherParams):
+                       meta: TileMeta, params: MatcherParams, acc_scale=None):
     """Quantized-input variant: points_q i16 [B, T, 2] are 0.25 m
     fixed-point offsets from per-trace origins f32 [B, 2] (host→device
     bytes halve vs f32; 0.125 m quantization ≪ sigma_z). Traces spanning
@@ -143,7 +155,7 @@ def match_batch_wire_q(points_q, origins, lengths, tables: dict[str, Any],
     points = origins[:, None, :] + points_q.astype(jnp.float32) * jnp.float32(
         OFFSET_QUANTUM)
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
-    out = match_traces(points, valid, tables, meta, params)
+    out = match_traces(points, valid, tables, meta, params, acc_scale)
     return _pack_wire(out)
 
 

@@ -71,8 +71,11 @@ def _validate_payload(payload: Any) -> tuple[str, list[dict]]:
     # timeless fixtures the same way).
     out = []
     for i, p in enumerate(pts):
-        out.append({"lat": float(p["lat"]), "lon": float(p["lon"]),
-                    "time": float(p.get("time", i))})
+        norm = {"lat": float(p["lat"]), "lon": float(p["lon"]),
+                "time": float(p.get("time", i))}
+        if "accuracy" in p:   # optional per-point GPS accuracy (m)
+            norm["accuracy"] = float(p["accuracy"])
+        out.append(norm)
     out.sort(key=lambda p: p["time"])
     return uuid, out
 

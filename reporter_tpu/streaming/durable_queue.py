@@ -52,6 +52,23 @@ class DurableIngestQueue(IngestQueue):
         self.dir = dir
         self._fsync = bool(fsync)
         os.makedirs(dir, exist_ok=True)
+        # The partition count is part of the log's identity: reopening
+        # with a different count would orphan partitions and re-route
+        # uuids under the consumer's committed offsets. Pin it on first
+        # creation; refuse a mismatched reopen.
+        meta_path = os.path.join(dir, "meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                stored = int(json.load(f)["num_partitions"])
+            if stored != self.num_partitions:
+                raise ValueError(
+                    f"{dir}: log was created with num_partitions={stored}, "
+                    f"reopened with {self.num_partitions} — records would "
+                    "be orphaned/mis-routed; migrate explicitly instead")
+        else:
+            with open(meta_path + ".tmp", "w") as f:
+                json.dump({"num_partitions": self.num_partitions}, f)
+            os.replace(meta_path + ".tmp", meta_path)
         self._files = []
         for p in range(self.num_partitions):
             base, records, good_bytes = self._load_partition(p)
@@ -121,4 +138,13 @@ class DurableIngestQueue(IngestQueue):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._log_path(p))
+        if self._fsync:
+            # Power-loss safety requires the RENAME to be durable too, or
+            # later fsync'd appends land on an inode the replayed journal
+            # may not point at; process-death safety doesn't need this.
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         self._files[p] = open(self._log_path(p), "ab")

@@ -142,7 +142,14 @@ class StreamPipeline:
             # path's convention (app._validate_payload), not the partition
             # offset (which interleaves across uuids).
             t = float(len(buf.points))
-        buf.points.append({"lat": lat, "lon": lon, "time": t})
+        point = {"lat": lat, "lon": lon, "time": t}
+        if "accuracy" in rec:   # same optional field the HTTP path keeps
+            try:
+                point["accuracy"] = float(rec["accuracy"])
+            except (TypeError, ValueError):
+                pass            # malformed accuracy: drop the field, not
+                                # the point (it is advisory weighting)
+        buf.points.append(point)
 
     def _flush(self, uuids: list[str]) -> int:
         payloads = [{"uuid": u, "trace": self._buffers[u].points}

@@ -197,7 +197,9 @@ class TestDurableIngestQueue:
         q.truncate([6])
         q.append({"uuid": "v", "lat": 10.0, "lon": 0.0, "time": 10.0})
         q.close()
-        assert _os.listdir(d) == ["p0.log"]   # no sidecar to desync
+        # no floor sidecar to desync (meta.json only pins the partition
+        # count, which never changes after creation)
+        assert sorted(_os.listdir(d)) == ["meta.json", "p0.log"]
         q2 = DurableIngestQueue(d, num_partitions=1)
         got = q2.poll(0, 6, 10)
         assert [(off, r["time"]) for off, r in got] == [
@@ -244,3 +246,30 @@ class TestDurableIngestQueue:
         assert n1 > 0 and n2 > 0
         assert pipe2.stats()["lag"] == 0
         q2.close()
+
+    def test_reopen_with_different_partition_count_rejected(self, tmp_path):
+        from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+
+        d = str(tmp_path / "log")
+        q = DurableIngestQueue(d, num_partitions=4)
+        q.append({"uuid": "v", "lat": 0.0, "lon": 0.0, "time": 0.0})
+        q.close()
+        with pytest.raises(ValueError, match="num_partitions=4"):
+            DurableIngestQueue(d, num_partitions=2)
+
+
+def test_stream_ingest_keeps_accuracy(tiny_tiles):
+    """The streaming path must carry per-point accuracy like the HTTP
+    path does — same trace, same weighting, either ingest."""
+    from reporter_tpu.config import Config
+    from reporter_tpu.streaming.pipeline import StreamPipeline
+
+    pipe = StreamPipeline(tiny_tiles, Config())
+    pipe.queue.append({"uuid": "v", "lat": 37.75, "lon": -122.41,
+                       "time": 0.0, "accuracy": 25.0})
+    pipe.queue.append({"uuid": "v", "lat": 37.7501, "lon": -122.41,
+                       "time": 1.0, "accuracy": "garbage"})
+    pipe.step()
+    pts = pipe._buffers["v"].points
+    assert pts[0]["accuracy"] == 25.0
+    assert "accuracy" not in pts[1]      # malformed: field dropped, point kept

@@ -303,3 +303,71 @@ class TestQueueLength:
         segs = {s["segment_id"]: s for s in mj.match(jam)["segments"]}
         assert seg_id in segs
         assert segs[seg_id]["queue_length"] <= seg_len + 1e-6
+
+
+class TestAccuracy:
+    """Per-point GPS accuracy (the reference schema's optional field):
+    emission sigma = max(sigma_z, accuracy), device path via distance
+    scaling (ops/match.match_traces), CPU oracle via per-point sigma."""
+
+    def test_accuracy_none_is_noop(self, matchers, short_seg_tiles):
+        mj, _ = matchers
+        p = synthesize_probe(short_seg_tiles, seed=12, num_points=50)
+        base = p.to_report_json()
+        with_acc = {"uuid": base["uuid"], "trace": [
+            dict(pt, accuracy=1.0) for pt in base["trace"]]}
+        a = [s["segment_id"] for s in mj.match(base)["segments"]]
+        # accuracy <= sigma_z clamps to sigma_z -> identical decode
+        b = [s["segment_id"] for s in mj.match(with_acc)["segments"]]
+        assert a == b
+
+    def test_bad_accuracy_point_downweighted(self, short_seg_tiles):
+        """Drag one mid-trace point hard sideways. With honest (large)
+        reported accuracy the match must ride through on route
+        continuity; the same trace claiming pinpoint accuracy is allowed
+        to deviate. Checked on both backends."""
+        from reporter_tpu.geometry import xy_to_lonlat
+
+        ts = short_seg_tiles
+        p = synthesize_probe(ts, seed=22, num_points=50, gps_sigma=1.0)
+        xy = p.xy.copy()
+        k = 25
+        # ~8-sigma outlier, still inside search_radius (50 m) of the true
+        # edge: the honest-accuracy decode has the right candidate and
+        # must let route continuity outvote the dragged emission
+        xy[k] += np.float32(30.0 / np.sqrt(2.0))
+        lonlat = xy_to_lonlat(xy.astype(np.float64),
+                              np.asarray(ts.meta.origin_lonlat))
+
+        def payload(uuid, acc_k):
+            trace = []
+            for i, ((lo, la), t) in enumerate(zip(lonlat, p.times)):
+                pt = {"lat": float(la), "lon": float(lo), "time": float(t)}
+                if i == k:
+                    pt["accuracy"] = acc_k
+                trace.append(pt)
+            return {"uuid": uuid, "trace": trace}
+
+        clean_ids = None
+        for backend in ("jax", "reference_cpu"):
+            m = SegmentMatcher(short_seg_tiles, Config(matcher_backend=backend))
+            honest = m.match(payload(f"h-{backend}", 100.0))["segments"]
+            clean = m.match(p.to_report_json())["segments"]
+            # with the outlier down-weighted ~25x, the matched segment
+            # sequence must equal the clean trace's
+            assert ([s["segment_id"] for s in honest]
+                    == [s["segment_id"] for s in clean]), backend
+            # both backends must agree on the clean sequence too
+            if clean_ids is None:
+                clean_ids = [s["segment_id"] for s in clean]
+            else:
+                assert clean_ids == [s["segment_id"] for s in clean]
+            # pinpoint claimed accuracy (<= sigma_z) clamps to sigma_z:
+            # identical to not reporting accuracy at all, outlier included
+            pin = m.match(payload(f"p-{backend}", 1.0))["segments"]
+            no_acc = {"uuid": f"n-{backend}", "trace": [
+                {k: v for k, v in pt.items() if k != "accuracy"}
+                for pt in payload("x", 1.0)["trace"]]}
+            bare = m.match(no_acc)["segments"]
+            assert ([s["segment_id"] for s in pin]
+                    == [s["segment_id"] for s in bare]), backend
