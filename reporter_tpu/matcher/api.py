@@ -154,15 +154,17 @@ class SegmentMatcher:
         return [MatchedPoint(int(e), float(o), bool(s))
                 for e, o, s in zip(*trip)]
 
-    def match_topk(self, trace: Trace,
+    def match_topk(self, trace: Trace, exact: bool = False,
                    ) -> list[tuple[float, list[MatchedPoint]]]:
         """K-best path interpretations of one trace (Meili TopKSearch
         analog). Contract (oracle-pinned by tests/test_topk_oracle.py):
-        the best path is the exact global optimum; each alternate is the
-        exact optimal path ending at one of the final chain's terminal
-        candidates, ranked by cost — a subset of true K-best (alternates
-        that differ only before the terminal are not enumerated; Meili's
-        penalized re-search can return those). jax backend only — the
+        the best path is the exact global optimum; with ``exact=False``
+        (default, cheapest) each alternate is the exact optimal path
+        ending at one of the final chain's terminal candidates — a subset
+        of true K-best; with ``exact=True`` the alternates are the final
+        chain's EXACT K globally-best paths (list Viterbi — the carry
+        grows a rank axis, ops/hmm.viterbi_kbest_paths), which dominates
+        Meili's penalized re-search approximation. jax backend only — the
         reference_cpu backend raises NotImplementedError by contract (it
         exists as a fidelity oracle for the primary path, and its own
         oracle for TopK is the exact list-Viterbi in the test above).
@@ -171,7 +173,8 @@ class SegmentMatcher:
             raise NotImplementedError("match_topk requires the jax backend")
         import jax.numpy as jnp
 
-        from reporter_tpu.ops.hmm import viterbi_topk_paths
+        from reporter_tpu.ops.hmm import (viterbi_kbest_paths,
+                                          viterbi_topk_paths)
         from reporter_tpu.ops.match import batch_candidates
 
         # diagnostic surface: alternates are computed over the first
@@ -187,10 +190,27 @@ class SegmentMatcher:
                                  self.params)
         p = self.params
         trace_cands = type(cands)(*(x[0] for x in cands))
-        choices, scores, ok = viterbi_topk_paths(
-            trace_cands, pj[0], vj[0], self._tables, p.sigma_z, p.beta,
-            p.max_route_distance_factor, p.breakage_distance,
-            p.backward_slack, p.interpolation_distance)
+        if trace.accuracy is not None:
+            # same emission down-weighting match() applies (acc_scale in
+            # _submit_many) — the ranked paths must agree with the primary
+            # decode on accuracy-bearing traces
+            scale = np.ones(pts.shape[1], np.float32)
+            a = np.asarray(trace.accuracy[:len(xy)], np.float32)
+            sz = np.float32(p.sigma_z)
+            scale[:len(a)] = sz / np.maximum(sz, a)
+            trace_cands = trace_cands._replace(
+                dist=trace_cands.dist * jnp.asarray(scale)[:, None])
+        if exact:
+            choices, scores, ok = viterbi_kbest_paths(
+                trace_cands, pj[0], vj[0], self._tables, p.sigma_z, p.beta,
+                p.max_route_distance_factor, p.breakage_distance,
+                p.backward_slack, p.interpolation_distance,
+                num_paths=p.max_candidates)
+        else:
+            choices, scores, ok = viterbi_topk_paths(
+                trace_cands, pj[0], vj[0], self._tables, p.sigma_z, p.beta,
+                p.max_route_distance_factor, p.breakage_distance,
+                p.backward_slack, p.interpolation_distance)
         ce = np.asarray(cands.edge[0])
         co = np.asarray(cands.offset[0])
         out = []

@@ -56,8 +56,11 @@ def _speed_mps(tags: dict[str, str]) -> float:
     return _DEFAULT_SPEED.get(hw.removesuffix("_link"), 13.4)
 
 
-def parse_osm_xml(source: str, name: str = "osm") -> RoadNetwork:
-    """Parse an .osm XML document (path or XML string) into a RoadNetwork."""
+def xml_elements(source: str):
+    """Raw OSM elements off an XML document (path or XML string):
+    (node_pos {id: (lon, lat)}, ways [(id, refs, tags)...], relations
+    [(tags, [(role, member type, ref)...])...]) — build_network's input
+    shape, also what netgen/pbf.write_osm_pbf serializes."""
     if source.lstrip().startswith("<"):
         root = ET.fromstring(source)
     else:
@@ -78,8 +81,12 @@ def parse_osm_xml(source: str, name: str = "osm") -> RoadNetwork:
         members = [(m.get("role"), m.get("type"), int(m.get("ref")))
                    for m in rel.findall("member")]
         raw_relations.append((tags, members))
+    return node_pos, raw_ways, raw_relations
 
-    return build_network(node_pos, raw_ways, raw_relations, name)
+
+def parse_osm_xml(source: str, name: str = "osm") -> RoadNetwork:
+    """Parse an .osm XML document (path or XML string) into a RoadNetwork."""
+    return build_network(*xml_elements(source), name)
 
 
 def build_network(

@@ -470,3 +470,115 @@ def viterbi_topk_paths(cands: CandidateSet, points, valid_pt, tables,
     choices = jax.vmap(back_one)(order)                  # [K, T]
     choices = jnp.where(rank_valid[:, None], choices, -1)
     return choices, rank_score, rank_valid
+
+
+def viterbi_kbest_paths(cands: CandidateSet, points, valid_pt, tables,
+                        sigma_z: float, beta: float, max_route_factor: float,
+                        breakage_distance: float,
+                        backward_slack: float = 10.0,
+                        interpolation_distance: float = 0.0,
+                        num_paths: int = 4):
+    """EXACT K-best paths of ONE trace's final chain (list Viterbi).
+
+    Where viterbi_topk_paths returns the optimal completion per terminal
+    candidate (alternates can only differ in the suffix), this carries the
+    top ``num_paths`` path costs PER LATTICE STATE through the scan — the
+    textbook list-Viterbi / parallel-list decoder, which on TPU is just
+    one more vectorized axis: the carry is [K, R] instead of [K], the
+    per-step reduction a lax.top_k over the (prev candidate × rank)
+    axis. Exactness (scores AND paths, against an independent numpy
+    list-Viterbi oracle) is asserted by tests/test_topk_oracle.py.
+
+    Alternate ranks share the convention of viterbi_topk_paths: earlier
+    chains keep their single best path; ranks enumerate the final chain's
+    K globally-best paths, not per-terminal completions.
+
+    Returns (choice [R, T] i32 candidate slots (-1 unmatched), score [R]
+    f32, valid [R] bool), ranked best-first.
+    """
+    T, K = cands.edge.shape
+    R = int(num_paths)
+    keep = interpolation_keep_mask(points, valid_pt, interpolation_distance)
+    em = emission_costs(cands, sigma_z)                     # [T, K]
+    active = keep & jnp.any(cands.valid, axis=1)            # [T]
+    # flat (candidate, rank) coding: state s = c * R + r
+    identity_bp = jnp.arange(K * R, dtype=jnp.int32).reshape(K, R)
+
+    def slot_view(t_idx):
+        return CandidateSet(edge=cands.edge[t_idx], offset=cands.offset[t_idx],
+                            dist=cands.dist[t_idx], valid=cands.valid[t_idx])
+
+    def step(carry, inp):
+        score, prev_pt, prev_any, prev_idx = carry          # score [K, R]
+        em_t, pt, act_t, t_idx = inp
+
+        gc = jnp.sqrt(jnp.sum((pt - prev_pt) ** 2))
+        trans = transition_costs(slot_view(prev_idx), slot_view(t_idx), gc,
+                                 tables, beta, max_route_factor,
+                                 backward_slack)             # [K, K]
+        trans = jnp.where(gc <= breakage_distance, trans, BIG)
+
+        # via[(cp, r), c] = score[cp, r] + trans[cp, c]; top-R smallest per
+        # c. Ties resolve by ascending flat index — the same (cp, r)
+        # enumeration order the numpy oracle's stable sort uses.
+        via = (score[:, :, None] + trans[:, None, :]).reshape(K * R, K)
+        vals, idxs = jax.lax.top_k(-via.T, R)                # [K(c), R]
+        best_cost = -vals                                    # ascending
+        connected = best_cost < BIG
+        broken = ~jnp.any(connected) | ~prev_any
+
+        restart = jnp.concatenate(
+            [em_t[:, None], jnp.full((K, R - 1), BIG, em_t.dtype)], axis=1)
+        new_score = jnp.where(broken, restart,
+                              jnp.where(connected,
+                                        best_cost + em_t[:, None], BIG))
+        backptr = jnp.where(broken | ~connected, -1, idxs.astype(jnp.int32))
+
+        score_out = jnp.where(act_t, new_score, score)
+        new_carry = (score_out,
+                     jnp.where(act_t, pt, prev_pt),
+                     act_t | prev_any,
+                     jnp.where(act_t, t_idx, prev_idx))
+        emit = (score_out,
+                jnp.where(act_t, backptr, identity_bp),
+                act_t & broken)
+        return new_carry, emit
+
+    init = (jnp.full((K, R), BIG, jnp.float32), points[0], jnp.bool_(False),
+            jnp.int32(0))
+    xs = (em, points, active, jnp.arange(T, dtype=jnp.int32))
+    _, (scores, backptrs, started) = jax.lax.scan(step, init, xs)
+    # scores [T, K, R], backptrs [T, K, R] (flat-coded), started [T]
+
+    final = scores[-1].reshape(K * R)
+    order = jnp.argsort(final)[:R].astype(jnp.int32)         # best R states
+    rank_score = final[order]
+    rank_valid = rank_score < BIG
+
+    def back_one(state):                                     # flat (c, r)
+        def back(carry, inp):
+            nxt_state, nxt_started = carry
+            score_t, bp_next, act_t, started_t = inp
+            safe = jnp.maximum(nxt_state, 0)
+            prop = jnp.where(nxt_state >= 0,
+                             bp_next.reshape(K * R)[safe], -1)
+            # chain boundary: earlier chains keep their single best path
+            own = jnp.argmin(score_t.reshape(K * R)).astype(jnp.int32)
+            own = jnp.where(score_t.reshape(K * R)[own] < BIG, own, -1)
+            terminal = nxt_started | (nxt_state < 0)
+            state_t = jnp.where(terminal, own, prop)
+            out = jnp.where(act_t, state_t, -1)
+            return (state_t, started_t), out
+
+        bp_above = jnp.concatenate(
+            [backptrs[1:],
+             jnp.broadcast_to(state, (1, K, R)).astype(jnp.int32)])
+        rev = (scores[::-1], bp_above[::-1], active[::-1], started[::-1])
+        _, states_rev = jax.lax.scan(
+            back, (state.astype(jnp.int32), jnp.bool_(False)), rev)
+        states = states_rev[::-1]
+        return jnp.where(states >= 0, states // R, -1)       # slot per point
+
+    choices = jax.vmap(back_one)(order)                      # [R, T]
+    choices = jnp.where(rank_valid[:, None], choices, -1)
+    return choices, rank_score, rank_valid

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 import time
 from typing import Any, Callable, Iterable
@@ -74,7 +75,15 @@ def _validate_payload(payload: Any) -> tuple[str, list[dict]]:
         norm = {"lat": float(p["lat"]), "lon": float(p["lon"]),
                 "time": float(p.get("time", i))}
         if "accuracy" in p:   # optional per-point GPS accuracy (m)
-            norm["accuracy"] = float(p["accuracy"])
+            try:
+                acc = float(p["accuracy"])
+            except (TypeError, ValueError):
+                raise BadRequest("'accuracy' must be a number (meters)")
+            # json.loads accepts the NaN/Infinity literals; a NaN scale
+            # would poison the whole trace's decode device-side
+            if not math.isfinite(acc) or acc < 0:
+                raise BadRequest("'accuracy' must be finite and >= 0")
+            norm["accuracy"] = acc
         out.append(norm)
     out.sort(key=lambda p: p["time"])
     return uuid, out

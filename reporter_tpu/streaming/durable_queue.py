@@ -66,9 +66,20 @@ class DurableIngestQueue(IngestQueue):
                     f"reopened with {self.num_partitions} — records would "
                     "be orphaned/mis-routed; migrate explicitly instead")
         else:
+            # Always fsync the pin (file AND directory): it is written once,
+            # and losing it to a power cut while fsync'd records survive
+            # would let a mis-configured reopen recreate it with the wrong
+            # count — the exact corruption the guard refuses.
             with open(meta_path + ".tmp", "w") as f:
                 json.dump({"num_partitions": self.num_partitions}, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(meta_path + ".tmp", meta_path)
+            dfd = os.open(dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         self._files = []
         for p in range(self.num_partitions):
             base, records, good_bytes = self._load_partition(p)

@@ -68,7 +68,24 @@ def main(argv: list[str] | None = None) -> int:
     i = sub.add_parser("info", help="print a compiled tileset's stats")
     i.add_argument("path")
 
+    c = sub.add_parser("convert", help="convert an OSM XML extract to PBF")
+    c.add_argument("xml", help="input .osm/.xml file")
+    c.add_argument("pbf", help="output .osm.pbf path")
+    c.add_argument("--raw", action="store_true",
+                   help="write uncompressed blobs (debugging)")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "convert":
+        from reporter_tpu.netgen.osm_xml import xml_elements
+        from reporter_tpu.netgen.pbf import write_osm_pbf
+
+        node_pos, ways, relations = xml_elements(args.xml)
+        write_osm_pbf(args.pbf, node_pos, ways, relations,
+                      compress=not args.raw)
+        print(json.dumps({"written": args.pbf, "nodes": len(node_pos),
+                          "ways": len(ways), "relations": len(relations)}))
+        return 0
 
     if args.cmd == "info":
         from reporter_tpu.tiles.tileset import TileSet

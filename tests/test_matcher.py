@@ -371,3 +371,37 @@ class TestAccuracy:
             bare = m.match(no_acc)["segments"]
             assert ([s["segment_id"] for s in pin]
                     == [s["segment_id"] for s in bare]), backend
+
+    def test_match_topk_honors_accuracy(self, matchers, short_seg_tiles):
+        """The ranked-paths surface must apply the same accuracy
+        down-weighting as the primary decode: rank 0 on the dragged trace
+        with honest accuracy follows the clean route."""
+        from reporter_tpu.geometry import xy_to_lonlat  # noqa: F401
+
+        ts = short_seg_tiles
+        mj, _ = matchers
+        p = synthesize_probe(ts, seed=22, num_points=50, gps_sigma=1.0)
+        xy = p.xy.copy()
+        k = 25
+        xy[k] += np.float32(30.0 / np.sqrt(2.0))
+        acc = np.zeros(len(xy), np.float32)
+        acc[k] = 100.0
+        dragged = Trace(uuid="d", xy=xy.astype(np.float32), times=p.times,
+                        accuracy=acc)
+        clean = Trace(uuid="c", xy=p.xy.astype(np.float32), times=p.times)
+        def route(pts, skip):
+            # consecutive-deduped edge sequence, ignoring unmatched slots
+            # and the dragged index (its interpolation activity differs
+            # between the two traces)
+            seq = []
+            for i, mp in enumerate(pts):
+                if i == skip or mp.edge < 0:
+                    continue
+                if not seq or seq[-1] != mp.edge:
+                    seq.append(mp.edge)
+            return seq
+
+        for exact in (False, True):
+            best = mj.match_topk(dragged, exact=exact)[0][1]
+            want = mj.match_topk(clean, exact=exact)[0][1]
+            assert route(best, k) == route(want, k), exact

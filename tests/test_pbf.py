@@ -169,3 +169,11 @@ class TestCLI:
         ts = TileSet.load(out)
         assert ts.name == "city"
         assert ts.num_edges > 0
+
+    def test_convert_subcommand(self, tmp_path):
+        from reporter_tpu.tiles.__main__ import main
+
+        pbf = str(tmp_path / "conv.osm.pbf")
+        assert main(["convert", FIXTURE, pbf]) == 0
+        _assert_networks_equal(parse_osm_xml(FIXTURE, name="x"),
+                               parse_osm_pbf(pbf, name="x"))

@@ -149,6 +149,12 @@ class TestEndpoints:
         ("POST", "/report", {"trace": [{"lat": 0, "lon": 0}]}, 400),  # no uuid
         ("POST", "/report", {"uuid": "v", "trace": []}, 400),  # empty trace
         ("POST", "/report", {"uuid": "v", "trace": [{"lat": 1}]}, 400),
+        ("POST", "/report", {"uuid": "v", "trace": [
+            {"lat": 1, "lon": 1, "accuracy": "25m"}]}, 400),  # non-numeric
+        ("POST", "/report", {"uuid": "v", "trace": [
+            {"lat": 1, "lon": 1, "accuracy": float("nan")}]}, 400),  # NaN
+        ("POST", "/report", {"uuid": "v", "trace": [
+            {"lat": 1, "lon": 1, "accuracy": -3.0}]}, 400),   # negative
         ("GET", "/report", None, 405),
         ("POST", "/nope", {"x": 1}, 404),
     ])

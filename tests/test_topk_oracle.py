@@ -173,3 +173,82 @@ class TestTopKOracle:
         s = [float(x) for x, okr in zip(lattice["scores"], lattice["ok"])
              if okr]
         assert s == sorted(s)
+
+
+class TestExactKBest:
+    """viterbi_kbest_paths must reproduce the exact oracle: scores AND
+    full paths, rank for rank — not just dominate it."""
+
+    @pytest.fixture(scope="class")
+    def kbest(self, lattice):
+        import jax.numpy as jnp
+
+        from reporter_tpu.config import CompilerParams, Config
+        from reporter_tpu.ops.hmm import viterbi_kbest_paths
+        from reporter_tpu.ops.match import batch_candidates
+
+        # Recreate the same lattice inputs the module fixture used.
+        ts = compile_network(generate_city("tiny"),
+                             CompilerParams(reach_radius=500.0,
+                                            osmlr_max_length=250.0))
+        m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+        p = m.params
+        probe = synthesize_probe(ts, seed=5, num_points=14, speed_mps=12.0,
+                                 gps_sigma=2.0)
+        xy = probe.xy.astype(np.float32)
+        T = len(xy)
+        pts = np.zeros((1, _bucket_len(T), 2), np.float32)
+        pts[0, :T] = xy
+        valid = np.zeros((1, pts.shape[1]), bool)
+        valid[0, :T] = True
+        pj, vj = jnp.asarray(pts), jnp.asarray(valid)
+        cands = batch_candidates(pj, vj, m._tables, ts.meta, p)
+        trace_cands = CandidateSet(*(x[0] for x in cands))
+        choices, scores, ok = viterbi_kbest_paths(
+            trace_cands, pj[0], vj[0], m._tables, p.sigma_z, p.beta,
+            p.max_route_distance_factor, p.breakage_distance,
+            p.backward_slack, p.interpolation_distance,
+            num_paths=R_ORACLE)
+        return (np.asarray(choices), np.asarray(scores), np.asarray(ok))
+
+    def test_matches_oracle_exactly(self, lattice, kbest):
+        choices, scores, ok = kbest
+        want, _ = _oracle_topr(lattice["em"], lattice["trans"], R_ORACLE)
+        act = lattice["act_idx"]
+        n = min(int(ok.sum()), len(want))
+        assert n >= 3, "need several exact alternates to compare"
+        for r in range(n):
+            np.testing.assert_allclose(scores[r], want[r][0], rtol=1e-4,
+                                       err_msg=f"rank {r}")
+            assert tuple(choices[r][act]) == want[r][1], f"rank {r}"
+
+    def test_dominates_terminal_completion(self, lattice, kbest):
+        """Exact K-best scores are <= the terminal-completion scores rank
+        for rank (they optimize over a superset of paths)."""
+        _, scores, ok = kbest
+        tc = [float(s) for s, okr in
+              zip(lattice["scores"], lattice["ok"]) if okr]
+        ex = [float(s) for s, okr in zip(scores, ok) if okr]
+        for r in range(min(len(tc), len(ex))):
+            assert ex[r] <= tc[r] + 1e-3, f"rank {r}"
+
+    def test_match_topk_exact_surface(self, lattice):
+        from reporter_tpu.config import CompilerParams, Config
+
+        ts = compile_network(generate_city("tiny"),
+                             CompilerParams(reach_radius=500.0,
+                                            osmlr_max_length=250.0))
+        m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+        probe = synthesize_probe(ts, seed=5, num_points=14, speed_mps=12.0,
+                                 gps_sigma=2.0)
+        tr = Trace(uuid="e", xy=probe.xy.astype(np.float32),
+                   times=probe.times)
+        exact = m.match_topk(tr, exact=True)
+        approx = m.match_topk(tr)
+        assert exact and approx
+        s_e = [s for s, _ in exact]
+        assert s_e == sorted(s_e)
+        # rank 0 agrees between modes (both are the global optimum)
+        np.testing.assert_allclose(s_e[0], approx[0][0], rtol=1e-4)
+        assert [mp.edge for mp in exact[0][1]] == \
+               [mp.edge for mp in approx[0][1]]
