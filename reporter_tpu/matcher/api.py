@@ -73,6 +73,22 @@ class MatchedPoint:
     chain_start: bool
 
 
+def _accuracy_scale(accuracy: "np.ndarray | None", sigma_z: float,
+                    n: int) -> np.ndarray:
+    """[n] f32 emission distance scale: sigma_z / max(sigma_z, accuracy),
+    1.0 where accuracy is absent. THE accuracy rule — shared by the batch
+    path (_submit_many) and the ranked-paths path (match_topk) so they
+    cannot drift; the CPU oracle implements the same rule as a per-point
+    sigma (cpu_reference.match_trace_cpu)."""
+    scale = np.ones(n, np.float32)
+    if accuracy is None:
+        return scale
+    a = np.asarray(accuracy[:n], np.float32)
+    sz = np.float32(sigma_z)
+    scale[:len(a)] = sz / np.maximum(sz, a)
+    return scale
+
+
 def _dijkstra_route_fn(ts: TileSet, bound: float,
                        cache: "cpu_reference.DijkstraCache"):
     def route(e1: int, e2: int):
@@ -191,13 +207,10 @@ class SegmentMatcher:
         p = self.params
         trace_cands = type(cands)(*(x[0] for x in cands))
         if trace.accuracy is not None:
-            # same emission down-weighting match() applies (acc_scale in
-            # _submit_many) — the ranked paths must agree with the primary
-            # decode on accuracy-bearing traces
-            scale = np.ones(pts.shape[1], np.float32)
-            a = np.asarray(trace.accuracy[:len(xy)], np.float32)
-            sz = np.float32(p.sigma_z)
-            scale[:len(a)] = sz / np.maximum(sz, a)
+            # same emission down-weighting match() applies — the ranked
+            # paths must agree with the primary decode
+            scale = _accuracy_scale(trace.accuracy[:len(xy)], p.sigma_z,
+                                    pts.shape[1])
             trace_cands = trace_cands._replace(
                 dist=trace_cands.dist * jnp.asarray(scale)[:, None])
         if exact:
@@ -298,14 +311,12 @@ class SegmentMatcher:
             scale = None
             if any(traces[work[w][0]].accuracy is not None for w in ws):
                 scale = np.ones((B, b), np.float32)
-                sz = np.float32(self.params.sigma_z)
                 for r, w in enumerate(ws):
                     i, lo, xy = work[w]
                     a = traces[i].accuracy
-                    if a is None:
-                        continue
-                    a = np.asarray(a[lo:lo + len(xy)], np.float32)
-                    scale[r, :len(a)] = sz / np.maximum(sz, a)
+                    if a is not None:
+                        scale[r] = _accuracy_scale(
+                            a[lo:lo + len(xy)], self.params.sigma_z, b)
             acc_scale = None if scale is None else jnp.asarray(scale)
             origins = pts[:, 0, :].copy()
             dq = np.round((pts - origins[:, None, :])

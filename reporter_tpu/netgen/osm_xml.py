@@ -15,6 +15,7 @@ import xml.etree.ElementTree as ET
 
 import numpy as np
 
+from reporter_tpu.geometry import lonlat_to_xy
 from reporter_tpu.netgen.network import RoadNetwork, TurnRestriction, Way
 
 DRIVABLE_HIGHWAY = {
@@ -41,6 +42,11 @@ _DEFAULT_SPEED = {  # m/s by highway class
     "motorway": 29.0, "trunk": 24.5, "primary": 17.9, "secondary": 15.6,
     "tertiary": 13.4, "residential": 11.2, "service": 6.7, "living_street": 4.5,
 }
+
+# Interior shape runs longer than this split into separate legs/edges:
+# keeps edge offsets far inside the u16 wire range (16.4 km) and candidate
+# search output well-conditioned on rural roads with distant junctions.
+_MAX_LEG_LENGTH = 5000.0  # meters
 
 
 def _speed_mps(tags: dict[str, str]) -> float:
@@ -114,10 +120,63 @@ def build_network(
             drivable.append((way_id, refs, tags))
     raw_ways = drivable
 
-    # Keep only nodes referenced by drivable ways; remap to dense indices.
-    used: dict[int, int] = {}
+    # Graph simplification (what valhalla_build_tiles does with OSM shape
+    # nodes): only JUNCTION nodes become graph nodes — way endpoints,
+    # nodes shared between drivable ways (or revisited within one), and
+    # restriction via nodes. Interior degree-2 refs are curve shape, not
+    # topology; they collapse into per-leg edge geometry (Way.geometry →
+    # the compiler's per-edge polylines), which keeps node/edge counts —
+    # and with them reach tables and HMM transition work — proportional
+    # to the road TOPOLOGY instead of to how smoothly the mapper drew the
+    # curves. Collapsed runs split at _MAX_LEG_LENGTH so edge offsets
+    # stay far inside the u16 wire range.
+    ref_count: dict[int, int] = {}
+    junction: set[int] = set()
     for _, refs, _ in raw_ways:
+        junction.add(refs[0])
+        junction.add(refs[-1])
         for r in refs:
+            n = ref_count.get(r, 0) + 1
+            ref_count[r] = n
+            if n >= 2:
+                junction.add(r)
+    for tags, members in raw_relations:
+        if tags.get("type") == "restriction":
+            for role, mtype, ref in members:
+                if role == "via" and mtype == "node":
+                    junction.add(ref)
+
+    def leg_split(refs: list[int]):
+        """Split one way's refs at junctions (and length caps) into legs:
+        (junction refs, {leg index: interior lonlat array}). Lengths come
+        from geometry.lonlat_to_xy — the same local metric the compiler
+        measures edges in."""
+        ll = np.asarray([node_pos[r] for r in refs], np.float64)
+        step = np.hypot(*np.diff(lonlat_to_xy(ll, ll[0]), axis=0).T)
+        nodes = [refs[0]]
+        geometry: dict[int, np.ndarray] = {}
+        interior: list[tuple[float, float]] = []
+        acc = 0.0
+        for j, r in enumerate(refs[1:]):
+            acc += float(step[j])
+            if r in junction or acc >= _MAX_LEG_LENGTH or r == refs[-1]:
+                if interior:
+                    geometry[len(nodes) - 1] = np.asarray(interior,
+                                                          np.float64)
+                nodes.append(r)
+                interior = []
+                acc = 0.0
+            else:
+                interior.append(node_pos[r])
+        return nodes, geometry
+
+    # Keep only junction nodes; remap to dense indices.
+    used: dict[int, int] = {}
+    split_ways: list[tuple[int, list[int], dict, dict[str, str]]] = []
+    for way_id, refs, tags in raw_ways:
+        nodes, geometry = leg_split(refs)
+        split_ways.append((way_id, nodes, geometry, tags))
+        for r in nodes:
             if r not in used:
                 used[r] = len(used)
     lonlat = np.zeros((len(used), 2), dtype=np.float64)
@@ -126,14 +185,18 @@ def build_network(
 
     ways: list[Way] = []
     drivable_way_ids = set()
-    for way_id, refs, tags in raw_ways:
+    for way_id, refs, geometry, tags in split_ways:
         ow = tags.get("oneway", "no") in ("yes", "true", "1")
         nodes = [used[r] for r in refs]
         if tags.get("oneway") == "-1":
             nodes = nodes[::-1]
             ow = True
+            # leg i of the reversed way is original leg L-1-i, driven
+            # backwards — reverse its interior points too
+            L = len(refs) - 1
+            geometry = {L - 1 - i: g[::-1] for i, g in geometry.items()}
         ways.append(
-            Way(way_id=way_id, nodes=nodes, oneway=ow,
+            Way(way_id=way_id, nodes=nodes, oneway=ow, geometry=geometry,
                 name=tags.get("name", ""), speed_mps=_speed_mps(tags))
         )
         drivable_way_ids.add(way_id)

@@ -70,19 +70,27 @@ def _validate_payload(payload: Any) -> tuple[str, list[dict]]:
             raise BadRequest("trace points need 'lat' and 'lon'")
     # Points without explicit time get index seconds (reference tolerates
     # timeless fixtures the same way).
+    # json.loads accepts the NaN/Infinity literals, and a single NaN
+    # coordinate/scale poisons the whole trace's decode device-side —
+    # every numeric field must be a finite number or the request is a 400.
+    def finite(p: dict, key: str, default=None) -> float:
+        v = p.get(key, default)
+        try:
+            f = float(v)
+        except (TypeError, ValueError):
+            raise BadRequest(f"{key!r} must be a number")
+        if not math.isfinite(f):
+            raise BadRequest(f"{key!r} must be finite")
+        return f
+
     out = []
     for i, p in enumerate(pts):
-        norm = {"lat": float(p["lat"]), "lon": float(p["lon"]),
-                "time": float(p.get("time", i))}
+        norm = {"lat": finite(p, "lat"), "lon": finite(p, "lon"),
+                "time": finite(p, "time", i)}
         if "accuracy" in p:   # optional per-point GPS accuracy (m)
-            try:
-                acc = float(p["accuracy"])
-            except (TypeError, ValueError):
-                raise BadRequest("'accuracy' must be a number (meters)")
-            # json.loads accepts the NaN/Infinity literals; a NaN scale
-            # would poison the whole trace's decode device-side
-            if not math.isfinite(acc) or acc < 0:
-                raise BadRequest("'accuracy' must be finite and >= 0")
+            acc = finite(p, "accuracy")
+            if acc < 0:
+                raise BadRequest("'accuracy' must be >= 0")
             norm["accuracy"] = acc
         out.append(norm)
     out.sort(key=lambda p: p["time"])

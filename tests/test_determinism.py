@@ -83,7 +83,10 @@ def test_cli_synth_info_build(tmp_path):
     from reporter_tpu.tiles.tileset import TileSet
 
     ts = TileSet.load(str(out2))
-    assert ts.num_edges == 4  # one residential two-way chain
+    # one two-way chain; the interior node collapses to shape geometry
+    # (graph simplification), so 2 directed edges over 4 line segments
+    assert ts.num_edges == 2
+    assert len(ts.seg_edge) == 4
 
 
 def test_utils_surfaces(tmp_path, monkeypatch):

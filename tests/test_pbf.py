@@ -46,6 +46,9 @@ def _assert_networks_equal(a, b):
         assert (wa.way_id, wa.nodes, wa.oneway) == (
             wb.way_id, wb.nodes, wb.oneway)
         assert wa.speed_mps == pytest.approx(wb.speed_mps)
+        assert sorted(wa.geometry) == sorted(wb.geometry)
+        for leg, g in wa.geometry.items():
+            np.testing.assert_allclose(g, wb.geometry[leg], atol=1e-12)
     assert [(r.from_way, r.via_node, r.to_way, r.kind)
             for r in a.restrictions] == \
            [(r.from_way, r.via_node, r.to_way, r.kind)
@@ -95,10 +98,14 @@ class TestRoundTrip:
         pbf = str(tmp_path / "gran.pbf")
         write_osm_pbf(pbf, node_pos, ways, granularity=1000)
         net = parse_osm_pbf(pbf)
+        # interior node 2 collapses to leg shape (graph simplification)
         np.testing.assert_allclose(
             net.node_lonlat,
-            [[-122.414100, 37.750000], [-122.413200, 37.750100],
-             [-122.412300, 37.750200]], atol=1.1e-6)
+            [[-122.414100, 37.750000], [-122.412300, 37.750200]],
+            atol=1.1e-6)
+        np.testing.assert_allclose(
+            net.ways[0].geometry[0], [[-122.413200, 37.750100]],
+            atol=1.1e-6)
 
     def test_negative_and_large_ids(self, tmp_path):
         """Zigzag + delta coding across sign changes and 2^40-scale ids
@@ -114,7 +121,9 @@ class TestRoundTrip:
         assert len(net.ways) == 1
         assert net.ways[0].way_id == big + 77
         assert net.ways[0].oneway
-        assert len(net.node_lonlat) == 4
+        # 2 junction endpoints; the 2 interior refs are leg shape
+        assert len(net.node_lonlat) == 2
+        assert len(net.ways[0].geometry[0]) == 2
 
     def test_southern_western_hemisphere(self, tmp_path):
         """Negative lat/lon exercise signed dense-node deltas."""
@@ -127,8 +136,9 @@ class TestRoundTrip:
         net = parse_osm_pbf(pbf)
         np.testing.assert_allclose(
             net.node_lonlat,
-            [[-70.6506, -33.4372], [-70.6505, -33.4371],
-             [-70.6504, -33.4370]], atol=1e-12)
+            [[-70.6506, -33.4372], [-70.6504, -33.4370]], atol=1e-12)
+        np.testing.assert_allclose(
+            net.ways[0].geometry[0], [[-70.6505, -33.4371]], atol=1e-12)
 
 
 class TestErrors:

@@ -252,3 +252,50 @@ class TestExactKBest:
         np.testing.assert_allclose(s_e[0], approx[0][0], rtol=1e-4)
         assert [mp.edge for mp in exact[0][1]] == \
                [mp.edge for mp in approx[0][1]]
+
+
+def test_kbest_rank0_equals_primary_decode_with_breakage():
+    """Pin viterbi_kbest_paths' scan scaffolding (restart/broken/inactive
+    semantics) to the primary decode on traces WITH chain breaks — the
+    oracle lattice fixture is break-free, so this is the coverage that
+    keeps the [K, R] copy from drifting on the parts the oracle can't
+    see. Rank 0 must reproduce match()'s per-point choices exactly."""
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.hmm import viterbi_decode, viterbi_kbest_paths
+    from reporter_tpu.ops.match import batch_candidates
+
+    ts = compile_network(generate_city("tiny"),
+                         CompilerParams(reach_radius=500.0,
+                                        osmlr_max_length=250.0))
+    m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    p = m.params
+    # stitch two distant on-map drives: the seam exceeds
+    # breakage_distance but both halves still have candidates
+    pa = synthesize_probe(ts, seed=8, num_points=20, gps_sigma=2.0)
+    pb = synthesize_probe(ts, seed=31, num_points=20, gps_sigma=2.0)
+    xy = np.concatenate([pa.xy, pb.xy]).astype(np.float32)
+    # the tiny map is smaller than the default breakage_distance, so
+    # tighten it below the seam gap to force the break
+    breakage = 300.0
+    assert np.linalg.norm(pa.xy[-1] - pb.xy[0]) > breakage, \
+        "pick seeds whose drives are farther apart"
+    T = len(xy)
+    pts = np.zeros((1, _bucket_len(T), 2), np.float32)
+    pts[0, :T] = xy
+    valid = np.zeros((1, pts.shape[1]), bool)
+    valid[0, :T] = True
+    pj, vj = jnp.asarray(pts), jnp.asarray(valid)
+    cands = batch_candidates(pj, vj, m._tables, ts.meta, p)
+    tc = CandidateSet(*(x[0] for x in cands))
+
+    args = (tc, pj[0], vj[0], m._tables, p.sigma_z, p.beta,
+            p.max_route_distance_factor, breakage,
+            p.backward_slack, p.interpolation_distance)
+    primary = viterbi_decode(*args)
+    choices, scores, ok = viterbi_kbest_paths(*args, num_paths=4)
+    assert bool(ok[0])
+    assert bool(np.asarray(primary.chain_start)[:T].sum() >= 2), \
+        "fixture must actually break"
+    np.testing.assert_array_equal(np.asarray(choices[0]),
+                                  np.asarray(primary.choice))
