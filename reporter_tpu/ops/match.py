@@ -121,12 +121,14 @@ def match_batch(points, valid_pt, tables: dict[str, Any], meta: TileMeta,
     return match_traces(points, valid_pt, tables, meta, params)
 
 
-# Wire format (match_batch_wire): one u16 [B, 3, T] array so the decode
+# Wire format (match_batch_wire): one u16 [B, 2|3, T] array so the decode
 # result crosses the device→host link as a SINGLE transfer (a remote-attached
-# chip pays a round-trip per fetched array, and bytes are the bottleneck):
+# chip pays a round-trip per fetched array, and bytes are the bottleneck).
+# Full 3-lane layout:
 #   lane 0: offset along edge, 0.25 m fixed-point (u16 ⇒ edges to 16.4 km)
 #   lane 1: edge id low 16 bits
 #   lane 2: edge id bits 16..28 | chain_start << 14 | matched << 15
+# Small metros use the compact 2-lane layout (see _COMPACT_WIRE_EDGES).
 OFFSET_QUANTUM = 0.25
 
 
@@ -134,13 +136,13 @@ OFFSET_QUANTUM = 0.25
 def match_batch_wire(points, lengths, tables: dict[str, Any], meta: TileMeta,
                      params: MatcherParams, acc_scale=None):
     """points f32 [B, T, 2], lengths i32 [B] (valid prefix per trace) →
-    u16 [B, 3, T] wire array; unpack with unpack_wire(). acc_scale: see
+    u16 [B, 2|3, T] wire array; unpack with unpack_wire(). acc_scale: see
     match_traces (None traces a separate, scale-free executable, so
     accuracy-less batches pay nothing)."""
     T = points.shape[1]
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
     out = match_traces(points, valid, tables, meta, params, acc_scale)
-    return _pack_wire(out)
+    return _pack_wire(out, tables["edge_len"].shape[0])
 
 
 @functools.partial(jax.jit, static_argnames=("meta", "params"))
@@ -156,13 +158,28 @@ def match_batch_wire_q(points_q, origins, lengths, tables: dict[str, Any],
         OFFSET_QUANTUM)
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
     out = match_traces(points, valid, tables, meta, params, acc_scale)
-    return _pack_wire(out)
+    return _pack_wire(out, tables["edge_len"].shape[0])
 
 
-def _pack_wire(out: MatchOutput):
+# Compact 2-lane format: metros under _COMPACT_WIRE_EDGES directed edges
+# (most single-city tiles — sf's 5.3k qualifies, bayarea's 54k does not)
+# fit the edge id in 14 bits, so lane 1 carries id | start | matched and
+# lane 0 the offset — one third fewer device→host bytes on exactly the
+# link-bound path. The format is chosen statically from the edge count
+# (tables shape → trace-time constant); unpack_wire dispatches on the
+# lane-count axis, so every consumer handles both.
+_COMPACT_WIRE_EDGES = 1 << 14
+
+
+def _pack_wire(out: MatchOutput, num_edges: int):
     edge = jnp.maximum(out.edge, 0).astype(jnp.uint32)
     off_q = jnp.clip(jnp.round(out.offset / OFFSET_QUANTUM), 0, 65535)
     w0 = off_q.astype(jnp.uint16)
+    if num_edges <= _COMPACT_WIRE_EDGES:
+        w1 = (edge & 0x3FFF
+              | (out.chain_start.astype(jnp.uint32) << 14)
+              | (out.matched.astype(jnp.uint32) << 15)).astype(jnp.uint16)
+        return jnp.stack([w0, w1], axis=1)
     w1 = (edge & 0xFFFF).astype(jnp.uint16)
     w2 = ((edge >> 16) & 0x1FFF
           | (out.chain_start.astype(jnp.uint32) << 14)
@@ -171,15 +188,21 @@ def _pack_wire(out: MatchOutput):
 
 
 def unpack_wire(wire) -> tuple[Any, Any, Any]:
-    """numpy unpack: u16 [B, 3, T] → (edges i32 [B,T] with -1 unmatched,
+    """numpy unpack: u16 [B, 2|3, T] → (edges i32 [B,T] with -1 unmatched,
     offsets f32 [B,T], chain_starts bool [B,T])."""
     import numpy as np
 
     w0 = wire[:, 0].astype(np.int64)
-    w1 = wire[:, 1].astype(np.int64)
-    w2 = wire[:, 2].astype(np.int64)
-    matched = (w2 >> 15) & 1
-    edges = np.where(matched == 1, w1 | ((w2 & 0x1FFF) << 16), -1)
+    if wire.shape[1] == 2:                  # compact: id(14) | start | matched
+        w1 = wire[:, 1].astype(np.int64)
+        matched = (w1 >> 15) & 1
+        edges = np.where(matched == 1, w1 & 0x3FFF, -1)
+        starts = ((w1 >> 14) & 1).astype(bool)
+    else:
+        w1 = wire[:, 1].astype(np.int64)
+        w2 = wire[:, 2].astype(np.int64)
+        matched = (w2 >> 15) & 1
+        edges = np.where(matched == 1, w1 | ((w2 & 0x1FFF) << 16), -1)
+        starts = ((w2 >> 14) & 1).astype(bool)
     offsets = (w0 * OFFSET_QUANTUM).astype(np.float32)
-    starts = ((w2 >> 14) & 1).astype(bool)
     return edges.astype(np.int32), offsets, starts

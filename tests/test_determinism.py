@@ -5,6 +5,7 @@ which batch it rode in)."""
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from reporter_tpu.config import MatcherParams
 from reporter_tpu.netgen.traces import synthesize_fleet
@@ -116,9 +117,16 @@ def test_utils_surfaces(tmp_path, monkeypatch):
     assert (tmp_path / "trace").exists()
 
 
-def test_wire_format_roundtrip_random():
-    """u16 wire pack/unpack is lossless for edge ids (<2^29), flags, and
-    0.25m-quantized offsets across random MatchOutput values."""
+@pytest.mark.parametrize("num_edges,max_id,lanes", [
+    (2 ** 29, 2 ** 29 - 1, 3),          # full 3-lane format
+    (5000, 4999, 2),                     # compact small-metro format
+    (2 ** 14, 2 ** 14 - 1, 2),           # boundary: largest compact metro
+    (2 ** 14 + 1, 2 ** 14, 3),           # boundary: smallest full metro
+])
+def test_wire_format_roundtrip_random(num_edges, max_id, lanes):
+    """u16 wire pack/unpack is lossless for edge ids, flags, and
+    0.25m-quantized offsets across random MatchOutput values — in both
+    the full and the compact small-metro layouts."""
     import jax.numpy as jnp
 
     from reporter_tpu.ops.match import (OFFSET_QUANTUM, MatchOutput,
@@ -126,7 +134,7 @@ def test_wire_format_roundtrip_random():
 
     rng = np.random.default_rng(8)
     B, T = 16, 64
-    edges = rng.integers(0, 2 ** 29 - 1, size=(B, T), dtype=np.int64)
+    edges = rng.integers(0, max_id, size=(B, T), dtype=np.int64)
     matched = rng.random((B, T)) < 0.8
     edges = np.where(matched, edges, -1).astype(np.int32)
     offsets = (rng.integers(0, 65535, size=(B, T))
@@ -136,8 +144,9 @@ def test_wire_format_roundtrip_random():
 
     wire = np.asarray(_pack_wire(MatchOutput(
         edge=jnp.asarray(edges), offset=jnp.asarray(offsets),
-        chain_start=jnp.asarray(starts), matched=jnp.asarray(matched))))
-    assert wire.dtype == np.uint16 and wire.shape == (B, 3, T)
+        chain_start=jnp.asarray(starts), matched=jnp.asarray(matched)),
+        num_edges))
+    assert wire.dtype == np.uint16 and wire.shape == (B, lanes, T)
 
     e2, o2, s2 = unpack_wire(wire)
     np.testing.assert_array_equal(e2, edges)
