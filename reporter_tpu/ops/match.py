@@ -193,13 +193,12 @@ def unpack_wire(wire) -> tuple[Any, Any, Any]:
     import numpy as np
 
     w0 = wire[:, 0].astype(np.int64)
+    w1 = wire[:, 1].astype(np.int64)
     if wire.shape[1] == 2:                  # compact: id(14) | start | matched
-        w1 = wire[:, 1].astype(np.int64)
         matched = (w1 >> 15) & 1
         edges = np.where(matched == 1, w1 & 0x3FFF, -1)
         starts = ((w1 >> 14) & 1).astype(bool)
     else:
-        w1 = wire[:, 1].astype(np.int64)
         w2 = wire[:, 2].astype(np.int64)
         matched = (w2 >> 15) & 1
         edges = np.where(matched == 1, w1 | ((w2 & 0x1FFF) << 16), -1)

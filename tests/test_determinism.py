@@ -134,8 +134,11 @@ def test_wire_format_roundtrip_random(num_edges, max_id, lanes):
 
     rng = np.random.default_rng(8)
     B, T = 16, 64
-    edges = rng.integers(0, max_id, size=(B, T), dtype=np.int64)
+    edges = rng.integers(0, max_id, size=(B, T), dtype=np.int64,
+                         endpoint=True)
+    edges[0, 0] = max_id               # the boundary id, deterministically
     matched = rng.random((B, T)) < 0.8
+    matched[0, 0] = True
     edges = np.where(matched, edges, -1).astype(np.int32)
     offsets = (rng.integers(0, 65535, size=(B, T))
                * OFFSET_QUANTUM).astype(np.float32)
