@@ -49,18 +49,25 @@ def main() -> None:
 
     cfg = Config()
     cfg = dataclasses.replace(
-        cfg, service=dataclasses.replace(cfg.service,
-                                         datastore_url="http://datastore"))
+        cfg,
+        service=dataclasses.replace(cfg.service,
+                                    datastore_url="http://datastore"),
+        # big flush threshold so the late drives below stay BUFFERED when
+        # the worker dies (the first batch flushes via force_flush)
+        streaming=dataclasses.replace(cfg.streaming, flush_min_points=100))
 
     # ---- producer side: probes → partitioned durable log ----------------
     queue = DurableIngestQueue(log_dir, cfg.streaming.num_partitions)
     fleet = synthesize_fleet(ts, 8, num_points=60, seed=4)
-    records = [{"uuid": p.uuid, "lat": float(la), "lon": float(lo),
-                "time": float(t)}
-               for p in fleet
-               for (lo, la), t in zip(p.lonlat, p.times)]
-    for r in records[:300]:
-        queue.append(r)
+
+    def points_of(p, lo, hi):
+        return [{"uuid": p.uuid, "lat": float(la), "lon": float(lo_),
+                 "time": float(t)}
+                for (lo_, la), t in zip(p.lonlat[lo:hi], p.times[lo:hi])]
+
+    for p in fleet[:5]:                       # five full drives up front
+        for r in points_of(p, 0, 60):
+            queue.append(r)
     print(f"produced 300 records into {queue.num_partitions} partitions "
           f"(lag {queue.lag([0] * queue.num_partitions)})")
 
@@ -72,10 +79,14 @@ def main() -> None:
     print(f"worker flushed {n} reports; {flushed} segments of "
           "speed+queue histogram deltas published; checkpointed")
 
-    # late records arrive, get consumed but NOT flushed, then the worker dies
-    for r in records[300:]:
-        queue.append(r)
+    # Late records arrive — under flush_min_points per vehicle, so step()
+    # consumes them into buffers WITHOUT flushing. Then the worker dies
+    # with those drives only in (a) its buffers and (b) the log.
+    for p in fleet[5:]:
+        for r in points_of(p, 0, 60):
+            queue.append(r)
     pipe.step()
+    assert pipe.stats()["buffered_points"] > 0   # genuinely unflushed
     queue.close()
     del pipe                              # the crash
 

@@ -75,3 +75,47 @@ def test_mixed_lengths_one_batch():
     solo = [m.match_many([t])[0] for t in traces]
     for b, s in zip(batched, solo):
         assert [r.segment_id for r in b] == [r.segment_id for r in s]
+
+
+@pytest.mark.parametrize("seed", [7, 19])
+def test_irregular_geometry_backend_agreement(seed):
+    """Same 0.95 gate on NON-grid geometry (ramps, dual carriageways,
+    cul-de-sacs — the shapes HMM matchers actually get stressed by)."""
+    import os
+
+    from reporter_tpu.netgen.osm_xml import parse_osm_xml
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "irregular.osm")
+    ts = compile_network(parse_osm_xml(fixture, name="irr"),
+                         CompilerParams(reach_radius=400.0,
+                                        osmlr_max_length=250.0))
+    fleet = synthesize_fleet(ts, 6, num_points=50, seed=seed)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype("float32"), times=p.times)
+              for p in fleet]
+    m_jax = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    m_cpu = SegmentMatcher(ts, Config(matcher_backend="reference_cpu"))
+    agree, total = length_weighted_agreement(m_jax.match_many(traces),
+                                             m_cpu.match_many(traces))
+    assert agree / total >= 0.95, f"seed {seed}: {agree:.1f}/{total:.1f}"
+
+
+def test_degenerate_accuracy_does_not_crash():
+    """Accuracy extremes (0, huge, mixed) must neither crash nor emit
+    non-finite records on either backend."""
+    ts = compile_network(generate_city("tiny"), CompilerParams())
+    fleet = synthesize_fleet(ts, 2, num_points=30, seed=3)
+    cases = []
+    for p in fleet:
+        for acc in (np.zeros(30, np.float32),
+                    np.full(30, 1e6, np.float32),
+                    np.where(np.arange(30) % 2 == 0, 0.0, 500.0
+                             ).astype(np.float32)):
+            cases.append(Trace(uuid=p.uuid, xy=p.xy.astype("float32"),
+                               times=p.times, accuracy=acc))
+    for backend in ("jax", "reference_cpu"):
+        m = SegmentMatcher(ts, Config(matcher_backend=backend))
+        for recs in m.match_many(cases):
+            for r in recs:
+                assert np.isfinite(r.length)
+                assert np.isfinite(r.queue_length)
