@@ -16,6 +16,7 @@ checkpoints offsets + buffers + histograms for crash recovery
 """
 
 from reporter_tpu.streaming.broker import ProbeConsumer
+from reporter_tpu.streaming.formatter import ProbeFormatter
 from reporter_tpu.streaming.queue import IngestQueue
 from reporter_tpu.streaming.durable_queue import DurableIngestQueue
 from reporter_tpu.streaming.histogram import SpeedHistogram
@@ -23,4 +24,5 @@ from reporter_tpu.streaming.pipeline import StreamPipeline
 from reporter_tpu.streaming.worker import StreamWorker
 
 __all__ = ["DurableIngestQueue", "IngestQueue", "ProbeConsumer",
-           "SpeedHistogram", "StreamPipeline", "StreamWorker"]
+           "ProbeFormatter", "SpeedHistogram", "StreamPipeline",
+           "StreamWorker"]

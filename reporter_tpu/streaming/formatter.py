@@ -1,0 +1,182 @@
+"""Probe formatter — the reference's raw→formatted normalization stage.
+
+The reference's Kafka pipeline interposes a formatter worker between the
+raw probe topic and the matcher workers (SURVEY.md §2.1 "Kafka streaming
+workers": consume raw probe messages; *normalize/format*; partition by
+uuid): vendors deliver probes as CSV lines, differently-keyed JSON, or
+nested envelopes, and only canonical records reach the matcher. This
+module is that stage: ``ProbeFormatter.normalize`` maps one raw vendor
+payload to the canonical record the pipeline buffers
+(``{"uuid", "lat", "lon", "time"[, "accuracy"]}``), and ``format_stream``
+pumps raw payloads into a broker, preserving the invariant the rest of
+the system relies on — records are partitioned by uuid AFTER
+normalization, so one vehicle's stream lands in one partition regardless
+of the vendor format it arrived in.
+
+Formats are pluggable: built-ins cover canonical JSON dicts, flat CSV
+lines, and common vendor field aliases; ``register`` adds new ones
+without touching the pipeline. Malformed payloads return None and are
+counted — the formatter drops them so a poison vendor message can never
+wedge a partition (the same stance StreamPipeline takes post-broker).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Callable
+
+# one raw payload → canonical record dict, or None when not this format
+FormatFn = Callable[[Any], "dict | None"]
+
+_ALIASES = {
+    "uuid": ("uuid", "id", "vehicle_id", "device_id", "driver_id"),
+    "lat": ("lat", "latitude", "y"),
+    "lon": ("lon", "lng", "longitude", "x"),
+    "time": ("time", "timestamp", "ts", "t", "recorded_at"),
+    "accuracy": ("accuracy", "acc", "hdop_m", "horizontal_accuracy"),
+}
+
+
+def _finite(v) -> "float | None":
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+def _from_mapping(obj: "dict[str, Any]") -> "dict | None":
+    """Canonical + alias-keyed flat dicts, and one nested-envelope level
+    ({"location": {"lat": .., "lon": ..}, ...})."""
+    loc = obj.get("location")
+    if isinstance(loc, dict):
+        obj = {**obj, **loc}
+    rec: dict = {}
+    for field, names in _ALIASES.items():
+        # first alias with a USABLE value wins — a present-but-invalid
+        # alias (e.g. "lat": null beside "latitude": 37.75) must not
+        # shadow a later valid one
+        for n in names:
+            if n not in obj:
+                continue
+            if field == "uuid":
+                u = str(obj[n]).strip()
+                if u:
+                    rec["uuid"] = u
+                    break
+            else:
+                v = _finite(obj[n])
+                if v is not None:
+                    rec[field] = v
+                    break
+    if "uuid" not in rec or "lat" not in rec or "lon" not in rec:
+        return None
+    if "accuracy" in rec and rec["accuracy"] < 0:
+        del rec["accuracy"]
+    return rec
+
+
+def _from_csv(line: str) -> "dict | None":
+    """``uuid,lat,lon,time[,accuracy]`` — the flat vendor CSV shape."""
+    parts = [p.strip() for p in line.split(",")]
+    if len(parts) < 3 or not parts[0]:
+        return None
+    lat, lon = _finite(parts[1]), _finite(parts[2])
+    if lat is None or lon is None:
+        return None
+    rec = {"uuid": parts[0], "lat": lat, "lon": lon}
+    if len(parts) > 3:
+        t = _finite(parts[3])
+        if t is None:
+            return None
+        rec["time"] = t
+    if len(parts) > 4:
+        acc = _finite(parts[4])
+        if acc is not None and acc >= 0:
+            rec["accuracy"] = acc
+    return rec
+
+
+def _default_formats() -> "dict[str, FormatFn]":
+    def auto(payload):
+        if isinstance(payload, dict):
+            return _from_mapping(payload)
+        if isinstance(payload, (bytes, bytearray)):
+            try:
+                payload = payload.decode("utf-8")
+            except UnicodeDecodeError:
+                return None
+        if isinstance(payload, str):
+            s = payload.strip()
+            if s.startswith("{"):
+                try:
+                    obj = json.loads(s)
+                except json.JSONDecodeError:
+                    return None
+                return _from_mapping(obj) if isinstance(obj, dict) else None
+            return _from_csv(s)
+        return None
+
+    def json_only(payload):
+        """Pinned JSON contract: a dict, or a string/bytes holding a JSON
+        object — anything else (CSV lines included) is malformed, not
+        silently re-interpreted."""
+        if isinstance(payload, dict):
+            return _from_mapping(payload)
+        if isinstance(payload, (bytes, bytearray)):
+            try:
+                payload = payload.decode("utf-8")
+            except UnicodeDecodeError:
+                return None
+        if isinstance(payload, str):
+            try:
+                obj = json.loads(payload)
+            except json.JSONDecodeError:
+                return None
+            return _from_mapping(obj) if isinstance(obj, dict) else None
+        return None
+
+    return {"auto": auto, "json": json_only, "csv": _from_csv}
+
+
+class ProbeFormatter:
+    """Normalizes raw vendor payloads into canonical probe records."""
+
+    def __init__(self, fmt: str = "auto"):
+        self._formats = _default_formats()
+        self.fmt = fmt
+        if fmt not in self._formats:
+            raise ValueError(f"unknown format {fmt!r}; "
+                             f"have {sorted(self._formats)}")
+        self.normalized = 0
+        self.dropped = 0
+
+    def register(self, name: str, fn: FormatFn) -> None:
+        """Plug in a vendor-specific format (fn: payload → record|None)."""
+        self._formats[name] = fn
+
+    def normalize(self, payload: Any, fmt: "str | None" = None,
+                  ) -> "dict | None":
+        rec = self._formats[fmt or self.fmt](payload)
+        if rec is None:
+            self.dropped += 1
+        else:
+            self.normalized += 1
+        return rec
+
+    def format_stream(self, payloads, queue, fmt: "str | None" = None,
+                      ) -> int:
+        """Normalize raw payloads into ``queue`` (any object with the
+        IngestQueue producer surface — records route by uuid AFTER
+        normalization). Returns the number of records appended."""
+        n = 0
+        for p in payloads:
+            rec = self.normalize(p, fmt)
+            if rec is not None:
+                queue.append(rec)
+                n += 1
+        return n
+
+    def stats(self) -> dict:
+        return {"normalized": self.normalized, "dropped": self.dropped}

@@ -1,0 +1,130 @@
+"""ProbeFormatter — the raw→formatted normalization stage (SURVEY §2.1)."""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.streaming.formatter import ProbeFormatter
+from reporter_tpu.streaming.queue import IngestQueue, partition_of
+
+
+class TestNormalize:
+    def test_canonical_passthrough(self):
+        f = ProbeFormatter()
+        rec = f.normalize({"uuid": "v1", "lat": 37.75, "lon": -122.4,
+                           "time": 5.0, "accuracy": 8.0})
+        assert rec == {"uuid": "v1", "lat": 37.75, "lon": -122.4,
+                       "time": 5.0, "accuracy": 8.0}
+
+    @pytest.mark.parametrize("payload,want_uuid", [
+        ({"vehicle_id": 77, "latitude": 1.0, "longitude": 2.0,
+          "timestamp": 3.0}, "77"),
+        ({"device_id": "d-9", "y": 1.0, "x": 2.0, "ts": 3.0}, "d-9"),
+        ({"id": "n", "location": {"lat": 1.0, "lng": 2.0},
+          "recorded_at": 3.0}, "n"),
+    ])
+    def test_vendor_aliases_and_nesting(self, payload, want_uuid):
+        rec = ProbeFormatter().normalize(payload)
+        assert rec is not None
+        assert (rec["uuid"], rec["lat"], rec["lon"], rec["time"]) == (
+            want_uuid, 1.0, 2.0, 3.0)
+
+    def test_csv_line(self):
+        f = ProbeFormatter()
+        assert f.normalize("v2, 37.75, -122.40, 12.5, 6.0") == {
+            "uuid": "v2", "lat": 37.75, "lon": -122.4, "time": 12.5,
+            "accuracy": 6.0}
+        assert f.normalize(b"v3,1.0,2.0") == {
+            "uuid": "v3", "lat": 1.0, "lon": 2.0}
+
+    def test_json_string_payload(self):
+        rec = ProbeFormatter().normalize(
+            '{"uuid": "s", "lat": 1.5, "lon": 2.5, "time": 0}')
+        assert rec == {"uuid": "s", "lat": 1.5, "lon": 2.5, "time": 0.0}
+
+    @pytest.mark.parametrize("bad", [
+        None, 42, "", "not,a", '{"lat": 1.0}', {"uuid": "v"},
+        {"uuid": "v", "lat": float("nan"), "lon": 1.0},
+        {"uuid": "", "lat": 1.0, "lon": 1.0},
+        b"\xff\xfe", "{broken json", "v,abc,def",
+    ])
+    def test_malformed_dropped_not_raised(self, bad):
+        f = ProbeFormatter()
+        assert f.normalize(bad) is None
+        assert f.stats()["dropped"] == 1
+
+    def test_negative_accuracy_stripped(self):
+        rec = ProbeFormatter().normalize(
+            {"uuid": "v", "lat": 1.0, "lon": 2.0, "accuracy": -4.0})
+        assert rec is not None and "accuracy" not in rec
+
+    def test_custom_format_registration(self):
+        f = ProbeFormatter()
+        f.register("pipes", lambda s: (
+            {"uuid": s.split("|")[0], "lat": float(s.split("|")[1]),
+             "lon": float(s.split("|")[2])}
+            if isinstance(s, str) and s.count("|") == 2 else None))
+        assert f.normalize("a|1.0|2.0", fmt="pipes") == {
+            "uuid": "a", "lat": 1.0, "lon": 2.0}
+
+
+class TestFormatStream:
+    def test_partitioning_happens_after_normalization(self):
+        """One vehicle arriving in THREE vendor formats must land in ONE
+        partition — the invariant the per-uuid buffers rely on."""
+        q = IngestQueue(num_partitions=4)
+        f = ProbeFormatter()
+        raw = [
+            {"uuid": "veh-x", "lat": 1.0, "lon": 2.0, "time": 0.0},
+            "veh-x, 1.001, 2.001, 1.0",
+            '{"vehicle_id": "veh-x", "latitude": 1.002, '
+            '"longitude": 2.002, "ts": 2.0}',
+            "garbage,,",
+        ]
+        n = f.format_stream(raw, q)
+        assert n == 3 and f.stats() == {"normalized": 3, "dropped": 1}
+        p = partition_of("veh-x", 4)
+        got = q.poll(p, 0, 10)
+        assert [r["time"] for _, r in got] == [0.0, 1.0, 2.0]
+
+    def test_feeds_stream_pipeline(self, tiny_tiles):
+        """Formatter → broker → StreamPipeline end to end: mixed vendor
+        formats produce matched reports like canonical input does."""
+        from reporter_tpu.config import Config
+        from reporter_tpu.geometry import xy_to_lonlat  # noqa: F401
+        from reporter_tpu.netgen.traces import synthesize_fleet
+        from reporter_tpu.streaming.pipeline import StreamPipeline
+
+        pipe = StreamPipeline(tiny_tiles, Config())
+        f = ProbeFormatter()
+        fleet = synthesize_fleet(tiny_tiles, 3, num_points=40, seed=6)
+        raw = []
+        for i, p in enumerate(fleet):
+            for (lo, la), t in zip(p.lonlat, p.times):
+                if i == 0:
+                    raw.append({"uuid": p.uuid, "lat": la, "lon": lo,
+                                "time": t})
+                elif i == 1:
+                    raw.append(f"{p.uuid},{la},{lo},{t}")
+                else:
+                    raw.append({"vehicle_id": p.uuid, "latitude": la,
+                                "longitude": lo, "timestamp": t})
+        assert f.format_stream(raw, pipe.queue) == len(raw)
+        pipe.step(force_flush=True)
+        assert pipe.stats()["lag"] == 0
+        assert pipe.stats()["malformed"] == 0
+
+
+class TestReviewRegressions:
+    def test_invalid_alias_does_not_shadow_valid_one(self):
+        rec = ProbeFormatter().normalize(
+            {"id": "v1", "lat": None, "latitude": 37.75, "lon": -122.4})
+        assert rec is not None and rec["lat"] == 37.75
+        rec = ProbeFormatter().normalize(
+            {"uuid": "", "id": "v1", "lat": 1.0, "lon": 2.0})
+        assert rec is not None and rec["uuid"] == "v1"
+
+    def test_json_pin_rejects_csv(self):
+        f = ProbeFormatter("json")
+        assert f.normalize("veh-1,37.75,-122.40,5.0") is None
+        assert f.normalize('{"uuid": "v", "lat": 1.0, "lon": 2.0}') == {
+            "uuid": "v", "lat": 1.0, "lon": 2.0}
