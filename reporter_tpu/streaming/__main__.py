@@ -1,0 +1,121 @@
+"""Streaming-worker CLI — the reference's Kafka worker entrypoint analog.
+
+    python -m reporter_tpu.streaming --tiles metro.npz --broker-dir ./broker
+        [--checkpoint worker.ckpt] [--partitions 0 1] [--config conf.json]
+        [--poll-interval 0.05] [--max-steps N] [--format auto]
+
+Runs one matcher worker: restore the checkpoint if present, consume the
+durable broker log from the committed offsets (replaying any unflushed
+tail), flush ripe traces through the device matcher, publish reports +
+histogram deltas to the configured datastore, and checkpoint on SIGTERM/
+SIGINT (and every --checkpoint-interval seconds). Several workers scale
+out over one broker directory by giving each a disjoint --partitions
+subset and its own checkpoint — the consumer-group model (SURVEY.md
+§3.3, DISTRIBUTED.md "Ingest stays host-local").
+
+--stdin-format additionally accepts raw vendor payloads on stdin (one
+per line), normalized through ProbeFormatter into the broker before
+consuming — handy for piping a vendor feed straight into a worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import sys
+import time
+
+log = logging.getLogger("reporter_tpu.streaming.worker")
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m reporter_tpu.streaming",
+        description="reporter_tpu streaming matcher worker")
+    ap.add_argument("--tiles", required=True, help="compiled tileset .npz")
+    ap.add_argument("--broker-dir", required=True,
+                    help="durable ingest log directory (shared by workers)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="checkpoint path (restored on start if present)")
+    ap.add_argument("--checkpoint-interval", type=float, default=30.0)
+    ap.add_argument("--partitions", type=int, nargs="*", default=None,
+                    help="partition subset this worker owns (default: all)")
+    ap.add_argument("--config", default=None, help="JSON config path")
+    ap.add_argument("--poll-interval", type=float, default=0.05)
+    ap.add_argument("--max-steps", type=int, default=None,
+                    help="stop after N steps (tests/drains); default: run "
+                         "until signalled")
+    ap.add_argument("--stdin-format", default=None,
+                    help="also read raw payloads from stdin, normalized "
+                         "via ProbeFormatter ('auto'|'json'|'csv')")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+
+    from reporter_tpu.config import Config
+    from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+    from reporter_tpu.streaming.pipeline import StreamPipeline
+    from reporter_tpu.tiles.tileset import TileSet
+
+    config = Config.load(args.config)   # JSON file + env overrides
+    ts = TileSet.load(args.tiles)
+    queue = DurableIngestQueue(args.broker_dir,
+                               config.streaming.num_partitions)
+    pipe = StreamPipeline(ts, config, queue=queue,
+                          partitions=args.partitions)
+    if args.checkpoint and os.path.exists(
+            args.checkpoint if args.checkpoint.endswith(".npz")
+            else args.checkpoint + ".npz"):
+        pipe.restore(args.checkpoint)
+        log.info("restored checkpoint %s (committed=%s)",
+                 args.checkpoint, pipe.committed)
+
+    if args.stdin_format:
+        from reporter_tpu.streaming.formatter import ProbeFormatter
+
+        fmt = ProbeFormatter(args.stdin_format)
+        n = fmt.format_stream((line for line in sys.stdin), queue)
+        log.info("stdin feed: %d records normalized, %d dropped",
+                 n, fmt.stats()["dropped"])
+
+    stop = {"now": False}
+
+    def _handle(signum, frame):
+        stop["now"] = True
+
+    signal.signal(signal.SIGINT, _handle)
+    signal.signal(signal.SIGTERM, _handle)
+
+    reports = steps = 0
+    last_ckpt = time.monotonic()
+    try:
+        while not stop["now"]:
+            reports += pipe.step()
+            steps += 1
+            if args.checkpoint and (time.monotonic() - last_ckpt
+                                    >= args.checkpoint_interval):
+                pipe.checkpoint(args.checkpoint)
+                last_ckpt = time.monotonic()
+            if args.max_steps is not None and steps >= args.max_steps:
+                break
+            if pipe.stats()["lag"] == 0:
+                time.sleep(args.poll_interval)
+    finally:
+        reports += pipe.drain()
+        pipe.flush_histograms()
+        if args.checkpoint:
+            pipe.checkpoint(args.checkpoint)
+        queue.close()
+    print(json.dumps({"steps": steps, "reports": reports,
+                      **{k: v for k, v in pipe.stats().items()
+                         if k in ("lag", "published", "malformed",
+                                  "hist_rows", "buffered_points")}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

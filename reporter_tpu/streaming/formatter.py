@@ -61,6 +61,11 @@ def _from_mapping(obj: "dict[str, Any]") -> "dict | None":
             if n not in obj:
                 continue
             if field == "uuid":
+                # None must fall through to the next alias, not become the
+                # literal "None" (which would merge every null-uuid vehicle
+                # into one phantom stream)
+                if obj[n] is None:
+                    continue
                 u = str(obj[n]).strip()
                 if u:
                     rec["uuid"] = u
@@ -88,9 +93,9 @@ def _from_csv(line: str) -> "dict | None":
     rec = {"uuid": parts[0], "lat": lat, "lon": lon}
     if len(parts) > 3:
         t = _finite(parts[3])
-        if t is None:
-            return None
-        rec["time"] = t
+        if t is not None:       # unparseable time degrades to a timeless
+            rec["time"] = t     # record (like the mapping path), the
+                                # pipeline assigns index seconds
     if len(parts) > 4:
         acc = _finite(parts[4])
         if acc is not None and acc >= 0:
@@ -158,7 +163,16 @@ class ProbeFormatter:
 
     def normalize(self, payload: Any, fmt: "str | None" = None,
                   ) -> "dict | None":
-        rec = self._formats[fmt or self.fmt](payload)
+        name = fmt or self.fmt
+        if name not in self._formats:   # per-call override gets the same
+            raise ValueError(           # validation the constructor does
+                f"unknown format {name!r}; have {sorted(self._formats)}")
+        try:
+            rec = self._formats[name](payload)
+        except Exception:
+            # a poison payload (or a buggy registered format fn) must
+            # never wedge the stream — drop and count, as documented
+            rec = None
         if rec is None:
             self.dropped += 1
         else:

@@ -128,3 +128,26 @@ class TestReviewRegressions:
         assert f.normalize("veh-1,37.75,-122.40,5.0") is None
         assert f.normalize('{"uuid": "v", "lat": 1.0, "lon": 2.0}') == {
             "uuid": "v", "lat": 1.0, "lon": 2.0}
+
+    def test_null_uuid_falls_through_and_never_becomes_None(self):
+        rec = ProbeFormatter().normalize(
+            {"uuid": None, "id": "v1", "lat": 1.0, "lon": 2.0})
+        assert rec is not None and rec["uuid"] == "v1"
+        assert ProbeFormatter().normalize(
+            {"uuid": None, "lat": 1.0, "lon": 2.0}) is None
+
+    def test_raising_registered_format_is_dropped_not_raised(self):
+        f = ProbeFormatter()
+        f.register("pipes", lambda s: {
+            "uuid": s.split("|")[0], "lat": float(s.split("|")[1]),
+            "lon": float(s.split("|")[2])})
+        assert f.normalize("a|notanum|2.0", fmt="pipes") is None
+        assert f.stats()["dropped"] == 1
+
+    def test_unknown_fmt_override_is_valueerror(self):
+        with pytest.raises(ValueError, match="unknown format"):
+            ProbeFormatter().normalize({"uuid": "v"}, fmt="jsonl")
+
+    def test_csv_trailing_comma_degrades_to_timeless(self):
+        rec = ProbeFormatter().normalize("veh-1,37.75,-122.40,")
+        assert rec == {"uuid": "veh-1", "lat": 37.75, "lon": -122.4}

@@ -1,0 +1,88 @@
+"""Streaming-worker CLI (python -m reporter_tpu.streaming)."""
+
+import io
+import json
+
+import pytest
+
+from reporter_tpu.config import CompilerParams, Config
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.streaming.__main__ import main
+from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+from reporter_tpu.tiles.compiler import compile_network
+
+
+@pytest.fixture(scope="module")
+def worker_env(tmp_path_factory):
+    d = tmp_path_factory.mktemp("worker")
+    ts = compile_network(generate_city("tiny"),
+                         CompilerParams(osmlr_max_length=250.0))
+    tiles = str(d / "tiles.npz")
+    ts.save(tiles)
+    fleet = synthesize_fleet(ts, 4, num_points=60, seed=9)
+    return {"dir": d, "tiles": tiles, "fleet": fleet}
+
+
+def test_worker_consumes_broker_and_checkpoints(worker_env, capsys):
+    d = worker_env["dir"]
+    broker = str(d / "broker")
+    ckpt = str(d / "worker.ckpt")
+    q = DurableIngestQueue(broker, Config().streaming.num_partitions)
+    for p in worker_env["fleet"]:
+        for (lo, la), t in zip(p.lonlat, p.times):
+            q.append({"uuid": p.uuid, "lat": float(la), "lon": float(lo),
+                      "time": float(t)})
+    q.close()
+
+    assert main(["--tiles", worker_env["tiles"], "--broker-dir", broker,
+                 "--checkpoint", ckpt, "--max-steps", "3"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["lag"] == 0 and out["reports"] > 0
+
+    # restart: restores the checkpoint, nothing new to replay
+    assert main(["--tiles", worker_env["tiles"], "--broker-dir", broker,
+                 "--checkpoint", ckpt, "--max-steps", "1"]) == 0
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out2["lag"] == 0 and out2["reports"] == 0
+
+
+def test_worker_stdin_feed(worker_env, capsys, monkeypatch):
+    d = worker_env["dir"]
+    lines = "".join(
+        f"{p.uuid},{la},{lo},{t}\n"
+        for p in worker_env["fleet"]
+        for (lo, la), t in zip(p.lonlat, p.times))
+    monkeypatch.setattr("sys.stdin", io.StringIO(lines))
+    assert main(["--tiles", worker_env["tiles"],
+                 "--broker-dir", str(d / "broker2"),
+                 "--max-steps", "2", "--stdin-format", "csv"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["lag"] == 0 and out["reports"] > 0 and out["malformed"] == 0
+
+
+def test_worker_partition_subset(worker_env, capsys):
+    """Two workers over disjoint partition subsets drain the whole log —
+    the consumer-group shape from one CLI."""
+    d = worker_env["dir"]
+    broker = str(d / "broker3")
+    P = Config().streaming.num_partitions
+    q = DurableIngestQueue(broker, P)
+    for p in worker_env["fleet"]:
+        for (lo, la), t in zip(p.lonlat, p.times):
+            q.append({"uuid": p.uuid, "lat": float(la), "lon": float(lo),
+                      "time": float(t)})
+    ends = [q.end_offset(pp) for pp in range(P)]
+    q.close()
+
+    total = 0
+    for subset in ([0, 1], list(range(2, P))):
+        args = (["--tiles", worker_env["tiles"], "--broker-dir", broker,
+                 "--max-steps", "2", "--partitions"]
+                + [str(s) for s in subset])
+        assert main(args) == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["lag"] == 0          # lag is over the worker's subset
+        total += out["reports"]
+    assert total > 0
+    assert sum(ends) == sum(len(p.times) for p in worker_env["fleet"])
