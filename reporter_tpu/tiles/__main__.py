@@ -68,6 +68,12 @@ def main(argv: list[str] | None = None) -> int:
     i = sub.add_parser("info", help="print a compiled tileset's stats")
     i.add_argument("path")
 
+    g = sub.add_parser("osmlr",
+                       help="export OSMLR segment definitions as GeoJSON")
+    g.add_argument("path", help="compiled tileset .npz")
+    g.add_argument("-o", "--output", required=True,
+                   help="output .geojson path")
+
     c = sub.add_parser("convert", help="convert an OSM XML extract to PBF")
     c.add_argument("xml", help="input .osm/.xml file")
     c.add_argument("pbf", help="output .osm.pbf path")
@@ -75,6 +81,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="write uncompressed blobs (debugging)")
 
     args = ap.parse_args(argv)
+
+    if args.cmd == "osmlr":
+        from reporter_tpu.tiles.osmlr_export import export_osmlr_geojson
+        from reporter_tpu.tiles.tileset import TileSet
+
+        n = export_osmlr_geojson(TileSet.load(args.path), args.output)
+        print(json.dumps({"written": args.output, "segments": n}))
+        return 0
 
     if args.cmd == "convert":
         from reporter_tpu.netgen.osm_xml import xml_elements

@@ -289,3 +289,32 @@ def test_access_tags_filter_motor_traffic():
     net = parse_osm_xml(xml, name="access")
     got = sorted(w.way_id for w in net.ways)
     assert got == [202, 203], got
+
+
+def test_osmlr_geojson_export(tiny_tiles, tmp_path):
+    """Exported segment definitions must reconstruct each OSMLR segment:
+    valid GeoJSON, one LineString per segment, polyline length matching
+    osmlr_len, ids matching the association arrays."""
+    import json
+
+    from reporter_tpu.geometry import lonlat_to_xy
+    from reporter_tpu.tiles.osmlr_export import export_osmlr_geojson
+
+    ts = tiny_tiles
+    out = str(tmp_path / "segments.geojson")
+    n = export_osmlr_geojson(ts, out)
+    fc = json.load(open(out))
+    assert fc["type"] == "FeatureCollection"
+    assert n == len(fc["features"]) == len(ts.osmlr_id)
+    by_id = {int(i): k for k, i in enumerate(ts.osmlr_id)}
+    origin = np.asarray(ts.meta.origin_lonlat)
+    for f in fc["features"]:
+        row = by_id[f["id"]]
+        coords = np.asarray(f["geometry"]["coordinates"], np.float64)
+        assert len(coords) >= 2
+        xy = lonlat_to_xy(coords, origin)
+        poly_len = float(np.hypot(*np.diff(xy, axis=0).T).sum())
+        # 7-decimal coordinate rounding + f32 lengths: ~meter tolerance
+        assert poly_len == pytest.approx(
+            float(ts.osmlr_len[row]), abs=2.0), f["id"]
+        assert f["properties"]["way_ids"]
