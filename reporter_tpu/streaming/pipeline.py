@@ -240,6 +240,7 @@ class StreamPipeline:
                                    for b in self._buffers.values()),
             "published": self.app.publisher.published,
             "hist_rows": int(len(self.hist.nonzero_rows())),
+            "qhist_rows": int(len(self.qhist.nonzero_rows())),
             **self.app.stats,
         }
 
