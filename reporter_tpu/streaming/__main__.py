@@ -113,7 +113,8 @@ def main(argv: "list[str] | None" = None) -> int:
     print(json.dumps({"steps": steps, "reports": reports,
                       **{k: v for k, v in pipe.stats().items()
                          if k in ("lag", "published", "malformed",
-                                  "hist_rows", "buffered_points")}}))
+                                  "hist_rows", "qhist_rows",
+                                  "buffered_points")}}))
     return 0
 
 

@@ -30,25 +30,20 @@ FINITE = BIG / 2       # "allowed" threshold for f32 cost entries
 
 
 @pytest.fixture(scope="module")
-def lattice():
-    """One no-breakage trace's candidate lattice + the production TopK."""
-    import jax.numpy as jnp
-
-    from reporter_tpu.ops.hmm import (interpolation_keep_mask,
-                                      transition_costs, emission_costs,
-                                      viterbi_topk_paths)
-    from reporter_tpu.ops.match import batch_candidates
-
+def oracle_matcher():
+    """One (tileset, matcher) pair shared by every lattice build here."""
     ts = compile_network(generate_city("tiny"),
                          CompilerParams(reach_radius=500.0,
                                         osmlr_max_length=250.0))
-    m = SegmentMatcher(ts, Config(matcher_backend="jax"))
-    p = m.params
-    # 14 points at ~12 m/s: every step exceeds interpolation_distance and
-    # stays far under breakage_distance — one unbroken chain.
-    probe = synthesize_probe(ts, seed=5, num_points=14, speed_mps=12.0,
-                             gps_sigma=2.0)
-    xy = probe.xy.astype(np.float32)
+    return ts, SegmentMatcher(ts, Config(matcher_backend="jax"))
+
+
+def _trace_lattice(m: SegmentMatcher, xy: np.ndarray):
+    """Bucket-pad a trace and build its candidate lattice the way
+    match_topk does: (trace_cands, pts [1, Tp, 2], pj, vj)."""
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.match import batch_candidates
 
     T = len(xy)
     pts = np.zeros((1, _bucket_len(T), 2), np.float32)
@@ -56,8 +51,27 @@ def lattice():
     valid = np.zeros((1, pts.shape[1]), bool)
     valid[0, :T] = True
     pj, vj = jnp.asarray(pts), jnp.asarray(valid)
-    cands = batch_candidates(pj, vj, m._tables, ts.meta, p)
-    trace_cands = CandidateSet(*(x[0] for x in cands))
+    cands = batch_candidates(pj, vj, m._tables, m.ts.meta, m.params)
+    return CandidateSet(*(x[0] for x in cands)), pts, pj, vj
+
+
+@pytest.fixture(scope="module")
+def lattice(oracle_matcher):
+    """One no-breakage trace's candidate lattice + the production TopK."""
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.hmm import (interpolation_keep_mask,
+                                      transition_costs, emission_costs,
+                                      viterbi_topk_paths)
+
+    ts, m = oracle_matcher
+    p = m.params
+    # 14 points at ~12 m/s: every step exceeds interpolation_distance and
+    # stays far under breakage_distance — one unbroken chain.
+    probe = synthesize_probe(ts, seed=5, num_points=14, speed_mps=12.0,
+                             gps_sigma=2.0)
+    trace_cands, pts, pj, vj = _trace_lattice(
+        m, probe.xy.astype(np.float32))
 
     choices, scores, ok = viterbi_topk_paths(
         trace_cands, pj[0], vj[0], m._tables, p.sigma_z, p.beta,
@@ -180,30 +194,16 @@ class TestExactKBest:
     full paths, rank for rank — not just dominate it."""
 
     @pytest.fixture(scope="class")
-    def kbest(self, lattice):
-        import jax.numpy as jnp
-
-        from reporter_tpu.config import CompilerParams, Config
+    def kbest(self, lattice, oracle_matcher):
         from reporter_tpu.ops.hmm import viterbi_kbest_paths
-        from reporter_tpu.ops.match import batch_candidates
 
         # Recreate the same lattice inputs the module fixture used.
-        ts = compile_network(generate_city("tiny"),
-                             CompilerParams(reach_radius=500.0,
-                                            osmlr_max_length=250.0))
-        m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+        ts, m = oracle_matcher
         p = m.params
         probe = synthesize_probe(ts, seed=5, num_points=14, speed_mps=12.0,
                                  gps_sigma=2.0)
-        xy = probe.xy.astype(np.float32)
-        T = len(xy)
-        pts = np.zeros((1, _bucket_len(T), 2), np.float32)
-        pts[0, :T] = xy
-        valid = np.zeros((1, pts.shape[1]), bool)
-        valid[0, :T] = True
-        pj, vj = jnp.asarray(pts), jnp.asarray(valid)
-        cands = batch_candidates(pj, vj, m._tables, ts.meta, p)
-        trace_cands = CandidateSet(*(x[0] for x in cands))
+        trace_cands, pts, pj, vj = _trace_lattice(
+            m, probe.xy.astype(np.float32))
         choices, scores, ok = viterbi_kbest_paths(
             trace_cands, pj[0], vj[0], m._tables, p.sigma_z, p.beta,
             p.max_route_distance_factor, p.breakage_distance,
@@ -232,13 +232,8 @@ class TestExactKBest:
         for r in range(min(len(tc), len(ex))):
             assert ex[r] <= tc[r] + 1e-3, f"rank {r}"
 
-    def test_match_topk_exact_surface(self, lattice):
-        from reporter_tpu.config import CompilerParams, Config
-
-        ts = compile_network(generate_city("tiny"),
-                             CompilerParams(reach_radius=500.0,
-                                            osmlr_max_length=250.0))
-        m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    def test_match_topk_exact_surface(self, lattice, oracle_matcher):
+        ts, m = oracle_matcher
         probe = synthesize_probe(ts, seed=5, num_points=14, speed_mps=12.0,
                                  gps_sigma=2.0)
         tr = Trace(uuid="e", xy=probe.xy.astype(np.float32),
@@ -254,21 +249,15 @@ class TestExactKBest:
                [mp.edge for mp in approx[0][1]]
 
 
-def test_kbest_rank0_equals_primary_decode_with_breakage():
+def test_kbest_rank0_equals_primary_decode_with_breakage(oracle_matcher):
     """Pin viterbi_kbest_paths' scan scaffolding (restart/broken/inactive
     semantics) to the primary decode on traces WITH chain breaks — the
     oracle lattice fixture is break-free, so this is the coverage that
     keeps the [K, R] copy from drifting on the parts the oracle can't
     see. Rank 0 must reproduce match()'s per-point choices exactly."""
-    import jax.numpy as jnp
-
     from reporter_tpu.ops.hmm import viterbi_decode, viterbi_kbest_paths
-    from reporter_tpu.ops.match import batch_candidates
 
-    ts = compile_network(generate_city("tiny"),
-                         CompilerParams(reach_radius=500.0,
-                                        osmlr_max_length=250.0))
-    m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    ts, m = oracle_matcher
     p = m.params
     # stitch two distant on-map drives: the seam exceeds
     # breakage_distance but both halves still have candidates
@@ -281,13 +270,7 @@ def test_kbest_rank0_equals_primary_decode_with_breakage():
     assert np.linalg.norm(pa.xy[-1] - pb.xy[0]) > breakage, \
         "pick seeds whose drives are farther apart"
     T = len(xy)
-    pts = np.zeros((1, _bucket_len(T), 2), np.float32)
-    pts[0, :T] = xy
-    valid = np.zeros((1, pts.shape[1]), bool)
-    valid[0, :T] = True
-    pj, vj = jnp.asarray(pts), jnp.asarray(valid)
-    cands = batch_candidates(pj, vj, m._tables, ts.meta, p)
-    tc = CandidateSet(*(x[0] for x in cands))
+    tc, pts, pj, vj = _trace_lattice(m, xy)
 
     args = (tc, pj[0], vj[0], m._tables, p.sigma_z, p.beta,
             p.max_route_distance_factor, breakage,
@@ -299,3 +282,59 @@ def test_kbest_rank0_equals_primary_decode_with_breakage():
         "fixture must actually break"
     np.testing.assert_array_equal(np.asarray(choices[0]),
                                   np.asarray(primary.choice))
+
+
+@pytest.mark.parametrize("seed", [13, 27, 44])
+def test_kbest_matches_oracle_across_random_lattices(seed, oracle_matcher):
+    """Exactness must hold on arbitrary lattices, not one fixture: build a
+    fresh trace's lattice per seed and compare every returned (score,
+    path) to the numpy list-Viterbi oracle."""
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.hmm import (emission_costs,
+                                      interpolation_keep_mask,
+                                      transition_costs,
+                                      viterbi_kbest_paths)
+
+    ts, m = oracle_matcher
+    p = m.params
+    probe = synthesize_probe(ts, seed=seed, num_points=12, speed_mps=13.0,
+                             gps_sigma=3.0)
+    tc, pts, pj, vj = _trace_lattice(m, probe.xy.astype(np.float32))
+
+    keep = np.asarray(interpolation_keep_mask(pj[0], vj[0],
+                                              p.interpolation_distance))
+    em_all = np.asarray(emission_costs(tc, p.sigma_z))
+    act = np.nonzero(keep & (em_all < FINITE).any(axis=1))[0]
+    if len(act) < 4:
+        pytest.skip("degenerate lattice for this seed")
+    trans = []
+    broke = False
+    for a, b in zip(act[:-1], act[1:]):
+        gc = float(np.sqrt(((pts[0, b] - pts[0, a]) ** 2).sum()))
+        if gc > p.breakage_distance:
+            broke = True
+            break
+        blk = np.asarray(transition_costs(
+            CandidateSet(*(x[int(a)] for x in tc)),
+            CandidateSet(*(x[int(b)] for x in tc)), jnp.float32(gc),
+            m._tables, p.beta, p.max_route_distance_factor,
+            p.backward_slack))
+        if not (blk < FINITE).any():
+            broke = True     # route-disconnect restart: the decoder
+            break            # legitimately restarts the chain here too
+        trans.append(blk)
+    if broke:
+        pytest.skip("trace broke — oracle models one chain")
+
+    choices, scores, ok = viterbi_kbest_paths(
+        tc, pj[0], vj[0], m._tables, p.sigma_z, p.beta,
+        p.max_route_distance_factor, p.breakage_distance,
+        p.backward_slack, p.interpolation_distance, num_paths=4)
+    want, _ = _oracle_topr(em_all[act], trans, 4)
+    n = min(int(ok.sum()), len(want))
+    assert n >= 1
+    for r in range(n):
+        np.testing.assert_allclose(scores[r], want[r][0], rtol=1e-4,
+                                   err_msg=f"seed {seed} rank {r}")
+        assert tuple(choices[r][act]) == want[r][1], f"seed {seed} rank {r}"
