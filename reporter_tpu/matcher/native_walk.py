@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+from typing import NamedTuple
 
 import numpy as np
 
@@ -20,6 +21,68 @@ from reporter_tpu.tiles.tileset import TileSet
 
 def _ptr(arr: np.ndarray, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class RecordColumns(NamedTuple):
+    """Flat record columns — one row per SegmentRecord, straight from the
+    C walker. The throughput path keeps records in THIS form end to end
+    (histogram updates, datastore batches are numpy reductions over the
+    columns); per-record Python objects are built lazily and only for
+    consumers that index a single trace. Building ~10^5 SegmentRecord
+    dataclasses per 16k-trace batch costs ~1 s of one-core host time —
+    5× the C walk itself — which was the round-2 e2e/decode gap."""
+
+    trace: np.ndarray         # i32 [N] trace row; nondecreasing as emitted
+    #                           by walk_columns — remapped/merged columns
+    #                           must be re-sorted (api._merge_columns)
+    #                           before per-trace slicing
+    segment_id: np.ndarray    # i64 [N]; -1 ⇒ internal connector
+    start_time: np.ndarray    # f64 [N]; -1.0 ⇒ partial
+    end_time: np.ndarray      # f64 [N]; -1.0 ⇒ partial
+    length: np.ndarray        # f64 [N] meters covered
+    queue_length: np.ndarray  # f64 [N] meters queued from the stop line
+    internal: np.ndarray      # bool [N]
+    way_off: np.ndarray       # i64 [N+1]: way_ids[way_off[r]:way_off[r+1]]
+    way_ids: np.ndarray       # i64 [way_off[-1]]
+
+    @property
+    def n_records(self) -> int:
+        return len(self.trace)
+
+
+def record_bounds(cols: RecordColumns, n_traces: int) -> np.ndarray:
+    """[n_traces+1] row bounds: trace b's records are rows
+    [bounds[b], bounds[b+1]). Requires cols.trace nondecreasing."""
+    return np.searchsorted(cols.trace, np.arange(n_traces + 1))
+
+
+def empty_columns() -> RecordColumns:
+    return RecordColumns(
+        np.empty(0, np.int32), np.empty(0, np.int64), np.empty(0),
+        np.empty(0), np.empty(0), np.empty(0), np.empty(0, bool),
+        np.zeros(1, np.int64), np.empty(0, np.int64))
+
+
+def materialize_records(cols: RecordColumns, lo: int = 0,
+                        hi: "int | None" = None) -> list[SegmentRecord]:
+    """SegmentRecord objects for column rows [lo, hi) (one trace, usually).
+
+    Bulk-converts via .tolist() (runs in C) — per-element numpy scalar
+    conversion costs ~150 ns × 6 fields per record otherwise."""
+    hi = cols.n_records if hi is None else hi
+    seg_l = cols.segment_id[lo:hi].tolist()
+    t0_l = cols.start_time[lo:hi].tolist()
+    t1_l = cols.end_time[lo:hi].tolist()
+    len_l = cols.length[lo:hi].tolist()
+    queue_l = cols.queue_length[lo:hi].tolist()
+    int_l = cols.internal[lo:hi].tolist()
+    off_l = cols.way_off[lo:hi + 1].tolist()
+    ways_l = cols.way_ids[off_l[0]:off_l[-1]].tolist() if hi > lo else []
+    base = off_l[0]
+    return [SegmentRecord(
+        seg_l[r], ways_l[off_l[r] - base:off_l[r + 1] - base],
+        t0_l[r], t1_l[r], len_l[r], bool(int_l[r]), queue_l[r])
+        for r in range(hi - lo)]
 
 
 class NativeWalker:
@@ -46,6 +109,18 @@ class NativeWalker:
              ) -> list[list[SegmentRecord]]:
         """edges i32 [B,T] (-1 unmatched), offs f32 [B,T], starts bool [B,T],
         times f64 [B,T] → per-trace record lists."""
+        B = edges.shape[0]
+        cols = self.walk_columns(edges, offs, starts, times, backward_slack)
+        bounds = record_bounds(cols, B)
+        return [materialize_records(cols, int(bounds[b]), int(bounds[b + 1]))
+                for b in range(B)]
+
+    def walk_columns(self, edges: np.ndarray, offs: np.ndarray,
+                     starts: np.ndarray, times: np.ndarray,
+                     backward_slack: float) -> RecordColumns:
+        """Same walk, but the records stay flat numpy columns (trace rows
+        nondecreasing, drive order within a trace — walker.cc emits shard
+        merges in trace order). The e2e hot path stops here."""
         B, T = edges.shape
         edges = np.ascontiguousarray(edges, np.int32)
         offs = np.ascontiguousarray(offs, np.float32)
@@ -93,26 +168,14 @@ class NativeWalker:
             rec_cap = max(rec_cap * 2, int(n) + 64)
             way_cap = max(way_cap * 2, int(n_ways.value) + 64)
 
-        # Bulk-convert columns to python scalars once (.tolist() runs in C;
-        # per-element numpy scalar conversion costs ~150ns × 6 fields ×
-        # ~10^5 records otherwise) and build records positionally.
         n = int(n)
-        trace_l = rec_trace[:n].tolist()
-        seg_l = rec_seg[:n].tolist()
-        t0_l = rec_t0[:n].tolist()
-        t1_l = rec_t1[:n].tolist()
-        len_l = rec_len[:n].tolist()
-        queue_l = rec_queue[:n].tolist()
-        int_l = rec_internal[:n].tolist()
-        off_l = way_off[:n + 1].tolist()
-        ways_l = way_ids[:off_l[-1]].tolist() if n else []
-
-        out: list[list[SegmentRecord]] = [[] for _ in range(B)]
-        for r in range(n):
-            out[trace_l[r]].append(SegmentRecord(
-                seg_l[r], ways_l[off_l[r]:off_l[r + 1]],
-                t0_l[r], t1_l[r], len_l[r], bool(int_l[r]), queue_l[r]))
-        return out
+        nw = int(way_off[n]) if n else 0
+        # .copy(): trimmed views would pin the oversized retry buffers
+        return RecordColumns(
+            rec_trace[:n].copy(), rec_seg[:n].copy(), rec_t0[:n].copy(),
+            rec_t1[:n].copy(), rec_len[:n].copy(), rec_queue[:n].copy(),
+            rec_internal[:n].astype(bool),
+            way_off[:n + 1].astype(np.int64), way_ids[:nw].copy())
 
 
 def make_native_walker(ts: TileSet) -> NativeWalker | None:
