@@ -1,23 +1,40 @@
 #!/usr/bin/env python
-"""Benchmark harness (driver hook): BASELINE.md config 2.
+"""Benchmark harness (driver hook): BASELINE.md configs 2-4 in one run.
 
-Matches 1k batched 120-point vehicle traces against one metro tile ("sf",
-synthetic — no OSM extracts in this environment) with the jax backend, and a
-sample of the same traces with the in-repo CPU reference matcher (the Meili
-stand-in, BASELINE config 1's anchor).
+Default run measures THREE tiles with the jax backend and one shared
+process:
+  - "sf" (BASELINE config 2, the headline number + latency/concurrency),
+  - "bayarea" (config 3, metro scale in HBM) in detail.metro,
+  - "sf+r" (sf with ~8% junction turn-restriction density) in
+    detail.restricted — banned_turn_pairs > 0 with the oracle audit on.
+The fidelity audit totals ≥500 traces across the three tiles against the
+in-repo exact-Dijkstra CPU oracle (the Meili stand-in, config 1's anchor),
+reported per tile.
 
 Prints ONE JSON line:
   {"metric": "probes_per_sec_e2e", "value": ..., "unit": "probes/s",
-   "vs_baseline": <jax throughput / cpu-reference throughput>, ...detail}
+   "vs_baseline": <sf jax throughput / cpu-reference throughput>, ...detail}
 
 "e2e" = the full SegmentMatcher.match_many path: host batching, device
-decode, segment association, report-ready records — the same work the
-reference's segment_matcher.Match does per trace.
+decode, segment association, report-ready records (columnar MatchBatch) —
+the same work the reference's segment_matcher.Match does per trace.
+
+Manual runs: `python bench.py [n_traces] [city]` bench exactly one tile
+(skips the metro/restricted extras).
+
+Tiles and fleets are cached on disk (.bench_tiles_*.npz /
+.bench_fleet_*.npz) so repeat runs exercise the operational
+load-from-npz restart path instead of recompiling; detail.setup_split
+reports where the setup time went either way.
 """
 
 import json
+import os
 import sys
 import time
+
+_RESTRICT_FRACTION = 0.08   # ~8% of junctions carry a no_turn (VERDICT r2 #5)
+_RESTRICT_SEED = 13
 
 
 def _time_best(fn, repeats: int) -> float:
@@ -29,10 +46,44 @@ def _time_best(fn, repeats: int) -> float:
     return best
 
 
+def _repo_path(name: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+
+
+def _cached_tileset(city: str, restricted: bool = False):
+    """Compile-or-load a bench tileset. Returns (ts, info) where info
+    records the source ("npz-cache" vs "compiled") and seconds — the
+    load path is the same TileSet.load a restarted service worker uses."""
+    from reporter_tpu.config import CompilerParams
+    from reporter_tpu.netgen.synthetic import (add_random_restrictions,
+                                               generate_city)
+    from reporter_tpu.tiles.compiler import compile_network
+    from reporter_tpu.tiles.tileset import TileSet
+
+    key = f"{city}_r{int(_RESTRICT_FRACTION * 100)}" if restricted else city
+    path = _repo_path(f".bench_tiles_{key}_v4.npz")
+    t0 = time.perf_counter()
+    if os.path.exists(path):
+        try:
+            ts = TileSet.load(path)
+            return ts, {"source": "npz-cache",
+                        "seconds": round(time.perf_counter() - t0, 2)}
+        except Exception:
+            pass                    # stale schema: fall through to compile
+    net = generate_city(city)
+    if restricted:
+        net = add_random_restrictions(net, fraction=_RESTRICT_FRACTION,
+                                      seed=_RESTRICT_SEED)
+    ts = compile_network(net, CompilerParams())
+    ts.save(path)
+    return ts, {"source": "compiled",
+                "seconds": round(time.perf_counter() - t0, 2)}
+
+
 def _cached_fleet(ts, n_traces: int, n_points: int):
     """Synthesizing 16k probe traces costs ~40s of single-core host time —
     cache the fleet on disk so repeat bench runs skip it."""
-    import os
+    import zlib
 
     import numpy as np
 
@@ -40,13 +91,15 @@ def _cached_fleet(ts, n_traces: int, n_points: int):
     from reporter_tpu.netgen.traces import synthesize_fleet
 
     # cache key includes a tileset content fingerprint + the synthesis
-    # seed, so geometry/compiler changes invalidate stale fleets
-    # (crc32, not hash(): python string hashing is per-process randomized)
-    import zlib
-
-    fp = f"{zlib.crc32(ts.edge_len.tobytes()) & 0xFFFFFFFF:08x}-s7"
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        f".bench_fleet_{ts.name}_{n_traces}x{n_points}_{fp}.npz")
+    # seed, so geometry/compiler/restriction changes invalidate stale
+    # fleets (crc32, not hash(): python hashing is per-process randomized;
+    # ban arrays are empty on unrestricted tiles, so their keys are stable
+    # across this change)
+    crc = zlib.crc32(ts.edge_len.tobytes())
+    crc = zlib.crc32(ts.ban_from.tobytes(), crc)
+    crc = zlib.crc32(ts.ban_to.tobytes(), crc)
+    fp = f"{crc & 0xFFFFFFFF:08x}-s7"
+    path = _repo_path(f".bench_fleet_{ts.name}_{n_traces}x{n_points}_{fp}.npz")
     if os.path.exists(path):
         with np.load(path) as z:
             xy, times = z["xy"], z["times"]
@@ -77,11 +130,42 @@ def _tpu_reachable(timeout_s: float = 120.0) -> bool:
         return False
 
 
+def _throughput(ts, traces, repeats: int):
+    """(matcher, e2e_pps, decode_pps, batch_seconds) for one tile."""
+    from reporter_tpu.config import Config
+    from reporter_tpu.matcher.api import SegmentMatcher
+
+    m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    m.match_many(traces)                    # compile + stage HBM (full shape)
+    dt = _time_best(lambda: m.match_many(traces), repeats=repeats)
+    dt_dec = _time_best(lambda: m._decode_many(traces), repeats=repeats)
+    probes = sum(len(t.xy) for t in traces)
+    return m, probes / dt, probes / dt_dec, dt
+
+
+def _oracle_audit(ts, jax_matcher, traces, n: int):
+    """Fidelity vs the exact-Dijkstra CPU oracle on n traces. Returns
+    (disagreement, cpu_pps, n)."""
+    from reporter_tpu.config import Config
+    from reporter_tpu.matcher.api import SegmentMatcher
+    from reporter_tpu.matcher.fidelity import mean_disagreement
+
+    cpu = SegmentMatcher(ts, Config(matcher_backend="reference_cpu"))
+    t0 = time.perf_counter()
+    rc = cpu.match_many(traces[:n])
+    dt_cpu = time.perf_counter() - t0
+    rj = jax_matcher.match_many(traces[:n])
+    probes = sum(len(t.xy) for t in traces[:n])
+    return mean_disagreement(rj, rc), probes / dt_cpu, n
+
+
 def main() -> None:
     t_setup = time.perf_counter()
-    import os
+    split: dict = {}
 
+    t0 = time.perf_counter()
     tpu_ok = _tpu_reachable()
+    split["device_probe_s"] = round(time.perf_counter() - t0, 1)
     if not tpu_ok:
         # Emit a real (CPU-backend) measurement rather than hanging; the
         # label makes the degraded environment visible to the reader.
@@ -95,14 +179,12 @@ def main() -> None:
 
     enable_compilation_cache()
 
-    from reporter_tpu.config import CompilerParams, Config
+    from reporter_tpu.config import Config
     from reporter_tpu.matcher.api import SegmentMatcher, Trace
-    from reporter_tpu.netgen.synthetic import generate_city
-    from reporter_tpu.netgen.traces import synthesize_fleet
-    from reporter_tpu.tiles.compiler import compile_network
 
+    manual = len(sys.argv) > 1
     n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 16000
-    city = sys.argv[2] if len(sys.argv) > 2 else "sf"   # "bayarea" = config 3
+    city = sys.argv[2] if len(sys.argv) > 2 else "sf"
     if not tpu_ok:
         n_traces = min(n_traces, 128)   # keep the degraded-mode run short:
                                         # even the grid gather path (auto's
@@ -110,20 +192,19 @@ def main() -> None:
                                         # oracle pass should finish in well
                                         # under a minute on one core
     n_points = 120
-    # Oracle audit size: ≥200 traces (24k probes) — affordable because the
-    # CPU reference shares one bound-aware Dijkstra memo across traces.
-    n_cpu = min(200, n_traces)
+    n_cpu = min(250, n_traces)          # sf leg of the ≥500-trace audit
 
-    ts = compile_network(generate_city(city), CompilerParams())
+    t0 = time.perf_counter()
+    ts, tile_info = _cached_tileset(city)
+    split["tile_s"] = round(time.perf_counter() - t0, 1)
+    t0 = time.perf_counter()
     traces = _cached_fleet(ts, n_traces, n_points)
+    split["fleet_s"] = round(time.perf_counter() - t0, 1)
 
-    jax_matcher = SegmentMatcher(ts, Config(matcher_backend="jax"))
-    jax_matcher.match_many(traces)                  # compile + stage HBM
-                                                    # (full batch shape)
-    dt_jax = _time_best(lambda: jax_matcher.match_many(traces), repeats=5)
-
-    # Device-decode-only throughput (the kernel itself, no host walk).
-    dt_decode = _time_best(lambda: jax_matcher._decode_many(traces), repeats=5)
+    t0 = time.perf_counter()
+    jax_matcher, jax_pps, decode_pps, dt_jax = _throughput(
+        ts, traces, repeats=5)
+    split["primary_measure_s"] = round(time.perf_counter() - t0, 1)
 
     # p50 single-trace match latency (the north star's second metric; on a
     # remote-attached chip this is link-RTT-bound, not compute-bound).
@@ -200,54 +281,103 @@ def main() -> None:
     conc_rps = (len(conc_lat) / conc_wall_total
                 if conc_lat and conc_wall_total > 0 else None)
 
-    # One timed CPU-oracle pass, reused for both the throughput anchor and
-    # the fidelity audit (BASELINE north star: <5% segment-ID disagreement
-    # vs the exact-Dijkstra CPU oracle, the in-repo Meili stand-in):
-    # per trace, 1 - |ids_jax ∩ ids_cpu| / max(|ids_jax|, |ids_cpu|), avg.
-    cpu_matcher = SegmentMatcher(ts, Config(matcher_backend="reference_cpu"))
+    # Fidelity audit leg 1 (BASELINE north star: <5% segment-ID
+    # disagreement, length-weighted — matcher/fidelity.py, the same metric
+    # the CI gates enforce) + the CPU throughput anchor.
     t0 = time.perf_counter()
-    rc = cpu_matcher.match_many(traces[:n_cpu])
-    dt_cpu = time.perf_counter() - t0
+    disagreement, cpu_pps, _ = _oracle_audit(ts, jax_matcher, traces, n_cpu)
+    split["oracle_primary_s"] = round(time.perf_counter() - t0, 1)
+    audit = {ts.name: {"traces": n_cpu,
+                       "disagreement": round(disagreement, 4)}}
 
-    rj = jax_matcher.match_many(traces[:n_cpu])
-    # Length-weighted segment-ID disagreement — the shared fidelity metric
-    # (matcher/fidelity.py), identical to what the CI gates enforce.
-    from reporter_tpu.matcher.fidelity import mean_disagreement
-    disagreement = mean_disagreement(rj, rc)
+    detail = {
+        "config": f"{n_traces}x{n_points}pt traces, tile={ts.name}",
+        "device": (str(jax.devices()[0]).split(":")[0] if tpu_ok
+                   else "CPU-FALLBACK (TPU tunnel unreachable)"),
+        "decode_only_probes_per_sec": round(decode_pps, 1),
+        "e2e_over_decode": round(jax_pps / decode_pps, 3),
+        "p50_single_trace_latency_ms": round(p50_latency * 1e3, 2),
+        "link_rtt_ms": round(link_rtt * 1e3, 2),
+        "latency_note": (
+            "CPU fallback — no device link in play" if not tpu_ok
+            else "single-trace p50 is link-RTT-bound "
+                 "(remote-attached chip)"
+            if p50_latency < 4 * link_rtt + 5e-3
+            else "single-trace p50 is compute-bound"),
+        f"concurrent{n_conc}_combined_p50_ms": (
+            round(conc_p50 * 1e3, 2) if conc_p50 is not None else None),
+        f"concurrent{n_conc}_requests_per_sec": (
+            round(conc_rps, 1) if conc_rps is not None else None),
+        **({"concurrent_errors": conc_errors[:4]} if conc_errors else {}),
+        "cpu_reference_probes_per_sec": round(cpu_pps, 1),
+        "oracle_sample_traces": n_cpu,
+        "segment_id_disagreement_vs_cpu_ref": round(disagreement, 4),
+        "batch_seconds": round(dt_jax, 3),
+        "tile_source": tile_info["source"],
+        "tile_stats": ts.stats,
+    }
 
-    probes = n_traces * n_points
-    jax_pps = probes / dt_jax
-    cpu_pps = (n_cpu * n_points) / dt_cpu
+    # Extra tiles (skipped in manual single-tile runs and in CPU fallback,
+    # where the grid-gather path would take minutes per tile).
+    if not manual and tpu_ok:
+        # -- metro scale (BASELINE config 3: bayarea tables in HBM) -------
+        t0 = time.perf_counter()
+        mts, mtile_info = _cached_tileset("bayarea")
+        mtraces = _cached_fleet(mts, n_traces, n_points)
+        mm, m_pps, m_decode, _ = _throughput(mts, mtraces, repeats=3)
+        m_dis, _, m_n = _oracle_audit(mts, mm, mtraces, 100)
+        audit[mts.name] = {"traces": m_n, "disagreement": round(m_dis, 4)}
+        detail["metro"] = {
+            "config": f"{len(mtraces)}x{n_points}pt traces, tile={mts.name}",
+            "probes_per_sec_e2e": round(m_pps, 1),
+            "decode_only_probes_per_sec": round(m_decode, 1),
+            "hbm_tile_bytes": int(mts.hbm_bytes()),
+            "tile_source": mtile_info["source"],
+            "tile_stats": mts.stats,
+        }
+        split["metro_s"] = round(time.perf_counter() - t0, 1)
+        del mm, mts, mtraces
+
+        # -- restrictions on (VERDICT r2 #5: realistic ban density) -------
+        t0 = time.perf_counter()
+        rts, rtile_info = _cached_tileset("sf", restricted=True)
+        # same fleet size as the primary: throughput_vs_unrestricted must
+        # isolate the restriction cost, not the batch-overlap difference
+        rtraces = _cached_fleet(rts, n_traces, n_points)
+        # repeats must MATCH the primary's: best-of-5 vs best-of-3 would
+        # bias the ratio below 1 on a ~2x-noise link regardless of cost
+        rm, r_pps, r_decode, _ = _throughput(rts, rtraces, repeats=5)
+        r_dis, _, r_n = _oracle_audit(rts, rm, rtraces, 150)
+        audit[rts.name] = {"traces": r_n, "disagreement": round(r_dis, 4)}
+        detail["restricted"] = {
+            "config": (f"{len(rtraces)}x{n_points}pt traces, tile={rts.name}"
+                       f" ({int(_RESTRICT_FRACTION * 100)}% junction"
+                       " restriction density)"),
+            "probes_per_sec_e2e": round(r_pps, 1),
+            "decode_only_probes_per_sec": round(r_decode, 1),
+            "throughput_vs_unrestricted": round(r_pps / jax_pps, 3),
+            "reach_rows_growth": round(
+                rts.reach_to.shape[0] / max(ts.reach_to.shape[0], 1), 3),
+            "tile_source": rtile_info["source"],
+            "tile_stats": rts.stats,
+        }
+        split["restricted_s"] = round(time.perf_counter() - t0, 1)
+        del rm, rts, rtraces
+
+        audit_total = sum(v["traces"] for v in audit.values())
+        detail["audit"] = {"total_traces": audit_total, "per_tile": audit}
+
+    detail["setup_split"] = split
+    detail["setup_seconds"] = round(
+        split["device_probe_s"] + split["tile_s"] + split["fleet_s"], 1)
+    detail["total_seconds"] = round(time.perf_counter() - t_setup, 1)
+
     print(json.dumps({
         "metric": "probes_per_sec_e2e",
         "value": round(jax_pps, 1),
         "unit": "probes/s",
         "vs_baseline": round(jax_pps / cpu_pps, 2),
-        "detail": {
-            "config": f"{n_traces}x{n_points}pt traces, tile={ts.name}",
-            "device": (str(jax.devices()[0]).split(":")[0] if tpu_ok
-                       else "CPU-FALLBACK (TPU tunnel unreachable)"),
-            "decode_only_probes_per_sec": round(probes / dt_decode, 1),
-            "p50_single_trace_latency_ms": round(p50_latency * 1e3, 2),
-            "link_rtt_ms": round(link_rtt * 1e3, 2),
-            "latency_note": (
-                "CPU fallback — no device link in play" if not tpu_ok
-                else "single-trace p50 is link-RTT-bound "
-                     "(remote-attached chip)"
-                if p50_latency < 4 * link_rtt + 5e-3
-                else "single-trace p50 is compute-bound"),
-            f"concurrent{n_conc}_combined_p50_ms": (
-                round(conc_p50 * 1e3, 2) if conc_p50 is not None else None),
-            f"concurrent{n_conc}_requests_per_sec": (
-                round(conc_rps, 1) if conc_rps is not None else None),
-            **({"concurrent_errors": conc_errors[:4]} if conc_errors else {}),
-            "cpu_reference_probes_per_sec": round(cpu_pps, 1),
-            "oracle_sample_traces": n_cpu,
-            "segment_id_disagreement_vs_cpu_ref": round(disagreement, 4),
-            "batch_seconds": round(dt_jax, 3),
-            "setup_seconds": round(time.perf_counter() - t_setup, 1),
-            "tile_stats": ts.stats,
-        },
+        "detail": detail,
     }))
 
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from reporter_tpu.netgen.network import RoadNetwork, Way
+from reporter_tpu.netgen.network import RoadNetwork, TurnRestriction, Way
 
 # name → (seed, nx, ny); sizes tuned so "sf" compiles in seconds and the trio
 # gives a meaningfully sharded multi-city set (BASELINE config 4).
@@ -22,7 +22,8 @@ CITY_PRESETS: dict[str, tuple[int, int, int]] = {
     "nyc": (2, 56, 36),
     "la": (3, 48, 48),
     # metro-scale tile set (BASELINE config 3 "Bay-Area tiles in HBM"):
-    # ~16k intersections, ~110k directed edges, ~17 km on a side
+    # ~16k intersections, ~54k directed edges after interior-node
+    # simplification (the compiled count STATUS/bench quote), ~17 km a side
     "bayarea": (4, 128, 128),
 }
 
@@ -134,3 +135,51 @@ def generate_city(
     add_chain([int(node_index[t, ny - 1 - t]) for t in range(min(nx, ny))], False, "diag_se", 17.9)
 
     return RoadNetwork(node_lonlat=node_lonlat, ways=ways, name=name)
+
+
+def add_random_restrictions(net: RoadNetwork, fraction: float = 0.08,
+                            seed: int = 99) -> RoadNetwork:
+    """Inject ``no_turn`` restrictions at ~``fraction`` of real junctions.
+
+    Gives synthetic cities a realistic turn-restriction density (the
+    reference's graphs carry OSM `restriction` relations; see
+    tiles/compiler._resolve_restrictions for the banned-pair lowering).
+    Candidate junctions are nodes where ≥2 distinct ways cross and ≥2
+    distinct ways leave; the ban always leaves the arriving vehicle another
+    exit (continuing on its own way, or a third way) — a restriction forces
+    a detour, never a dead end. Mutates and returns ``net`` (name gains a
+    ``+r`` suffix so tile caches key the variant separately).
+    """
+    rng = np.random.default_rng(seed)
+    # node → ways that can ARRIVE at it / LEAVE it (oneway-aware)
+    arrive: dict[int, list] = {}
+    leave: dict[int, list] = {}
+    for w in net.ways:
+        for i, nd in enumerate(w.nodes):
+            if i > 0 or not w.oneway:
+                arrive.setdefault(nd, []).append(w)
+            if i < len(w.nodes) - 1 or not w.oneway:
+                leave.setdefault(nd, []).append(w)
+    junctions = [nd for nd in arrive
+                 if len({w.way_id for w in leave.get(nd, [])}) >= 2]
+    junctions.sort()
+    n_pick = int(round(len(junctions) * fraction))
+    for nd in rng.permutation(np.asarray(junctions))[:n_pick]:
+        nd = int(nd)
+        dst_ids = sorted({w.way_id for w in leave[nd]})
+        # the banned exit must leave the arriving vehicle another way out
+        src = [w for w in arrive[nd]
+               if w.way_id in dst_ids and len(dst_ids) >= 2]
+        if not src:
+            continue
+        fw = src[rng.integers(len(src))]
+        to_choices = [d for d in dst_ids if d != fw.way_id]
+        if not to_choices:
+            continue
+        tw = to_choices[rng.integers(len(to_choices))]
+        net.restrictions.append(TurnRestriction(
+            from_way=fw.way_id, via_node=nd, to_way=int(tw),
+            kind="no_turn"))
+    if net.restrictions and not net.name.endswith("+r"):
+        net.name = f"{net.name}+r"
+    return net
