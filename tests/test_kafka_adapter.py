@@ -1,0 +1,162 @@
+"""KafkaProbeConsumer over a fake kafka-python-shaped client.
+
+The adapter must pass the SAME offset-semantics contract suite as the
+in-proc queues (tests/test_broker_contract.py check_probe_consumer) — no
+network, no kafka-python package: the fake implements exactly the client
+surface the adapter documents.
+"""
+
+import json
+from typing import NamedTuple
+
+import pytest
+
+from reporter_tpu.streaming.broker import ProbeConsumer
+from reporter_tpu.streaming.kafka_adapter import (KafkaProbeConsumer,
+                                                  TopicPartition)
+from reporter_tpu.streaming.queue import partition_of
+
+from tests.test_broker_contract import check_probe_consumer
+
+
+class _ConsumerRecord(NamedTuple):
+    offset: int
+    value: bytes
+
+
+class OffsetOutOfRangeError(Exception):
+    """Name-compatible stand-in for kafka.errors.OffsetOutOfRangeError."""
+
+
+class FakeKafkaClient:
+    """In-memory kafka-python KafkaConsumer shape: per-partition append
+    logs, cursor-based poll, pause/resume, retention floors."""
+
+    def __init__(self, topic: str, num_partitions: int,
+                 fetch_batch: int = 7):
+        self.topic = topic
+        self.logs: list[list[bytes]] = [[] for _ in range(num_partitions)]
+        self.floor = [0] * num_partitions      # retention floor per part
+        self._cursor: dict[TopicPartition, int] = {}
+        self._paused: set[TopicPartition] = set()
+        self._assigned: list[TopicPartition] = []
+        self._fetch_batch = fetch_batch        # per-poll fetch cap, so the
+        #                                        adapter's drain loop runs
+
+    # -- producer side (test helper; routes by uuid like a keyed producer)
+    def produce(self, record: dict) -> None:
+        p = partition_of(str(record["uuid"]), len(self.logs))
+        self.logs[p].append(json.dumps(record).encode())
+
+    def expire(self, partition: int, upto: int) -> None:
+        self.floor[partition] = upto
+
+    # -- KafkaConsumer surface the adapter uses
+    def partitions_for_topic(self, topic):
+        return set(range(len(self.logs))) if topic == self.topic else None
+
+    def assign(self, tps):
+        self._assigned = list(tps)
+        for tp in tps:
+            self._cursor.setdefault(tp, 0)
+
+    def seek(self, tp, offset):
+        assert tp in self._assigned
+        self._cursor[tp] = int(offset)
+
+    def pause(self, *tps):
+        self._paused.update(tps)
+
+    def resume(self, *tps):
+        self._paused.difference_update(tps)
+
+    def poll(self, timeout_ms=0, max_records=500):
+        out = {}
+        budget = max_records
+        for tp in self._assigned:
+            if tp in self._paused or budget <= 0:
+                continue
+            cur = self._cursor[tp]
+            if cur < self.floor[tp.partition]:
+                raise OffsetOutOfRangeError(
+                    {tp: cur})            # kafka-python payload shape
+            log = self.logs[tp.partition]
+            take = log[cur:cur + min(budget, self._fetch_batch)]
+            if not take:
+                continue
+            out[tp] = [_ConsumerRecord(cur + i, v)
+                       for i, v in enumerate(take)]
+            self._cursor[tp] = cur + len(take)
+            budget -= len(take)
+        return out
+
+    def end_offsets(self, tps):
+        return {tp: len(self.logs[tp.partition]) for tp in tps}
+
+
+class TestKafkaAdapterContract:
+    def test_contract(self):
+        client = FakeKafkaClient("probes", num_partitions=4)
+        adapter = KafkaProbeConsumer(client, "probes")
+        assert isinstance(adapter, ProbeConsumer)
+        check_probe_consumer(adapter, client.produce)
+
+    def test_contract_single_partition(self):
+        client = FakeKafkaClient("probes", num_partitions=1)
+        check_probe_consumer(KafkaProbeConsumer(client, "probes"),
+                             client.produce)
+
+    def test_small_fetch_batches_are_drained(self):
+        """One pipeline poll may need several client fetches (Kafka's
+        max_poll_records is a fetch cap, not a request size)."""
+        client = FakeKafkaClient("probes", num_partitions=1, fetch_batch=3)
+        adapter = KafkaProbeConsumer(client, "probes")
+        for i in range(20):
+            client.produce({"uuid": "v", "lat": 0.0, "lon": 0.0,
+                            "time": float(i)})
+        got = adapter.poll(0, 0, max_records=17)
+        assert [off for off, _ in got] == list(range(17))
+
+    def test_retention_floor_maps_to_lookup_error(self):
+        client = FakeKafkaClient("probes", num_partitions=2)
+        adapter = KafkaProbeConsumer(client, "probes")
+        for i in range(10):
+            client.produce({"uuid": "v", "lat": 0.0, "lon": 0.0,
+                            "time": float(i)})
+        p = partition_of("v", 2)
+        client.expire(p, client.end_offsets(
+            [TopicPartition("probes", p)])[TopicPartition("probes", p)])
+        with pytest.raises(LookupError):
+            adapter.poll(p, 0, max_records=4)
+
+    def test_missing_topic_rejected(self):
+        client = FakeKafkaClient("probes", num_partitions=2)
+        with pytest.raises(ValueError, match="no partitions"):
+            KafkaProbeConsumer(client, "other-topic")
+
+    def test_predeserialized_values_pass_through(self):
+        """A client configured with value_deserializer=json.loads hands
+        dicts to the adapter; both forms must decode identically."""
+        client = FakeKafkaClient("probes", num_partitions=1)
+        adapter = KafkaProbeConsumer(client, "probes")
+        rec = {"uuid": "v", "lat": 1.0, "lon": 2.0, "time": 3.0}
+        assert adapter._decode(json.dumps(rec).encode()) == rec
+        assert adapter._decode(rec) == rec
+
+    def test_pipeline_runs_over_kafka_adapter(self, tiny_tiles):
+        """End to end: StreamPipeline consuming via the Kafka adapter
+        produces reports and drains lag, exactly as over IngestQueue."""
+        from reporter_tpu.config import Config
+        from reporter_tpu.streaming.pipeline import StreamPipeline
+
+        cfg = Config()
+        client = FakeKafkaClient("probes",
+                                 cfg.streaming.num_partitions)
+        adapter = KafkaProbeConsumer(client, "probes")
+        pipe = StreamPipeline(tiny_tiles, cfg, queue=adapter)
+        for i in range(30):
+            client.produce({"uuid": "veh-k", "lat": 37.75 + i * 1e-5,
+                            "lon": -122.41, "time": float(i)})
+        pipe.step(force_flush=True)
+        assert pipe.stats()["lag"] == 0
+        assert pipe.stats()["buffered_points"] == 0
