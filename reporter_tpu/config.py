@@ -50,6 +50,32 @@ class MatcherParams:
     def replace(self, **kw: Any) -> "MatcherParams":
         return dataclasses.replace(self, **kw)
 
+    @classmethod
+    def preset(cls, mode: str) -> "MatcherParams":
+        """Mode-keyed matcher preset (the reference's per-mode Valhalla
+        costing → meili tuning, SURVEY.md §2.1 "mode costing"). GPS noise
+        is mode-independent (sigma_z stays), but plausible movement is
+        not: slower modes cover less ground between samples, so chain
+        breakage and route-deviation tolerances tighten, and the
+        candidate radius narrows (a pedestrian 50 m from a path is more
+        likely on another path than badly measured).
+
+        Use with a tileset compiled for the same mode
+        (``compile_network(net, params, mode=...)``) — the preset tunes
+        the HMM; the tileset's subgraph decides legality.
+        """
+        if mode == "auto":
+            return cls()
+        if mode == "bicycle":
+            return cls(search_radius=40.0, breakage_distance=1200.0,
+                       max_route_distance_factor=4.0)
+        if mode == "foot":
+            return cls(search_radius=30.0, breakage_distance=400.0,
+                       max_route_distance_factor=3.0,
+                       interpolation_distance=5.0)
+        raise ValueError(f"unknown mode {mode!r}; "
+                         "one of ['auto', 'bicycle', 'foot']")
+
 
 @dataclass(frozen=True)
 class CompilerParams:
@@ -135,6 +161,18 @@ class Config:
     service: ServiceConfig = field(default_factory=ServiceConfig)
     streaming: StreamingConfig = field(default_factory=StreamingConfig)
     matcher_backend: str = "jax"   # {"jax", "reference_cpu"} — the backend boundary
+
+    @classmethod
+    def for_mode(cls, mode: str, **kw: Any) -> "Config":
+        """Config serving one transport mode: the mode-keyed MatcherParams
+        preset + the service mode tag (reports carry it; requests naming a
+        different mode are rejected). Pair with a tileset compiled via
+        ``compile_network(net, params, mode=...)`` — one deployment serves
+        one mode, like the reference's per-mode valhalla config."""
+        p = MatcherParams.preset(mode)    # validates the mode name
+        svc = dataclasses.replace(kw.pop("service", ServiceConfig()),
+                                  mode=mode)
+        return cls(matcher=p, service=svc, **kw)
 
     def validate(self) -> "Config":
         """Cross-section invariants. The grid's single-cell candidate gather

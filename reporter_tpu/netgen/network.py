@@ -14,9 +14,21 @@ from dataclasses import dataclass, field
 import numpy as np
 
 
+# Per-mode access bits (Valhalla's kAutoAccess/kBicycleAccess/kPedestrian
+# analog, SURVEY.md §2.1 "mode costing"): a Way carries the set of modes
+# allowed on it; compile_network(..., mode=...) builds a tileset over one
+# mode's subgraph.
+ACCESS_AUTO = 1
+ACCESS_BICYCLE = 2
+ACCESS_FOOT = 4
+ACCESS_ALL = ACCESS_AUTO | ACCESS_BICYCLE | ACCESS_FOOT
+MODE_BITS = {"auto": ACCESS_AUTO, "bicycle": ACCESS_BICYCLE,
+             "foot": ACCESS_FOOT}
+
+
 @dataclass
 class Way:
-    """A drivable way: an ordered chain of node indices, optionally with
+    """A travelable way: an ordered chain of node indices, optionally with
     intermediate shape geometry per leg (lonlat points strictly between the
     leg's endpoint nodes)."""
 
@@ -27,6 +39,7 @@ class Way:
     speed_mps: float = 13.4              # free-flow speed, ~30 mph default
     # leg index i (between nodes[i] and nodes[i+1]) → [k, 2] lonlat shape points
     geometry: dict[int, np.ndarray] = field(default_factory=dict)
+    access_mask: int = ACCESS_ALL        # OR of ACCESS_* bits
 
 
 @dataclass
@@ -71,3 +84,58 @@ class RoadNetwork:
     def origin(self) -> np.ndarray:
         lo, hi = self.bbox()
         return (lo + hi) / 2.0
+
+    def for_mode(self, mode: str) -> "RoadNetwork":
+        """The mode's legal subgraph: ways whose access_mask includes
+        ``mode``, restrictions filtered to surviving ways. Pedestrians
+        ignore oneway (Valhalla pedestrian costing parity): the foot view
+        clears it, so both directed edges exist. Node array is shared
+        (ids stay stable); ways are shallow-rebuilt only where changed.
+
+        This is the per-mode costing boundary (SURVEY.md §2.1): one
+        compile per served mode, so the matcher's tables — candidates,
+        reach routing, OSMLR chains — are all consistent with what that
+        mode may drive. Fixed tables per mode beat per-query masking on
+        TPU: the sweep scans fewer segments instead of filtering more.
+        """
+        bit = MODE_BITS.get(mode)
+        if bit is None:
+            raise ValueError(f"unknown mode {mode!r}; "
+                             f"one of {sorted(MODE_BITS)}")
+        ways = [w for w in self.ways if w.access_mask & bit]
+        if mode == "foot":
+            ways = [w if not w.oneway else Way(
+                way_id=w.way_id, nodes=w.nodes, oneway=False, name=w.name,
+                speed_mps=w.speed_mps, geometry=w.geometry,
+                access_mask=w.access_mask) for w in ways]
+        if mode == "foot":
+            restrictions = []    # turn restrictions do not bind pedestrians
+        else:
+            keep = {w.way_id for w in ways}
+            restrictions = [r for r in self.restrictions
+                            if r.from_way in keep and r.to_way in keep]
+        # Compact nodes to those the kept ways reference: reach tables are
+        # one row PER NODE, so orphans from other modes' ways would cost
+        # real table memory downstream.
+        used: dict[int, int] = {}
+        for w in ways:
+            for nd in w.nodes:
+                if nd not in used:
+                    used[nd] = len(used)
+        if len(used) != self.num_nodes:
+            order = sorted(used, key=used.get)
+            node_lonlat = self.node_lonlat[order]
+            ways = [Way(way_id=w.way_id, nodes=[used[nd] for nd in w.nodes],
+                        oneway=w.oneway, name=w.name, speed_mps=w.speed_mps,
+                        geometry=w.geometry, access_mask=w.access_mask)
+                    for w in ways]
+            restrictions = [TurnRestriction(
+                from_way=r.from_way, via_node=used[r.via_node],
+                to_way=r.to_way, kind=r.kind)
+                for r in restrictions if r.via_node in used]
+        else:
+            node_lonlat = self.node_lonlat
+        suffix = "" if mode == "auto" else f"-{mode}"
+        return RoadNetwork(node_lonlat=node_lonlat, ways=ways,
+                           name=f"{self.name}{suffix}",
+                           restrictions=restrictions)

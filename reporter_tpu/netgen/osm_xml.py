@@ -16,7 +16,9 @@ import xml.etree.ElementTree as ET
 import numpy as np
 
 from reporter_tpu.geometry import lonlat_to_xy
-from reporter_tpu.netgen.network import RoadNetwork, TurnRestriction, Way
+from reporter_tpu.netgen.network import (ACCESS_ALL, ACCESS_AUTO,
+                                         ACCESS_BICYCLE, ACCESS_FOOT,
+                                         RoadNetwork, TurnRestriction, Way)
 
 DRIVABLE_HIGHWAY = {
     "motorway", "trunk", "primary", "secondary", "tertiary", "unclassified",
@@ -24,23 +26,66 @@ DRIVABLE_HIGHWAY = {
     "secondary_link", "tertiary_link", "living_street",
 }
 
-# Access values that exclude general motor traffic (Valhalla's auto costing
-# analog, SURVEY.md §3.4). Checked most-specific-first per the OSM access
-# hierarchy: motor_vehicle overrides vehicle overrides access.
+# highway classes that only exist for non-auto modes (kept in the
+# RoadNetwork with the matching access bits; the auto compile filters
+# them out via RoadNetwork.for_mode)
+_MODE_ONLY_HIGHWAY = {
+    "cycleway": ACCESS_BICYCLE | ACCESS_FOOT,
+    "footway": ACCESS_FOOT,
+    "pedestrian": ACCESS_FOOT,
+    "steps": ACCESS_FOOT,
+    "path": ACCESS_FOOT | ACCESS_BICYCLE,
+    # track: agricultural lanes — bike/foot by default here (the pre-mode
+    # parser never compiled them for autos; motor_vehicle=yes opts in)
+    "track": ACCESS_FOOT | ACCESS_BICYCLE,
+}
+
+# classes where non-motor modes are off by DEFAULT (tag overrides apply)
+_AUTO_ONLY_HIGHWAY = {"motorway", "motorway_link", "trunk", "trunk_link"}
+
+# Access values that exclude a mode (Valhalla costing analog, SURVEY.md
+# §3.4). Checked most-specific-first per the OSM access hierarchy — each
+# mode has its own override chain.
 _NO_ACCESS = {"no", "private", "agricultural", "forestry", "delivery",
               "emergency", "military"}
 
+_MODE_TAG_CHAIN = {
+    ACCESS_AUTO: ("motor_vehicle", "vehicle", "access"),
+    ACCESS_BICYCLE: ("bicycle", "vehicle", "access"),
+    ACCESS_FOOT: ("foot", "access"),
+}
 
-def _motor_access(tags: "dict[str, str]") -> bool:
-    for key in ("motor_vehicle", "vehicle", "access"):
-        v = tags.get(key)
-        if v is not None:
-            return v not in _NO_ACCESS
-    return True
+
+def _access_mask(tags: "dict[str, str]") -> int:
+    """Per-mode access bits for a way, from its highway class default +
+    the OSM access-tag hierarchy (most specific key wins per mode)."""
+    hw = tags.get("highway", "")
+    if hw in _MODE_ONLY_HIGHWAY:
+        default = _MODE_ONLY_HIGHWAY[hw]
+    elif hw in _AUTO_ONLY_HIGHWAY:
+        default = ACCESS_AUTO
+    elif hw in DRIVABLE_HIGHWAY:
+        default = ACCESS_ALL
+    else:
+        return 0
+    mask = 0
+    for bit, chain in _MODE_TAG_CHAIN.items():
+        allowed = bool(default & bit)
+        for key in chain:
+            v = tags.get(key)
+            if v is not None:
+                allowed = v not in _NO_ACCESS
+                break                 # most specific key decides
+        if allowed:
+            mask |= bit
+    return mask
 
 _DEFAULT_SPEED = {  # m/s by highway class
     "motorway": 29.0, "trunk": 24.5, "primary": 17.9, "secondary": 15.6,
     "tertiary": 13.4, "residential": 11.2, "service": 6.7, "living_street": 4.5,
+    # non-auto classes: free-flow for their primary mode
+    "cycleway": 5.6, "footway": 1.4, "pedestrian": 1.4, "steps": 0.7,
+    "path": 2.8, "track": 8.3,
 }
 
 # Interior shape runs longer than this split into separate legs/edges:
@@ -106,18 +151,17 @@ def build_network(
     node_pos: osm node id → (lon, lat); raw_ways: (way id, node refs,
     tags); raw_relations: (tags, [(role, member type, ref)...]).
     """
-    drivable: list[tuple[int, list[int], dict[str, str]]] = []
+    drivable: list[tuple[int, list[int], dict[str, str], int]] = []
     for way_id, refs, tags in raw_ways:
-        if tags.get("highway") not in DRIVABLE_HIGHWAY:
-            continue
-        if not _motor_access(tags):
+        mask = _access_mask(tags)
+        if not mask:
             continue
         refs = [r for r in refs if r in node_pos]
         # Real extracts contain duplicate consecutive refs; they would become
         # zero-length edges, which the compiler forbids (edge_len > 0).
         refs = [r for i, r in enumerate(refs) if i == 0 or r != refs[i - 1]]
         if len(refs) >= 2:
-            drivable.append((way_id, refs, tags))
+            drivable.append((way_id, refs, tags, mask))
     raw_ways = drivable
 
     # Graph simplification (what valhalla_build_tiles does with OSM shape
@@ -132,7 +176,7 @@ def build_network(
     # stay far inside the u16 wire range.
     ref_count: dict[int, int] = {}
     junction: set[int] = set()
-    for _, refs, _ in raw_ways:
+    for _, refs, _, _ in raw_ways:
         junction.add(refs[0])
         junction.add(refs[-1])
         for r in refs:
@@ -172,10 +216,10 @@ def build_network(
 
     # Keep only junction nodes; remap to dense indices.
     used: dict[int, int] = {}
-    split_ways: list[tuple[int, list[int], dict, dict[str, str]]] = []
-    for way_id, refs, tags in raw_ways:
+    split_ways: list[tuple[int, list[int], dict, dict[str, str], int]] = []
+    for way_id, refs, tags, mask in raw_ways:
         nodes, geometry = leg_split(refs)
-        split_ways.append((way_id, nodes, geometry, tags))
+        split_ways.append((way_id, nodes, geometry, tags, mask))
         for r in nodes:
             if r not in used:
                 used[r] = len(used)
@@ -185,7 +229,7 @@ def build_network(
 
     ways: list[Way] = []
     drivable_way_ids = set()
-    for way_id, refs, geometry, tags in split_ways:
+    for way_id, refs, geometry, tags, mask in split_ways:
         ow = tags.get("oneway", "no") in ("yes", "true", "1")
         nodes = [used[r] for r in refs]
         if tags.get("oneway") == "-1":
@@ -197,7 +241,8 @@ def build_network(
             geometry = {L - 1 - i: g[::-1] for i, g in geometry.items()}
         ways.append(
             Way(way_id=way_id, nodes=nodes, oneway=ow, geometry=geometry,
-                name=tags.get("name", ""), speed_mps=_speed_mps(tags))
+                name=tags.get("name", ""), speed_mps=_speed_mps(tags),
+                access_mask=mask)
         )
         drivable_way_ids.add(way_id)
 

@@ -56,9 +56,19 @@ class _Submission:
         self.error: "Exception | None" = None
 
 
-def _validate_payload(payload: Any) -> tuple[str, list[dict]]:
+def _validate_payload(payload: Any,
+                      expected_mode: "str | None" = None,
+                      ) -> tuple[str, list[dict]]:
     if not isinstance(payload, dict):
         raise BadRequest("payload must be a JSON object")
+    # Mode is a deployment property (one app serves one mode, like the
+    # reference's per-mode valhalla config): a request naming a different
+    # mode would silently get the wrong costing — reject it instead.
+    if (expected_mode is not None and "mode" in payload
+            and payload["mode"] != expected_mode):
+        raise BadRequest(
+            f"this service matches mode {expected_mode!r}; "
+            f"request asked for {payload['mode']!r}")
     uuid = payload.get("uuid")
     if not isinstance(uuid, str) or not uuid:
         raise BadRequest("missing or invalid 'uuid'")
@@ -135,7 +145,8 @@ class ReporterApp:
         Validation errors stay request-scoped (raised here, before
         enqueueing).
         """
-        pairs = [_validate_payload(p) for p in payloads]
+        pairs = [_validate_payload(p, self.config.service.mode)
+                 for p in payloads]
         sub = _Submission(pairs)
         with self._pending_lock:
             self._pending.append(sub)

@@ -55,6 +55,11 @@ def main(argv: list[str] | None = None) -> int:
     b.add_argument("--osm", required=True,
                    help="OSM file (.osm/.xml or .osm.pbf/.pbf)")
     b.add_argument("--name", default=None, help="tileset name")
+    b.add_argument("--mode", default="auto",
+                   choices=("auto", "bicycle", "foot"),
+                   help="compile this mode's legal subgraph (default auto; "
+                        "parsers keep every mode's ways, so one deployment "
+                        "builds one tileset per served mode)")
     _add_compiler_flags(b)
 
     from reporter_tpu.netgen.synthetic import CITY_PRESETS
@@ -137,7 +142,8 @@ def main(argv: list[str] | None = None) -> int:
 
         net = generate_city(args.city, seed=args.seed)
 
-    ts = compile_network(net, _params(args))
+    ts = compile_network(net, _params(args),
+                         mode=getattr(args, "mode", None))
     ts.save(args.output)
     print(json.dumps({"written": args.output, "name": ts.name,
                       "stats": ts.stats}))

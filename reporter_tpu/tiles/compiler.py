@@ -271,9 +271,19 @@ def _build_node_out(num_nodes: int, edge_src: np.ndarray):
     return node_out
 
 
-def compile_network(net: RoadNetwork, params: CompilerParams | None = None) -> TileSet:
-    """Compile a RoadNetwork into a device-ready TileSet."""
+def compile_network(net: RoadNetwork, params: CompilerParams | None = None,
+                    mode: "str | None" = None) -> TileSet:
+    """Compile a RoadNetwork into a device-ready TileSet.
+
+    ``mode`` ("auto" / "bicycle" / "foot") compiles the tileset over that
+    mode's legal subgraph (RoadNetwork.for_mode — the per-mode costing
+    boundary, SURVEY.md §2.1): candidate tables, reach routing, and OSMLR
+    chains are then all consistent with what the mode may travel. None
+    keeps the network as-is (synthetic cities default to all-access ways,
+    so None and "auto" compile identically there)."""
     params = params or CompilerParams()
+    if mode is not None:
+        net = net.for_mode(mode)
     if net.num_nodes == 0 or not net.ways:
         raise ValueError(
             f"RoadNetwork {net.name!r} has no drivable ways/nodes; nothing to compile")
@@ -341,6 +351,7 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None) -> T
             "reach_truncated_nodes": int(reach_truncated),
             "restrictions": len(net.restrictions),
             "banned_turn_pairs": int(len(banned_pairs)),
+            **({"mode": mode} if mode is not None else {}),
             "compile_seconds": round(time.time() - t0, 3),
         },
     )

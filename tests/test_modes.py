@@ -1,0 +1,223 @@
+"""Per-mode matching (SURVEY.md §2.1 "mode costing", §2.2 output "mode").
+
+The mode boundary is compile-time: ``compile_network(net, params,
+mode=...)`` builds the mode's legal subgraph (RoadNetwork.for_mode), and
+``Config.for_mode`` pairs it with the mode-keyed MatcherParams preset.
+The headline fixture: a bike trace down a cycleway legally matches in the
+bicycle profile — in BOTH backends — while the auto profile cannot use
+the cycleway at all.
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import CompilerParams, Config, MatcherParams
+from reporter_tpu.geometry import xy_to_lonlat
+from reporter_tpu.matcher.api import SegmentMatcher, Trace
+from reporter_tpu.netgen.network import (ACCESS_ALL, ACCESS_AUTO,
+                                         ACCESS_BICYCLE, ACCESS_FOOT,
+                                         RoadNetwork, TurnRestriction, Way)
+from reporter_tpu.netgen.osm_xml import _access_mask, parse_osm_xml
+from reporter_tpu.tiles.compiler import compile_network
+
+CYCLEWAY_ID = 99
+
+
+def _mode_city() -> RoadNetwork:
+    """3×3 grid: street ring + vertical sides, and a bike-only cycleway
+    straight across the middle (nodes 3-4-5). A car crossing west→east
+    must go around via the top or bottom street.
+
+        0 --- 1 --- 2        y=+220
+        |           |
+        3 ~~~ 4 ~~~ 5        y=0   (cycleway)
+        |           |
+        6 --- 7 --- 8        y=-220
+    """
+    xs = [-220.0, 0.0, 220.0]
+    ys = [220.0, 0.0, -220.0]
+    xy = np.array([[x, y] for y in ys for x in xs])
+    lonlat = xy_to_lonlat(xy, np.array([-122.4, 37.75]))
+    ways = [
+        Way(way_id=1, nodes=[0, 1, 2], name="top"),
+        Way(way_id=2, nodes=[6, 7, 8], name="bottom"),
+        Way(way_id=3, nodes=[0, 3, 6], name="west"),
+        Way(way_id=4, nodes=[2, 5, 8], name="east"),
+        Way(way_id=CYCLEWAY_ID, nodes=[3, 4, 5], name="cycle-cut",
+            speed_mps=5.6, access_mask=ACCESS_BICYCLE | ACCESS_FOOT),
+    ]
+    return RoadNetwork(node_lonlat=lonlat, ways=ways, name="modecity")
+
+
+def _bike_trace(n: int = 60) -> Trace:
+    """A ride straight down the cycleway (west→east along y=0)."""
+    rng = np.random.default_rng(5)
+    x = np.linspace(-215.0, 215.0, n)
+    pts = np.stack([x, np.zeros(n)], axis=1)
+    pts = pts + rng.normal(0.0, 2.0, pts.shape)
+    return Trace(uuid="bike-1", xy=pts.astype(np.float32),
+                 times=np.arange(n, dtype=np.float64))
+
+
+@pytest.fixture(scope="module")
+def mode_tiles():
+    net = _mode_city()
+    return {
+        "auto": compile_network(net, CompilerParams(), mode="auto"),
+        "bicycle": compile_network(net, CompilerParams(), mode="bicycle"),
+    }
+
+
+class TestModeFixture:
+    @pytest.mark.parametrize("backend", ["jax", "reference_cpu"])
+    def test_bike_trace_matches_cycleway_in_bicycle_profile(
+            self, mode_tiles, backend):
+        cfg = Config.for_mode("bicycle", matcher_backend=backend)
+        m = SegmentMatcher(mode_tiles["bicycle"], cfg)
+        recs = m.match_trace(_bike_trace())
+        ways = {w for r in recs for w in r.way_ids}
+        assert CYCLEWAY_ID in ways, f"cycleway unmatched; ways={ways}"
+        # the ride is a straight line down the cycleway — the matched
+        # length on it should dominate
+        cyc_len = sum(r.length for r in recs if CYCLEWAY_ID in r.way_ids)
+        assert cyc_len > 300.0
+
+    @pytest.mark.parametrize("backend", ["jax", "reference_cpu"])
+    def test_auto_profile_cannot_use_cycleway(self, mode_tiles, backend):
+        cfg = Config.for_mode("auto", matcher_backend=backend)
+        m = SegmentMatcher(mode_tiles["auto"], cfg)
+        recs = m.match_trace(_bike_trace())
+        ways = {w for r in recs for w in r.way_ids}
+        assert CYCLEWAY_ID not in ways
+        assert ways <= {1, 2, 3, 4}
+        # mid-block points are ~200 m from any drivable street; the auto
+        # profile's only legal interpretation is the around-the-block
+        # detour (~880 m via the ring) — it cannot take the ~430 m cut
+        # the bicycle profile matches
+        total = sum(r.length for r in recs)
+        assert total > 600.0
+
+    def test_mode_subgraph_shapes(self, mode_tiles):
+        a, b = mode_tiles["auto"], mode_tiles["bicycle"]
+        assert a.stats["mode"] == "auto"
+        assert b.stats["mode"] == "bicycle"
+        assert b.num_edges == a.num_edges + 4   # two-way cycleway, 2 legs
+        assert a.name == "modecity"             # auto keeps the base name
+        assert b.name == "modecity-bicycle"
+
+
+class TestForMode:
+    def test_foot_ignores_oneway_and_restrictions(self):
+        net = _mode_city()
+        net.ways[0].oneway = True
+        net.restrictions.append(TurnRestriction(
+            from_way=3, via_node=0, to_way=1, kind="no_turn"))
+        foot = net.for_mode("foot")
+        assert all(not w.oneway for w in foot.ways)
+        assert foot.restrictions == []
+        auto = net.for_mode("auto")
+        assert auto.ways[0].oneway
+        assert len(auto.restrictions) == 1
+
+    def test_restriction_on_dropped_way_is_dropped(self):
+        net = _mode_city()
+        net.restrictions.append(TurnRestriction(
+            from_way=CYCLEWAY_ID, via_node=3, to_way=3, kind="no_turn"))
+        assert net.for_mode("auto").restrictions == []
+        assert len(net.for_mode("bicycle").restrictions) == 1
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown mode"):
+            _mode_city().for_mode("hovercraft")
+
+
+class TestAccessMask:
+    def test_highway_class_defaults(self):
+        assert _access_mask({"highway": "residential"}) == ACCESS_ALL
+        assert _access_mask({"highway": "motorway"}) == ACCESS_AUTO
+        assert _access_mask({"highway": "cycleway"}) == (
+            ACCESS_BICYCLE | ACCESS_FOOT)
+        assert _access_mask({"highway": "footway"}) == ACCESS_FOOT
+        assert _access_mask({"highway": "steps"}) == ACCESS_FOOT
+        assert _access_mask({"highway": "path"}) == (
+            ACCESS_FOOT | ACCESS_BICYCLE)
+        # track is bike/foot by default (pre-mode parsers never compiled
+        # tracks for autos); motor_vehicle=yes opts in
+        assert _access_mask({"highway": "track"}) == (
+            ACCESS_FOOT | ACCESS_BICYCLE)
+        assert _access_mask({"highway": "track",
+                             "motor_vehicle": "yes"}) & ACCESS_AUTO
+        assert _access_mask({"highway": "proposed"}) == 0
+        assert _access_mask({}) == 0
+
+    def test_mode_specific_tag_overrides(self):
+        # bicycle=no on a residential street: bike loses, others keep
+        m = _access_mask({"highway": "residential", "bicycle": "no"})
+        assert m == (ACCESS_AUTO | ACCESS_FOOT)
+        # motor_vehicle=no: cars lose, bike/foot keep
+        m = _access_mask({"highway": "residential", "motor_vehicle": "no"})
+        assert m == (ACCESS_BICYCLE | ACCESS_FOOT)
+        # explicit allow overrides a class default (foot=yes on motorway)
+        m = _access_mask({"highway": "motorway", "foot": "yes"})
+        assert m & ACCESS_FOOT
+        # cycleway with bicycle=no (construction detour): nothing for bikes
+        m = _access_mask({"highway": "cycleway", "bicycle": "no"})
+        assert not (m & ACCESS_BICYCLE)
+
+    def test_hierarchy_specificity(self):
+        # access=no + motor_vehicle=yes: the specific key wins for autos,
+        # the generic deny still binds bike and foot
+        m = _access_mask({"highway": "residential", "access": "no",
+                          "motor_vehicle": "yes"})
+        assert m == ACCESS_AUTO
+        # vehicle=no stops autos and bikes, not pedestrians
+        m = _access_mask({"highway": "residential", "vehicle": "no"})
+        assert m == ACCESS_FOOT
+
+    def test_osm_xml_carries_masks(self):
+        xml = """<osm>
+          <node id="1" lon="-122.400" lat="37.750"/>
+          <node id="2" lon="-122.398" lat="37.750"/>
+          <node id="3" lon="-122.398" lat="37.752"/>
+          <way id="10"><nd ref="1"/><nd ref="2"/>
+            <tag k="highway" v="residential"/></way>
+          <way id="11"><nd ref="2"/><nd ref="3"/>
+            <tag k="highway" v="cycleway"/></way>
+        </osm>"""
+        net = parse_osm_xml(xml)
+        masks = {w.way_id: w.access_mask for w in net.ways}
+        assert masks[10] == ACCESS_ALL
+        assert masks[11] == ACCESS_BICYCLE | ACCESS_FOOT
+        # the auto view drops the cycleway; bicycle keeps both
+        assert {w.way_id for w in net.for_mode("auto").ways} == {10}
+        assert {w.way_id for w in net.for_mode("bicycle").ways} == {10, 11}
+
+
+class TestModePlumbing:
+    def test_config_for_mode_presets(self):
+        cfg = Config.for_mode("foot")
+        assert cfg.service.mode == "foot"
+        assert cfg.matcher == MatcherParams.preset("foot")
+        assert cfg.matcher.search_radius < MatcherParams().search_radius
+        with pytest.raises(ValueError):
+            Config.for_mode("warp")
+
+    def test_match_response_carries_mode(self, mode_tiles):
+        m = SegmentMatcher(mode_tiles["bicycle"], Config.for_mode("bicycle"))
+        out = m.match({"uuid": "b", "trace": [
+            {"lat": 37.75, "lon": -122.4, "time": 0.0}]})
+        assert out["mode"] == "bicycle"
+
+    def test_service_rejects_mismatched_mode(self, mode_tiles):
+        from reporter_tpu.service.app import BadRequest, ReporterApp
+
+        app = ReporterApp(mode_tiles["bicycle"], Config.for_mode("bicycle"))
+        ok = app.report_one({"uuid": "b", "mode": "bicycle", "trace": [
+            {"lat": 37.75, "lon": -122.4, "time": 0.0}]})
+        assert ok["mode"] == "bicycle"
+        untagged = app.report_one({"uuid": "b", "trace": [
+            {"lat": 37.75, "lon": -122.4, "time": 0.0}]})
+        assert untagged["mode"] == "bicycle"   # modeless requests pass
+        with pytest.raises(BadRequest, match="bicycle"):
+            app.report_one({"uuid": "b", "mode": "auto", "trace": [
+                {"lat": 37.75, "lon": -122.4, "time": 0.0}]})

@@ -241,9 +241,11 @@ def test_osm_xml_parser_roundtrip():
     from reporter_tpu.tiles.compiler import compile_network
     from reporter_tpu.config import CompilerParams
 
-    net = parse_osm_xml(xml, name="fixture")
-    assert len(net.ways) == 2  # footway dropped
-    assert net.num_nodes == 3  # node 9 only used by the footway
+    parsed = parse_osm_xml(xml, name="fixture")
+    assert len(parsed.ways) == 3    # footway kept, foot-only access bits
+    net = parsed.for_mode("auto")
+    assert len(net.ways) == 2       # footway out of the auto subgraph
+    assert net.num_nodes == 3       # node 9 orphaned with it, compacted out
     w101 = [w for w in net.ways if w.way_id == 101][0]
     assert w101.oneway and abs(w101.speed_mps - 40 * 0.44704) < 1e-6
 
@@ -287,8 +289,13 @@ def test_access_tags_filter_motor_traffic():
     from reporter_tpu.netgen.osm_xml import parse_osm_xml
 
     net = parse_osm_xml(xml, name="access")
-    got = sorted(w.way_id for w in net.ways)
+    # vehicle=no (201) now stays in the full network with foot-only bits;
+    # the AUTO subgraph is where motor filtering binds (for_mode)
+    got = sorted(w.way_id for w in net.for_mode("auto").ways)
     assert got == [202, 203], got
+    # foot: vehicle=no doesn't bind pedestrians (201 kept) but the generic
+    # access=no on 202 does — motor_vehicle=yes only rescues autos
+    assert sorted(w.way_id for w in net.for_mode("foot").ways) == [201, 203]
 
 
 def test_osmlr_geojson_export(tiny_tiles, tmp_path):
