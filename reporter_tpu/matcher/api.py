@@ -233,18 +233,26 @@ class SegmentMatcher:
         reference_cpu backend raises NotImplementedError by contract (it
         exists as a fidelity oracle for the primary path, and its own
         oracle for TopK is the exact list-Viterbi in the test above).
-        Diagnostic surface — the reporting pipeline uses the best path."""
+        Diagnostic surface — the reporting pipeline uses the best path.
+        Defined over at most one max bucket (1024 points): K-best chunks
+        do not compose into a global K-best the way match_many's
+        independent-HMM chunks do, so longer traces are REJECTED rather
+        than silently truncated — decimate or split the trace first."""
         if self.backend != "jax":
             raise NotImplementedError("match_topk requires the jax backend")
+        if len(trace.xy) > _BUCKETS[-1]:
+            raise ValueError(
+                f"match_topk is defined over ≤{_BUCKETS[-1]} points "
+                f"(got {len(trace.xy)}); ranked alternates do not compose "
+                "across chunks — split or decimate the trace, or use "
+                "match_many for the best-path decode")
         import jax.numpy as jnp
 
         from reporter_tpu.ops.hmm import (viterbi_kbest_paths,
                                           viterbi_topk_paths)
         from reporter_tpu.ops.match import batch_candidates
 
-        # diagnostic surface: alternates are computed over the first
-        # max-bucket points (match_many chunks longer traces instead)
-        xy = trace.xy[:_BUCKETS[-1]]
+        xy = trace.xy
         T = max(len(xy), 1)
         pts = np.zeros((1, _bucket_len(T), 2), np.float32)
         pts[0, :len(xy)] = xy
