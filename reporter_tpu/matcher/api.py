@@ -160,7 +160,10 @@ class SegmentMatcher:
         backend = self.config.matcher_backend
         self._native_walker = None
         if backend == "jax":
-            self._tables = tileset.device_tables()
+            # stage only the layout the resolved candidate backend sweeps
+            # (the unused one is the largest table at metro scale)
+            self._tables = tileset.device_tables(
+                self.params.candidate_backend)
             self._route_fn = reach_route_fn(tileset)
             # Native batch walker (walker.cc): same walk as build_segments
             # with the reach-table route_fn, multithreaded across traces.

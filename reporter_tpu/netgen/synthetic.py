@@ -25,6 +25,10 @@ CITY_PRESETS: dict[str, tuple[int, int, int]] = {
     # ~16k intersections, ~54k directed edges after interior-node
     # simplification (the compiled count STATUS/bench quote), ~17 km a side
     "bayarea": (4, 128, 128),
+    # realistic-scale HBM stressor (SURVEY §7 "HBM budget"): ~147k
+    # intersections, ~0.5M directed edges, ~46 km a side — several GB of
+    # reach/grid/shape tables, the real Bay Area's order of magnitude
+    "bayarea-xl": (5, 384, 384),
 }
 
 _CITY_CENTERS = {
@@ -33,6 +37,7 @@ _CITY_CENTERS = {
     "nyc": (-73.9857, 40.7484),
     "la": (-118.2437, 34.0522),
     "bayarea": (-122.2711, 37.8044),
+    "bayarea-xl": (-122.2711, 37.8044),
 }
 
 

@@ -29,8 +29,10 @@ def make_dp_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams):
     zero cross-device communication in the forward match.
     """
     axes = tuple(mesh.axis_names)              # ("tile", "dp") or ("dp",)
-    tables = jax.device_put(ts.device_tables(),
-                            NamedSharding(mesh, P()))      # replicated
+    # replicated to every device — stage only the layout this platform's
+    # candidate backend sweeps (cell_pack is ~1 GB at bayarea-xl scale)
+    tables = jax.device_put(ts.device_tables(params.candidate_backend),
+                            NamedSharding(mesh, P()))
     meta = ts.meta
 
     local = jax.shard_map(

@@ -1,0 +1,98 @@
+"""Per-chip HBM capacity planning — the SURVEY §7 "HBM budget" hard part.
+
+Decides, for a compiled TileSet and a device memory budget, whether the
+matcher stages the whole map replicated (the fast path: zero collectives
+in candidate search) or must shard the segment table over a mesh axis
+(parallel/sharded_candidates — per-shard sweeps + one ICI all-gather
+K-merge per batch).
+
+Plans over the bytes the dense (TPU) path actually stages
+(TileSet.device_tables(candidate_backend="dense")): the Morton-blocked
+seg_pack + bboxes, the per-edge arrays, and the node-keyed reach tables.
+The grid backend's cell_pack fusion — the largest table at metro scale
+(~1.06 GB for bayarea-xl) — is a CPU-backend layout and is no longer
+staged on accelerators.
+
+The measured envelope (bayarea-xl, 484,713 directed edges / 606,010 line
+segments): a few hundred bytes per directed edge, dominated by the reach
+rows — so one 16 GB v5e chip holds tens of millions of directed edges
+replicated, an order of magnitude past any US metro. Segment sharding is
+the continental-scale rung; past ITS crossover the reach share itself
+outgrows the budget, and the answer is metro sharding
+(parallel/multimetro) or a narrower reach_max, which the error message
+says. bench.py's `xl` block records the live numbers each round.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from reporter_tpu.tiles.tileset import TileSet
+
+# Conservative default budget for one v5e chip: 16 GB HBM minus compiler
+# workspace, activation buffers, and the wire/infeed arrays.
+DEFAULT_HBM_BUDGET = 12 * 1024**3
+
+class StagingPlan(NamedTuple):
+    strategy: str          # "replicated" | "segment-sharded"
+    shards: int            # mesh extent needed on the sharding axis (1 ⇒
+    #                        replicated)
+    table_bytes: int       # dense-path staged bytes, unsharded
+    shardable_bytes: int   # the segment-table share (what sharding divides)
+    fixed_bytes: int       # replicated share (reach + per-edge arrays)
+    budget_bytes: int
+    bytes_per_edge: float  # table_bytes / directed edges
+    edge_capacity: int     # directed edges that fit replicated in budget
+
+    def to_json(self) -> dict:
+        return {**self._asdict(),
+                "bytes_per_edge": round(self.bytes_per_edge, 1)}
+
+
+def dense_staged_bytes(ts: TileSet) -> tuple[int, int]:
+    """(shardable, fixed) HBM bytes for the dense path's device tables.
+
+    shardable — seg_pack [8, S] f32 + per-block bboxes, what
+    parallel/sharded_candidates.shard_tables splits over the mesh;
+    fixed — per-edge arrays + node-keyed reach rows, replicated by design
+    (every shard's Viterbi needs them).
+    """
+    from reporter_tpu.ops.dense_candidates import _SBLK, SP_NCOMP
+
+    # exact shape math for build_seg_pack's layout ([SP_NCOMP, S_pad] f32
+    # pack + [S_pad/_SBLK, 4] f32 bboxes) — computing it beats REBUILDING
+    # the Morton pack (~seconds at 0.6M segments on a one-core host)
+    s = int(len(ts.seg_edge))
+    spad = max(_SBLK, -(-s // _SBLK) * _SBLK)
+    shardable = (SP_NCOMP * spad + (spad // _SBLK) * 4) * 4
+    fixed = int(ts.edge_len.nbytes + ts.edge_reach_row.nbytes
+                + ts.edge_osmlr.nbytes + ts.reach_to.nbytes
+                + ts.reach_dist.nbytes)
+    return shardable, fixed
+
+
+def plan_staging(ts: TileSet, budget_bytes: int = DEFAULT_HBM_BUDGET,
+                 ) -> StagingPlan:
+    """Staging plan for one device (or one shard axis of a mesh).
+
+    Raises when even a fully-sharded layout cannot fit (the replicated
+    reach/edge share alone over budget) — at that scale shard by metro
+    (parallel/multimetro) or narrow reach_max instead.
+    """
+    shardable, fixed = dense_staged_bytes(ts)
+    total = shardable + fixed
+    per_edge = total / max(ts.num_edges, 1)
+    capacity = int(budget_bytes / per_edge) if per_edge else 0
+    if total <= budget_bytes:
+        return StagingPlan("replicated", 1, total, shardable, fixed,
+                           int(budget_bytes), per_edge, capacity)
+    headroom = budget_bytes - fixed
+    if headroom <= 0:
+        raise ValueError(
+            f"tileset {ts.name!r}: replicated share {fixed} B alone "
+            f"exceeds the {budget_bytes} B budget — segment sharding "
+            "cannot help; shard by metro (parallel/multimetro) or shrink "
+            "reach_max/grid capacity")
+    shards = -(-shardable // headroom)          # ceil division
+    return StagingPlan("segment-sharded", int(shards), total, shardable,
+                       fixed, int(budget_bytes), per_edge, capacity)

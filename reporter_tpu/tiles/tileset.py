@@ -205,14 +205,34 @@ class TileSet:
 
     # ---- device staging --------------------------------------------------
 
-    def device_tables(self) -> dict[str, Any]:
+    def device_tables(self, candidate_backend: str = "both",
+                      ) -> dict[str, Any]:
         """The subset of arrays the on-device matcher kernels consume, as a
-        plain dict pytree of jnp arrays (HBM-resident after first use)."""
+        plain dict pytree of jnp arrays (HBM-resident after first use).
+
+        ``candidate_backend`` prunes the candidate-search layout staged:
+        "dense" skips cell_pack (the grid backend's [C, 8*cap] f32 fusion
+        — by far the largest table at metro scale: ~1.06 GB for
+        bayarea-xl vs 19 MB of seg_pack), "grid" skips seg_pack/bbox,
+        "auto" resolves like ops.match.batch_candidates (grid on CPU,
+        dense on accelerators), "both" stages everything (multimetro
+        stacking and tests that flip backends per matcher)."""
+        import jax
         import jax.numpy as jnp
 
         import logging
 
         from reporter_tpu.ops.dense_candidates import build_seg_pack
+
+        if candidate_backend == "auto":
+            candidate_backend = ("grid" if jax.default_backend() == "cpu"
+                                 else "dense")
+        if candidate_backend not in ("dense", "grid", "both"):
+            # a typo would silently stage BOTH layouts, defeating the
+            # pruning — mirror ops/match.batch_candidates' strictness
+            raise ValueError(
+                f"unknown candidate_backend {candidate_backend!r}; "
+                "use 'auto', 'dense', 'grid' or 'both'")
 
         # The u16 result wire format carries offsets in 0.25 m fixed point
         # (ops/match.py OFFSET_QUANTUM): edges longer than 16.4 km would
@@ -231,20 +251,23 @@ class TileSet:
         # component rows swept by the pallas kernel with bbox culling, no
         # gathers at all; ops/dense_candidates.py). The id-only grid and
         # per-segment SoA arrays stay host-side.
-        sp = build_seg_pack(self.seg_a, self.seg_b, self.seg_edge,
-                            self.seg_off, self.seg_len)
-        return {
-            "cell_pack": jnp.asarray(build_cell_pack(
-                self.grid, self.seg_a, self.seg_b, self.seg_edge,
-                self.seg_off, self.seg_len)),
-            "seg_pack": jnp.asarray(sp.pack),
-            "seg_bbox": jnp.asarray(sp.bbox),
+        out: dict[str, Any] = {
             "edge_len": jnp.asarray(self.edge_len),
             "reach_row": jnp.asarray(self.edge_reach_row),
             "edge_osmlr": jnp.asarray(self.edge_osmlr),
             "reach_to": jnp.asarray(self.reach_to),
             "reach_dist": jnp.asarray(self.reach_dist),
         }
+        if candidate_backend != "dense":
+            out["cell_pack"] = jnp.asarray(build_cell_pack(
+                self.grid, self.seg_a, self.seg_b, self.seg_edge,
+                self.seg_off, self.seg_len))
+        if candidate_backend != "grid":
+            sp = build_seg_pack(self.seg_a, self.seg_b, self.seg_edge,
+                                self.seg_off, self.seg_len)
+            out["seg_pack"] = jnp.asarray(sp.pack)
+            out["seg_bbox"] = jnp.asarray(sp.bbox)
+        return out
 
     def hbm_bytes(self) -> int:
         return int(sum(getattr(self, f).nbytes for f in _ARRAY_FIELDS))

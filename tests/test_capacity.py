@@ -1,0 +1,82 @@
+"""HBM capacity planning (tiles/capacity.py) — SURVEY §7 "HBM budget".
+
+The plan must pick replicated staging inside the budget, compute the
+segment-sharding crossover outside it, and refuse impossible budgets —
+plus the sharded path it hands off to must agree with the replicated
+sweep (parity is covered by test_parallel; here we check the decision
+boundary and its arithmetic against real tilesets).
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.tiles.capacity import (DEFAULT_HBM_BUDGET,
+                                         dense_staged_bytes, plan_staging)
+
+
+class TestPlanStaging:
+    def test_replicated_within_budget(self, tiny_tiles):
+        plan = plan_staging(tiny_tiles)   # tiny vs 12 GB: trivially fits
+        assert plan.strategy == "replicated"
+        assert plan.shards == 1
+        shardable, fixed = dense_staged_bytes(tiny_tiles)
+        assert plan.table_bytes == shardable + fixed
+        assert plan.fixed_bytes + plan.shardable_bytes == plan.table_bytes
+        assert plan.edge_capacity > tiny_tiles.num_edges
+
+    def test_staged_bytes_track_device_tables(self, tiny_tiles):
+        """The plan's fixed share must equal what the dense path actually
+        stages (minus the segment pack), or the envelope is fiction."""
+        tables = tiny_tiles.device_tables("dense")
+        assert "cell_pack" not in tables       # grid layout not staged
+        staged_fixed = sum(
+            int(np.asarray(tables[k]).nbytes)
+            for k in ("edge_len", "reach_row", "edge_osmlr",
+                      "reach_to", "reach_dist"))
+        shardable, fixed = dense_staged_bytes(tiny_tiles)
+        assert fixed == staged_fixed
+        real = (int(np.asarray(tables["seg_pack"]).nbytes)
+                + int(np.asarray(tables["seg_bbox"]).nbytes))
+        assert shardable == real    # exact: same builder, same layout
+
+    def test_sharded_past_budget_and_monotone(self, tiny_tiles):
+        shardable, fixed = dense_staged_bytes(tiny_tiles)
+        tight = fixed + shardable // 2          # forces ≥2 shards
+        plan = plan_staging(tiny_tiles, tight)
+        assert plan.strategy == "segment-sharded"
+        assert plan.shards >= 2
+        # shards × per-shard headroom must cover the segment share
+        assert plan.shards * (tight - fixed) >= shardable
+        tighter = fixed + shardable // 4
+        assert plan_staging(tiny_tiles, tighter).shards >= plan.shards
+
+    def test_impossible_budget_raises(self, tiny_tiles):
+        _, fixed = dense_staged_bytes(tiny_tiles)
+        with pytest.raises(ValueError, match="segment sharding"):
+            plan_staging(tiny_tiles, fixed)
+
+    def test_envelope_arithmetic(self, tiny_tiles):
+        plan = plan_staging(tiny_tiles)
+        shardable, fixed = dense_staged_bytes(tiny_tiles)
+        want = DEFAULT_HBM_BUDGET / ((shardable + fixed)
+                                     / tiny_tiles.num_edges)
+        assert plan.edge_capacity == int(want)
+        assert plan.to_json()["strategy"] == "replicated"
+
+
+def test_xl_scale_city_compiles_and_plans(tmp_path):
+    """A scaled-down xl (same generator, kept CI-sized): the compiled
+    tables must plan replicated under the default budget, and the
+    bytes-per-edge figure must put the sharding crossover far past any
+    real metro (the measured envelope: ~825 B/edge ⇒ ~14M edges on 12 GB).
+    The full bayarea-xl (484,713 edges) runs in bench.py's xl block."""
+    from reporter_tpu.config import CompilerParams
+    from reporter_tpu.netgen.synthetic import generate_city
+    from reporter_tpu.tiles.compiler import compile_network
+
+    ts = compile_network(generate_city("bayarea-xl", nx=64, ny=64),
+                         CompilerParams())
+    plan = plan_staging(ts)
+    assert plan.strategy == "replicated"
+    assert 100 <= plan.bytes_per_edge <= 5000   # layout sanity band
+    assert plan.edge_capacity >= 2_000_000      # ≫ any US metro
