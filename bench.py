@@ -77,8 +77,17 @@ def _cached_tileset(city: str, restricted: bool = False):
         net = add_random_restrictions(net, fraction=_RESTRICT_FRACTION,
                                       seed=_RESTRICT_SEED)
     fp = zlib.crc32(net.node_lonlat.tobytes())
-    fp = zlib.crc32(np.int64(len(net.ways)).tobytes()
-                    + np.int64(len(net.restrictions)).tobytes(), fp)
+    # topology + attributes, not just counts: a generator change that
+    # moves no node but rewires ways/oneways/restrictions must miss
+    way_words = []
+    for w in net.ways:
+        way_words.extend((w.way_id, len(w.nodes), int(w.oneway),
+                          w.access_mask, int(w.speed_mps * 100)))
+        way_words.extend(w.nodes)
+    for r in net.restrictions:
+        way_words.extend((r.from_way, r.via_node, r.to_way,
+                          zlib.crc32(r.kind.encode())))
+    fp = zlib.crc32(np.asarray(way_words, np.int64).tobytes(), fp)
     path = _repo_path(f".bench_tiles_{key}_v4_{fp & 0xFFFFFFFF:08x}.npz")
     if os.path.exists(path):
         try:
@@ -111,19 +120,45 @@ def _cached_fleet(ts, n_traces: int, n_points: int):
     crc = zlib.crc32(ts.edge_len.tobytes())
     crc = zlib.crc32(ts.ban_from.tobytes(), crc)
     crc = zlib.crc32(ts.ban_to.tobytes(), crc)
-    fp = f"{crc & 0xFFFFFFFF:08x}-s7"
+    fp = f"{crc & 0xFFFFFFFF:08x}-s7t"   # t: cache carries ground truth
     path = _repo_path(f".bench_fleet_{ts.name}_{n_traces}x{n_points}_{fp}.npz")
     if os.path.exists(path):
         with np.load(path) as z:
-            xy, times = z["xy"], z["times"]
+            xy, times, true_edges = z["xy"], z["times"], z["true_edges"]
         return [Trace(uuid=f"bench-{i}", xy=xy[i], times=times[i])
-                for i in range(len(xy))]
+                for i in range(len(xy))], true_edges
     fleet = synthesize_fleet(ts, n_traces, num_points=n_points, seed=7)
     xy = np.stack([p.xy for p in fleet]).astype(np.float32)
     times = np.stack([p.times for p in fleet])
-    np.savez(path, xy=xy, times=times)
+    true_edges = np.stack([p.true_edges for p in fleet]).astype(np.int32)
+    np.savez(path, xy=xy, times=times, true_edges=true_edges)
     return [Trace(uuid=f"bench-{i}", xy=xy[i], times=times[i])
-            for i in range(len(xy))]
+            for i in range(len(xy))], true_edges
+
+
+def _truth_rates(ts, matcher, traces, true_edges, n: int):
+    """Per-point agreement with the SYNTHESIS ground truth (the fleet's
+    driven edge per sample) — independent of the CPU oracle. Point-level
+    truth is intrinsically ambiguous near junctions under 5 m GPS noise
+    (a point can legally project onto the next edge of the same route),
+    so these rates complement — not replace — the length-weighted
+    segment-agreement headline."""
+    import numpy as np
+
+    dec = matcher._decode_many(traces[:n])
+    row = ts.edge_osmlr
+    pts = edge_ok = seg_ok = 0
+    for (e, _, _), te in zip(dec, true_edges[:n]):
+        te = te[:len(e)].astype(np.int64)
+        e = e.astype(np.int64)
+        matched = e >= 0
+        pts += len(e)
+        edge_ok += int((matched & (e == te)).sum())
+        seg_ok += int((matched & (row[np.maximum(e, 0)] == row[te])
+                       & (row[te] >= 0)).sum())
+    return {"traces": n,
+            "point_edge_rate": round(edge_ok / max(pts, 1), 4),
+            "point_segment_rate": round(seg_ok / max(pts, 1), 4)}
 
 
 def _tpu_reachable(timeout_s: float = 120.0) -> bool:
@@ -150,10 +185,25 @@ def _throughput(ts, traces, repeats: int):
 
     m = SegmentMatcher(ts, Config(matcher_backend="jax"))
     m.match_many(traces)                    # compile + stage HBM (full shape)
-    dt = _time_best(lambda: m.match_many(traces), repeats=repeats)
-    dt_dec = _time_best(lambda: m._decode_many(traces), repeats=repeats)
+    dt, dt_dec = _timed_pair(m, traces, repeats)
     probes = sum(len(t.xy) for t in traces)
     return m, probes / dt, probes / dt_dec, dt
+
+
+def _timed_pair(m, traces, repeats: int) -> tuple[float, float]:
+    """Best-of-N (e2e seconds, decode-only seconds), reps INTERLEAVED:
+    the link's throughput drifts minute to minute (~2x day swing), so
+    phase-separated measurements would compare different moods and skew
+    the e2e/decode ratio. The single timing discipline for every window."""
+    dt = dt_dec = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        m.match_many(traces)
+        dt = min(dt, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        m._decode_many(traces)
+        dt_dec = min(dt_dec, time.perf_counter() - t0)
+    return dt, dt_dec
 
 
 def _oracle_audit(ts, jax_matcher, traces, n: int):
@@ -211,7 +261,7 @@ def main() -> None:
     ts, tile_info = _cached_tileset(city)
     split["tile_s"] = round(time.perf_counter() - t0, 1)
     t0 = time.perf_counter()
-    traces = _cached_fleet(ts, n_traces, n_points)
+    traces, true_edges = _cached_fleet(ts, n_traces, n_points)
     split["fleet_s"] = round(time.perf_counter() - t0, 1)
 
     t0 = time.perf_counter()
@@ -302,6 +352,8 @@ def main() -> None:
     split["oracle_primary_s"] = round(time.perf_counter() - t0, 1)
     audit = {ts.name: {"traces": n_cpu,
                        "disagreement": round(disagreement, 4)}}
+    truth = _truth_rates(ts, jax_matcher, traces, true_edges,
+                         n=min(2000, n_traces))
 
     detail = {
         "config": f"{n_traces}x{n_points}pt traces, tile={ts.name}",
@@ -325,6 +377,7 @@ def main() -> None:
         "cpu_reference_probes_per_sec": round(cpu_pps, 1),
         "oracle_sample_traces": n_cpu,
         "segment_id_disagreement_vs_cpu_ref": round(disagreement, 4),
+        "ground_truth": truth,
         "batch_seconds": round(dt_jax, 3),
         "tile_source": tile_info["source"],
         "tile_stats": ts.stats,
@@ -336,7 +389,7 @@ def main() -> None:
         # -- metro scale (BASELINE config 3: bayarea tables in HBM) -------
         t0 = time.perf_counter()
         mts, mtile_info = _cached_tileset("bayarea")
-        mtraces = _cached_fleet(mts, n_traces, n_points)
+        mtraces, _ = _cached_fleet(mts, n_traces, n_points)
         mm, m_pps, m_decode, _ = _throughput(mts, mtraces, repeats=3)
         m_dis, _, m_n = _oracle_audit(mts, mm, mtraces, 100)
         audit[mts.name] = {"traces": m_n, "disagreement": round(m_dis, 4)}
@@ -356,7 +409,7 @@ def main() -> None:
         rts, rtile_info = _cached_tileset("sf", restricted=True)
         # same fleet size as the primary: throughput_vs_unrestricted must
         # isolate the restriction cost, not the batch-overlap difference
-        rtraces = _cached_fleet(rts, n_traces, n_points)
+        rtraces, _ = _cached_fleet(rts, n_traces, n_points)
         # repeats must MATCH the primary's: best-of-5 vs best-of-3 would
         # bias the ratio below 1 on a ~2x-noise link regardless of cost
         rm, r_pps, r_decode, _ = _throughput(rts, rtraces, repeats=5)
@@ -387,7 +440,7 @@ def main() -> None:
         from reporter_tpu.tiles.capacity import plan_staging
 
         xts, xtile_info = _cached_tileset("bayarea-xl")
-        xtraces = _cached_fleet(xts, 4000, n_points)
+        xtraces, _ = _cached_fleet(xts, 4000, n_points)
         xm, x_pps, x_decode, _ = _throughput(xts, xtraces, repeats=3)
         plan = plan_staging(xts)
         detail["xl"] = {
@@ -411,6 +464,24 @@ def main() -> None:
 
         audit_total = sum(v["traces"] for v in audit.values())
         detail["audit"] = {"total_traces": audit_total, "per_tile": audit}
+
+        # Re-measure the primary in a SECOND mood window (~10 min after
+        # the first): the link's throughput swings ~1.5-2x over minutes,
+        # and one bad window under best-of-5 still records a trough. Same
+        # workload, same tile — best of the two windows is still an
+        # honest best-of-N, and both windows are recorded.
+        t0 = time.perf_counter()
+        dt2, dt_dec2 = _timed_pair(jax_matcher, traces, repeats=3)
+        probes = n_traces * n_points
+        detail["primary_second_window"] = {
+            "probes_per_sec_e2e": round(probes / dt2, 1),
+            "decode_only_probes_per_sec": round(probes / dt_dec2, 1)}
+        if probes / dt2 > jax_pps:
+            jax_pps, decode_pps = probes / dt2, probes / dt_dec2
+            detail["decode_only_probes_per_sec"] = round(decode_pps, 1)
+            detail["e2e_over_decode"] = round(jax_pps / decode_pps, 3)
+            detail["batch_seconds"] = round(dt2, 3)
+        split["primary_window2_s"] = round(time.perf_counter() - t0, 1)
 
     detail["setup_split"] = split
     detail["setup_seconds"] = round(

@@ -31,13 +31,17 @@ def __getattr__(name):  # lazy top-level API (avoids importing jax on
         "CompilerParams": ("reporter_tpu.config", "CompilerParams"),
         "MatcherParams": ("reporter_tpu.config", "MatcherParams"),
         "SegmentMatcher": ("reporter_tpu.matcher.api", "SegmentMatcher"),
+        "MatchBatch": ("reporter_tpu.matcher.api", "MatchBatch"),
         "Trace": ("reporter_tpu.matcher.api", "Trace"),
         "TileSet": ("reporter_tpu.tiles.tileset", "TileSet"),
         "compile_network": ("reporter_tpu.tiles.compiler", "compile_network"),
+        "plan_staging": ("reporter_tpu.tiles.capacity", "plan_staging"),
         "generate_city": ("reporter_tpu.netgen.synthetic", "generate_city"),
         "parse_osm_xml": ("reporter_tpu.netgen.osm_xml", "parse_osm_xml"),
         "make_app": ("reporter_tpu.service.app", "make_app"),
         "make_router": ("reporter_tpu.service.router", "make_router"),
+        "KafkaProbeConsumer": ("reporter_tpu.streaming.kafka_adapter",
+                               "KafkaProbeConsumer"),
     }
     if name in _api:
         import importlib

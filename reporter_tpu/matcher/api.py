@@ -404,17 +404,29 @@ class SegmentMatcher:
     def _decode_many(self, traces: Sequence[Trace]):
         """JAX decode for a list of traces → per-trace (edges, offsets,
         chain_starts) numpy triples, bucketed by padded length."""
+        from concurrent.futures import ThreadPoolExecutor
+
         from reporter_tpu.ops.match import unpack_wire
 
         work, inflight = self._submit_many(traces)
         per_trace: list[list[tuple[int, Any]]] = [[] for _ in traces]
-        for ws, wire in inflight:
-            edges, offs, starts = unpack_wire(np.asarray(wire))
+
+        # Same overlap trick as the walk path: unpack + per-trace split of
+        # slice k runs in a worker thread while slice k+1's wire bytes
+        # stream back over the link (np.asarray releases the GIL).
+        def split_slice(ws, arr):
+            edges, offs, starts = unpack_wire(arr)
             for r, w in enumerate(ws):
                 i, lo, xy = work[w]
                 T = len(xy)
                 per_trace[i].append(
                     (lo, (edges[r, :T], offs[r, :T], starts[r, :T])))
+
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            futs = [pool.submit(split_slice, ws, np.asarray(wire))
+                    for ws, wire in inflight]
+            for f in futs:
+                f.result()
 
         out: list[Any] = []
         for chunks in per_trace:

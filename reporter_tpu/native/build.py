@@ -22,6 +22,22 @@ _SOURCES = ("reach.cc", "walker.cc")
 _LIB_NAME = "_libreporter.so"
 
 
+# Sanitizer build flavors (SURVEY.md §5 "Race detection / sanitizers":
+# the reference's C++ deps ran ASan/TSan in upstream CI). Each flavor
+# compiles to its own .so; tests/test_native_sanitizers.py drives the
+# multithreaded walker and the reach builder under both.
+_SANITIZE_FLAGS = {
+    None: [],
+    "asan": ["-fsanitize=address,undefined", "-fno-omit-frame-pointer",
+             "-g", "-O1"],
+    "tsan": ["-fsanitize=thread", "-fno-omit-frame-pointer", "-g", "-O1"],
+}
+
+
+def _lib_name(sanitize: "str | None") -> str:
+    return _LIB_NAME if sanitize is None else f"_libreporter_{sanitize}.so"
+
+
 def _needs_build(lib_path: str) -> bool:
     if not os.path.exists(lib_path):
         return True
@@ -31,17 +47,18 @@ def _needs_build(lib_path: str) -> bool:
         for s in _SOURCES)
 
 
-def build_native_lib(force: bool = False) -> str | None:
+def build_native_lib(force: bool = False,
+                     sanitize: "str | None" = None) -> str | None:
     """Compile the shared library; returns its path or None on failure."""
-    lib_path = os.path.join(_SRC_DIR, _LIB_NAME)
+    lib_path = os.path.join(_SRC_DIR, _lib_name(sanitize))
     if not force and not _needs_build(lib_path):
         return lib_path
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
     # Build to a temp name then rename: atomic w.r.t. concurrent importers.
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
     os.close(fd)
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
-           *srcs, "-lpthread"]
+    cmd = ["g++", *( _SANITIZE_FLAGS[sanitize] or ["-O3"]), "-std=c++17",
+           "-shared", "-fPIC", "-o", tmp, *srcs, "-lpthread"]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
         if proc.returncode != 0:
@@ -58,11 +75,16 @@ def build_native_lib(force: bool = False) -> str | None:
         return None
 
 
-def load_native_lib() -> "ctypes.CDLL | None":
-    """Build if needed, load, and declare signatures. None ⇒ use Python."""
+def load_native_lib(sanitize: "str | None" = None) -> "ctypes.CDLL | None":
+    """Build if needed, load, and declare signatures. None ⇒ use Python.
+
+    ``sanitize`` ("asan"/"tsan") loads the instrumented flavor — the
+    process must have the matching sanitizer runtime preloaded
+    (LD_PRELOAD=libasan.so/libtsan.so), so sanitized runs live in
+    subprocesses (tests/test_native_sanitizers.py)."""
     if os.environ.get("REPORTER_TPU_NO_NATIVE"):
         return None
-    lib_path = build_native_lib()
+    lib_path = build_native_lib(sanitize=sanitize)
     if lib_path is None:
         return None
     try:

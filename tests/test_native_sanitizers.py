@@ -1,0 +1,101 @@
+"""ASan/UBSan + TSan runs of the native components (SURVEY.md §5 "Race
+detection / sanitizers": the reference's C++ deps ran sanitizer builds in
+upstream CI; here the multithreaded walker and reach/grid builders are the
+C++ surface).
+
+Each flavor compiles its own instrumented .so and runs in a SUBPROCESS
+with the sanitizer runtime preloaded (a sanitized shared object cannot
+load into an uninstrumented interpreter otherwise). The driven workload
+multithreads the walker over a real tileset and rebuilds reach tables on
+several threads — the race-prone paths — and asserts output parity with
+the uninstrumented library in the same process.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_DRIVER = r"""
+import numpy as np, sys
+from reporter_tpu.config import CompilerParams
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.tiles.compiler import compile_network
+from reporter_tpu.native.build import load_native_lib
+from reporter_tpu.matcher.native_walk import NativeWalker
+
+flavor = sys.argv[1]
+lib_s = load_native_lib(sanitize=flavor)
+assert lib_s is not None, "sanitized build failed"
+lib_p = load_native_lib()
+assert lib_p is not None
+
+ts = compile_network(generate_city("tiny", seed=19),
+                     CompilerParams(use_native=False))
+
+# --- walker: random-but-plausible decoded batches, many threads --------
+rng = np.random.default_rng(3)
+B, T = 48, 96
+edges = rng.integers(-1, ts.num_edges, size=(B, T)).astype(np.int32)
+offs = rng.uniform(0, 50, size=(B, T)).astype(np.float32)
+starts = (rng.random((B, T)) < 0.1).astype(np.uint8)
+times = np.cumsum(rng.uniform(0.5, 2.0, size=(B, T)), axis=1)
+
+ws = NativeWalker(lib_s, ts); ws._threads = 8
+wp = NativeWalker(lib_p, ts); wp._threads = 8
+cs = ws.walk_columns(edges, offs, starts, times, 10.0)
+cp = wp.walk_columns(edges, offs, starts, times, 10.0)
+for a, b in zip(cs, cp):
+    np.testing.assert_array_equal(a, b)
+
+# --- reach builder: multithreaded Dijkstra sweep -----------------------
+from reporter_tpu.tiles.native import build_reach_native
+import reporter_tpu.native as rn
+reach_out = []
+for lib in (lib_s, lib_p):
+    rn.lib = lib    # route the helper through each flavor
+    got = build_reach_native(ts.node_out, ts.edge_src, ts.edge_dst,
+                             ts.edge_len, 500.0, 32)
+    assert got is not None
+    reach_out.append(got)
+for a, b in zip(reach_out[0][:3], reach_out[1][:3]):
+    np.testing.assert_array_equal(a, b)   # instrumented == plain
+assert reach_out[0][3] == reach_out[1][3]
+print("SANITIZED-OK", cs.n_records)
+"""
+
+
+def _runtime_path(name: str) -> "str | None":
+    try:
+        out = subprocess.run(["g++", f"-print-file-name={name}"],
+                             capture_output=True, text=True, timeout=30)
+        path = out.stdout.strip()
+        return path if path and os.path.isabs(path) else None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+@pytest.mark.parametrize("flavor,runtime", [
+    ("asan", "libasan.so"), ("tsan", "libtsan.so")])
+def test_sanitized_native_components(flavor, runtime):
+    rt = _runtime_path(runtime)
+    if rt is None:
+        pytest.skip(f"{runtime} not available")
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env.update(
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        JAX_PLATFORMS="cpu",
+        LD_PRELOAD=rt,
+        # leak checking sees the interpreter's own allocations; the point
+        # here is memory errors and data races in OUR code
+        ASAN_OPTIONS="detect_leaks=0",
+        TSAN_OPTIONS="halt_on_error=1")
+    proc = subprocess.run(
+        [sys.executable, "-c", _DRIVER, flavor],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "SANITIZED-OK" in proc.stdout, proc.stderr[-2000:]
+    for marker in ("ERROR: AddressSanitizer", "runtime error:",
+                   "WARNING: ThreadSanitizer"):
+        assert marker not in proc.stderr, proc.stderr[-3000:]
