@@ -25,7 +25,11 @@ COPY README.md DISTRIBUTED.md ./
 
 ENV PYTHONPATH=/app \
     DATASTORE_URL="" \
-    REPORTER_TPU_PORT=8002
+    REPORTER_TPU_PORT=8002 \
+    REPORTER_MODE=auto
 
+# One deployment serves one transport mode (like the reference's per-mode
+# valhalla config): compile the matching tileset with
+#   python -m reporter_tpu.tiles build --osm region.osm.pbf --mode $MODE
 EXPOSE 8002
-CMD ["sh", "-c", "python -m reporter_tpu.service.server --tiles ${TILESET:-/data/tiles.npz} --port ${REPORTER_TPU_PORT}"]
+CMD ["sh", "-c", "python -m reporter_tpu.service.server --tiles ${TILESET:-/data/tiles.npz} --mode ${REPORTER_MODE} --port ${REPORTER_TPU_PORT}"]

@@ -84,6 +84,10 @@ def _cached_tileset(city: str, restricted: bool = False):
         way_words.extend((w.way_id, len(w.nodes), int(w.oneway),
                           w.access_mask, int(w.speed_mps * 100)))
         way_words.extend(w.nodes)
+        for leg in sorted(w.geometry):        # curve shape points count too
+            way_words.append(leg)
+            fp = zlib.crc32(np.ascontiguousarray(
+                w.geometry[leg], np.float64).tobytes(), fp)
     for r in net.restrictions:
         way_words.extend((r.from_way, r.via_node, r.to_way,
                           zlib.crc32(r.kind.encode())))
@@ -481,6 +485,12 @@ def main() -> None:
             detail["decode_only_probes_per_sec"] = round(decode_pps, 1)
             detail["e2e_over_decode"] = round(jax_pps / decode_pps, 3)
             detail["batch_seconds"] = round(dt2, 3)
+        # cross-block ratios must divide the PUBLISHED primary (whichever
+        # window won), or the JSON is internally inconsistent
+        detail["restricted"]["throughput_vs_unrestricted"] = round(
+            r_pps / jax_pps, 3)
+        detail["xl"]["culling"]["decode_slowdown_vs_sf"] = round(
+            decode_pps / x_decode, 1)
         split["primary_window2_s"] = round(time.perf_counter() - t0, 1)
 
     detail["setup_split"] = split

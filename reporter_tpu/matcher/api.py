@@ -404,8 +404,6 @@ class SegmentMatcher:
     def _decode_many(self, traces: Sequence[Trace]):
         """JAX decode for a list of traces → per-trace (edges, offsets,
         chain_starts) numpy triples, bucketed by padded length."""
-        from concurrent.futures import ThreadPoolExecutor
-
         from reporter_tpu.ops.match import unpack_wire
 
         work, inflight = self._submit_many(traces)
@@ -413,8 +411,8 @@ class SegmentMatcher:
 
         # Same overlap trick as the walk path: unpack + per-trace split of
         # slice k runs in a worker thread while slice k+1's wire bytes
-        # stream back over the link (np.asarray releases the GIL).
-        def split_slice(ws, arr):
+        # stream back over the link.
+        def split_slice(_k, ws, arr):
             edges, offs, starts = unpack_wire(arr)
             for r, w in enumerate(ws):
                 i, lo, xy = work[w]
@@ -422,11 +420,7 @@ class SegmentMatcher:
                 per_trace[i].append(
                     (lo, (edges[r, :T], offs[r, :T], starts[r, :T])))
 
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            futs = [pool.submit(split_slice, ws, np.asarray(wire))
-                    for ws, wire in inflight]
-            for f in futs:
-                f.result()
+        _harvest_overlapped(inflight, split_slice)
 
         out: list[Any] = []
         for chunks in per_trace:
@@ -457,8 +451,6 @@ class SegmentMatcher:
             with self.metrics.stage("walk"):
                 return self._walk_decoded(traces, decoded)
 
-        from concurrent.futures import ThreadPoolExecutor
-
         from reporter_tpu.ops.match import unpack_wire
 
         with self.metrics.stage("decode"):
@@ -484,11 +476,7 @@ class SegmentMatcher:
             slice_cols[k] = cols._replace(trace=row_to_trace[cols.trace])
 
         with self.metrics.stage("walk"):
-            with ThreadPoolExecutor(max_workers=1) as pool:
-                futs = [pool.submit(walk_slice, k, ws, np.asarray(wire))
-                        for k, (ws, wire) in enumerate(inflight)]
-                for f in futs:
-                    f.result()
+            _harvest_overlapped(inflight, walk_slice)
         self.metrics.count("unmatched_points", unmatched)
         return MatchBatch(_merge_columns(slice_cols), len(traces))
 
@@ -517,6 +505,22 @@ class SegmentMatcher:
             results.append(build_segments(self.ts, chains, self._route_fn,
                                           self.params.backward_slack))
         return results
+
+
+def _harvest_overlapped(inflight, per_slice) -> None:
+    """Harvest inflight wires in submit order with ONE worker thread:
+    ``np.asarray`` on slice k+1's wire blocks on the LINK with the GIL
+    released (remote-attached chip) while the worker processes slice k —
+    the shared overlap discipline of the walk and decode paths.
+    ``per_slice(k, ws, host_array)`` runs on the worker; exceptions
+    propagate via the futures."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        futs = [pool.submit(per_slice, k, ws, np.asarray(wire))
+                for k, (ws, wire) in enumerate(inflight)]
+        for f in futs:
+            f.result()
 
 
 def _merge_columns(slices: list):

@@ -49,6 +49,11 @@ def main(argv: list[str] | None = None) -> None:
                     help="compiled TileSet .npz path(s); several start the "
                          "multi-metro router (default: synthetic 'sf')")
     ap.add_argument("--config", help="JSON config path")
+    ap.add_argument("--mode", choices=("auto", "bicycle", "foot"),
+                    help="serve this transport mode: applies the mode's "
+                         "matcher preset and tags/validates requests "
+                         "(pair with a tileset compiled via "
+                         "`tiles build --mode ...`)")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int)
     args = ap.parse_args(argv)
@@ -59,6 +64,21 @@ def main(argv: list[str] | None = None) -> None:
 
     enable_compilation_cache()
     config = Config.load(args.config)
+    if args.mode:
+        import dataclasses
+
+        from reporter_tpu.config import MatcherParams
+        # An explicit --config wins on matcher tuning (operators mount
+        # tuned params; clobbering them with the preset would silently
+        # change serving behavior) — --mode then only tags/validates.
+        matcher = (config.matcher if args.config
+                   else MatcherParams.preset(args.mode))
+        if args.config:
+            logging.info("--mode %s: matcher params come from --config; "
+                         "preset not applied", args.mode)
+        config = dataclasses.replace(
+            config, matcher=matcher,
+            service=dataclasses.replace(config.service, mode=args.mode))
     if args.tiles:
         tilesets = [TileSet.load(p) for p in args.tiles]
     else:
