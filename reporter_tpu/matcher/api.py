@@ -17,7 +17,7 @@ batch.
 from __future__ import annotations
 
 from collections.abc import Sequence as _SequenceABC
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Sequence
 
 import numpy as np

@@ -98,18 +98,19 @@ class RoadNetwork:
         mode may drive. Fixed tables per mode beat per-query masking on
         TPU: the sweep scans fewer segments instead of filtering more.
         """
+        import dataclasses
+
         bit = MODE_BITS.get(mode)
         if bit is None:
             raise ValueError(f"unknown mode {mode!r}; "
                              f"one of {sorted(MODE_BITS)}")
         ways = [w for w in self.ways if w.access_mask & bit]
         if mode == "foot":
-            ways = [w if not w.oneway else Way(
-                way_id=w.way_id, nodes=w.nodes, oneway=False, name=w.name,
-                speed_mps=w.speed_mps, geometry=w.geometry,
-                access_mask=w.access_mask) for w in ways]
-        if mode == "foot":
-            restrictions = []    # turn restrictions do not bind pedestrians
+            # pedestrians walk one-way streets both directions, and turn
+            # restrictions do not bind them
+            ways = [w if not w.oneway
+                    else dataclasses.replace(w, oneway=False) for w in ways]
+            restrictions = []
         else:
             keep = {w.way_id for w in ways}
             restrictions = [r for r in self.restrictions
@@ -123,16 +124,11 @@ class RoadNetwork:
                 if nd not in used:
                     used[nd] = len(used)
         if len(used) != self.num_nodes:
-            order = sorted(used, key=used.get)
-            node_lonlat = self.node_lonlat[order]
-            ways = [Way(way_id=w.way_id, nodes=[used[nd] for nd in w.nodes],
-                        oneway=w.oneway, name=w.name, speed_mps=w.speed_mps,
-                        geometry=w.geometry, access_mask=w.access_mask)
-                    for w in ways]
-            restrictions = [TurnRestriction(
-                from_way=r.from_way, via_node=used[r.via_node],
-                to_way=r.to_way, kind=r.kind)
-                for r in restrictions if r.via_node in used]
+            node_lonlat = self.node_lonlat[list(used)]   # insertion order
+            ways = [dataclasses.replace(
+                w, nodes=[used[nd] for nd in w.nodes]) for w in ways]
+            restrictions = [dataclasses.replace(r, via_node=used[r.via_node])
+                            for r in restrictions if r.via_node in used]
         else:
             node_lonlat = self.node_lonlat
         suffix = "" if mode == "auto" else f"-{mode}"
