@@ -193,6 +193,38 @@ class TestAccessMask:
         assert {w.way_id for w in net.for_mode("bicycle").ways} == {10, 11}
 
 
+class TestModeFuzz:
+    def test_random_masks_compile_and_backends_agree(self):
+        """Random per-way access masks on a synthetic city: every mode
+        subgraph that survives must compile, synthesize legal fleets, and
+        keep the two backends in agreement — the mode boundary must not
+        introduce backend drift."""
+        from reporter_tpu.matcher.fidelity import length_weighted_agreement
+        from reporter_tpu.netgen.synthetic import generate_city
+        from reporter_tpu.netgen.traces import synthesize_fleet
+
+        rng = np.random.default_rng(44)
+        net = generate_city("tiny", seed=21)
+        for w in net.ways:
+            # bias toward all-access so subgraphs stay connected
+            w.access_mask = ACCESS_ALL if rng.random() < 0.7 else int(
+                rng.integers(1, 8))
+        for mode in ("auto", "bicycle", "foot"):
+            sub = net.for_mode(mode)
+            if len(sub.ways) < 4:
+                continue
+            ts = compile_network(sub, CompilerParams())
+            fleet = synthesize_fleet(ts, 8, num_points=50, seed=3)
+            traces = [Trace(uuid=p.uuid, xy=p.xy.astype(np.float32),
+                            times=p.times) for p in fleet]
+            cfg_j = Config.for_mode(mode, matcher_backend="jax")
+            cfg_c = Config.for_mode(mode, matcher_backend="reference_cpu")
+            rj = SegmentMatcher(ts, cfg_j).match_many(traces)
+            rc = SegmentMatcher(ts, cfg_c).match_many(traces)
+            agree, total = length_weighted_agreement(rj, rc)
+            assert agree / total >= 0.9, (mode, agree / total)
+
+
 class TestModePlumbing:
     def test_config_for_mode_presets(self):
         cfg = Config.for_mode("foot")
