@@ -231,7 +231,11 @@ def main() -> None:
     split: dict = {}
 
     t0 = time.perf_counter()
-    tpu_ok = _tpu_reachable()
+    # REPORTER_BENCH_FORCE_CPU=1 exercises the tunnel-outage fallback
+    # path on demand (it must emit a well-formed JSON line at round end
+    # even when the device probe fails)
+    forced_cpu = os.environ.get("REPORTER_BENCH_FORCE_CPU") == "1"
+    tpu_ok = not forced_cpu and _tpu_reachable()
     split["device_probe_s"] = round(time.perf_counter() - t0, 1)
     if not tpu_ok:
         # Emit a real (CPU-backend) measurement rather than hanging; the
@@ -362,6 +366,8 @@ def main() -> None:
     detail = {
         "config": f"{n_traces}x{n_points}pt traces, tile={ts.name}",
         "device": (str(jax.devices()[0]).split(":")[0] if tpu_ok
+                   else "CPU (forced by REPORTER_BENCH_FORCE_CPU)"
+                   if forced_cpu
                    else "CPU-FALLBACK (TPU tunnel unreachable)"),
         "decode_only_probes_per_sec": round(decode_pps, 1),
         "e2e_over_decode": round(jax_pps / decode_pps, 3),
