@@ -212,18 +212,75 @@ def _timed_pair(m, traces, repeats: int) -> tuple[float, float]:
 
 def _oracle_audit(ts, jax_matcher, traces, n: int):
     """Fidelity vs the exact-Dijkstra CPU oracle on n traces. Returns
-    (disagreement, cpu_pps, n)."""
+    (disagreement, cpu_pps, n).
+
+    The oracle's output is a PURE function of (tile, traces, params), so
+    its (segment_id, length) pairs — all the fidelity metric reads — are
+    cached on disk keyed by tile + trace content; the oracle pass was
+    ~half the composite bench's wall time. The jax side is always matched
+    fresh, and the CPU throughput anchor is re-measured on a small
+    subsample on cache hits so every published number is a measurement.
+    """
+    import zlib
+
+    import numpy as np
+
     from reporter_tpu.config import Config
     from reporter_tpu.matcher.api import SegmentMatcher
     from reporter_tpu.matcher.fidelity import mean_disagreement
+    from reporter_tpu.matcher.segments import SegmentRecord
 
+    import reporter_tpu.matcher.cpu_reference as _cpu_mod
+    import reporter_tpu.matcher.fidelity as _fid_mod
+    import reporter_tpu.matcher.segments as _seg_mod
+
+    crc = zlib.crc32(ts.edge_len.tobytes())
+    crc = zlib.crc32(ts.ban_from.tobytes(), crc)
+    crc = zlib.crc32(ts.ban_to.tobytes(), crc)
+    # the oracle's CODE and params key the cache too: editing the CPU
+    # matcher (or MatcherParams defaults) must invalidate, or the bench
+    # would publish fidelity vs a stale oracle's output
+    for mod in (_cpu_mod, _seg_mod, _fid_mod):
+        with open(mod.__file__, "rb") as f:
+            crc = zlib.crc32(f.read(), crc)
+    crc = zlib.crc32(repr(Config().matcher).encode(), crc)
+    for t in traces[:n]:
+        crc = zlib.crc32(np.ascontiguousarray(t.xy).tobytes(), crc)
+    path = _repo_path(f".bench_oracle_{ts.name}_{n}_"
+                      f"{crc & 0xFFFFFFFF:08x}.npz")
     cpu = SegmentMatcher(ts, Config(matcher_backend="reference_cpu"))
-    t0 = time.perf_counter()
-    rc = cpu.match_many(traces[:n])
-    dt_cpu = time.perf_counter() - t0
+    rc = None
+    if os.path.exists(path):
+        try:
+            with np.load(path) as z:
+                seg, length, bounds = z["seg"], z["length"], z["bounds"]
+            rc = [[SegmentRecord(int(s), [], -1.0, -1.0, float(ln), s < 0)
+                   for s, ln in zip(seg[a:b], length[a:b])]
+                  for a, b in zip(bounds[:-1], bounds[1:])]
+            # fresh throughput anchor on a subsample (the cached records
+            # settle fidelity; throughput must be measured, not replayed);
+            # untimed warm-up first so lazy init stays out of the window
+            n_sub = min(16, n)
+            cpu.match_many(traces[:1])
+            t0 = time.perf_counter()
+            cpu.match_many(traces[:n_sub])
+            cpu_pps = (sum(len(t.xy) for t in traces[:n_sub])
+                       / (time.perf_counter() - t0))
+        except Exception:
+            rc = None               # stale/corrupt cache: recompute
+    if rc is None:
+        t0 = time.perf_counter()
+        rc = cpu.match_many(traces[:n])
+        cpu_pps = (sum(len(t.xy) for t in traces[:n])
+                   / (time.perf_counter() - t0))
+        bounds = np.cumsum([0] + [len(r) for r in rc])
+        np.savez(path,
+                 seg=np.asarray([x.segment_id for r in rc for x in r],
+                                np.int64),
+                 length=np.asarray([x.length for r in rc for x in r]),
+                 bounds=bounds.astype(np.int64))
     rj = jax_matcher.match_many(traces[:n])
-    probes = sum(len(t.xy) for t in traces[:n])
-    return mean_disagreement(rj, rc), probes / dt_cpu, n
+    return mean_disagreement(rj, rc), cpu_pps, n
 
 
 def main() -> None:

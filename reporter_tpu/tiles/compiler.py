@@ -280,7 +280,16 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None,
     boundary, SURVEY.md §2.1): candidate tables, reach routing, and OSMLR
     chains are then all consistent with what the mode may travel. None
     keeps the network as-is (synthetic cities default to all-access ways,
-    so None and "auto" compile identically there)."""
+    so None and "auto" compile identically there).
+
+    Caveat: OSMLR chains are computed on the mode's SUBGRAPH, so where
+    mode filtering changes a junction's degree (e.g. a footpath crossing
+    leaves the auto view, turning a degree-3 node into degree-2), chain
+    boundaries — and therefore segment ids — can differ between modes
+    for the same road. Within one mode the ids are stable, and reports
+    carry the mode tag, so per-mode datastores stay consistent; joining
+    segment statistics ACROSS modes requires chaining on the full graph
+    (future work — the reference associates OSMLR once for all modes)."""
     params = params or CompilerParams()
     if mode is not None:
         net = net.for_mode(mode)
