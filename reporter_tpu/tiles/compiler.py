@@ -186,6 +186,59 @@ def _chain_osmlr(net: RoadNetwork, edge_len: np.ndarray,
             np.asarray(osmlr_ids, np.int64), np.asarray(osmlr_lens, np.float32))
 
 
+def _full_graph_osmlr(full_net: RoadNetwork, sub_net: RoadNetwork,
+                      sub_E: int, sub_fwd, sub_rev, max_len: float):
+    """OSMLR association computed on the FULL (all-mode) network, mapped
+    onto a mode subgraph's edges.
+
+    The reference associates OSMLR segments ONCE for all modes (osmlr +
+    valhalla_associate_segments run on the full graph; SURVEY.md §2.2), so
+    a road's segment id is identical whether a car or a bike report names
+    it. Chaining on the subgraph instead would move chain boundaries
+    wherever mode filtering changes a junction's degree. Mapping key is
+    (way_id, leg, direction) — leg structure is mode-invariant
+    (RoadNetwork.for_mode never re-splits ways). Direction-less edges the
+    full graph lacks (a pedestrian walking a one-way street backwards)
+    stay internal (-1): directional OSMLR refs have no counter-flow id in
+    the reference either.
+    """
+    cached = getattr(full_net, "_osmlr_assoc", None)
+    if cached is not None and cached[0] == max_len:
+        f_osmlr, f_off, ids, lens, by_key = cached[1]
+    else:
+        origin = full_net.origin()
+        node_xy = lonlat_to_xy(full_net.node_lonlat,
+                               origin).astype(np.float32)
+        (fsrc, fdst, _fway, _fspeed, fshapes, fopp,
+         f_fwd, f_rev) = _build_edges(full_net, node_xy, origin)
+        # polyline lengths directly — the full segment decompose would
+        # build and discard the whole kNN index just for this column
+        f_edge_len = np.asarray(
+            [float(np.linalg.norm(np.diff(p, axis=0), axis=1).sum())
+             for p in fshapes], np.float32)
+        f_osmlr, f_off, ids, lens = _chain_osmlr(
+            full_net, f_edge_len, fsrc, fdst, fopp, f_fwd, f_rev, max_len)
+        by_key = {}
+        for (wi, leg), e in f_fwd.items():
+            by_key[(full_net.ways[wi].way_id, leg, 0)] = e
+        for (wi, leg), e in f_rev.items():
+            by_key[(full_net.ways[wi].way_id, leg, 1)] = e
+        # one association per full net serves every mode compile
+        full_net._osmlr_assoc = (
+            max_len, (f_osmlr, f_off, ids, lens, by_key))
+
+    edge_osmlr = np.full(sub_E, -1, dtype=np.int32)
+    edge_osmlr_off = np.zeros(sub_E, dtype=np.float32)
+    for legs, d in ((sub_fwd, 0), (sub_rev, 1)):
+        for (wi, leg), e in legs.items():
+            fe = by_key.get((sub_net.ways[wi].way_id, leg, d))
+            if fe is not None:   # None: e.g. a pedestrian's counter-flow
+                #                  edge on a one-way — no directional ref
+                edge_osmlr[e] = f_osmlr[fe]
+                edge_osmlr_off[e] = f_off[fe]
+    return edge_osmlr, edge_osmlr_off, ids, lens
+
+
 def _decompose_segments(shapes: list[np.ndarray]):
     """Edge polylines → flat line-segment arrays (the kNN index unit)."""
     seg_a, seg_b, seg_edge, seg_off = [], [], [], []
@@ -282,22 +335,25 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None,
     keeps the network as-is (synthetic cities default to all-access ways,
     so None and "auto" compile identically there).
 
-    Caveat: OSMLR chains are computed on the mode's SUBGRAPH, so where
-    mode filtering changes a junction's degree (e.g. a footpath crossing
-    leaves the auto view, turning a degree-3 node into degree-2), chain
-    boundaries — and therefore segment ids — can differ between modes
-    for the same road. Within one mode the ids are stable, and reports
-    carry the mode tag, so per-mode datastores stay consistent; joining
-    segment statistics ACROSS modes requires chaining on the full graph
-    (future work — the reference associates OSMLR once for all modes)."""
+    OSMLR association for mode tilesets is computed on the FULL (all
+    modes) network and mapped onto the subgraph (_full_graph_osmlr), so a
+    road's segment id is identical across modes — the reference runs
+    osmlr + valhalla_associate_segments once for all modes, and
+    cross-mode segment joins in the datastore depend on it."""
     params = params or CompilerParams()
+    full_net = net
     if mode is not None:
         net = net.for_mode(mode)
     if net.num_nodes == 0 or not net.ways:
         raise ValueError(
             f"RoadNetwork {net.name!r} has no drivable ways/nodes; nothing to compile")
     t0 = time.time()
-    origin = net.origin()
+    # Mode compiles project with the FULL net's origin: the mapped OSMLR
+    # offsets/lengths are measured in that frame, and the walker compares
+    # them against subgraph edge lengths with 1 m absolute tolerances —
+    # two equirectangular frames (cos-lat scaling) would drift past that
+    # on metro-scale bbox shifts.
+    origin = (full_net if mode is not None else net).origin()
     node_xy = lonlat_to_xy(net.node_lonlat, origin).astype(np.float32)
 
     (edge_src, edge_dst, edge_way, edge_speed,
@@ -305,9 +361,16 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None,
 
     seg_a, seg_b, seg_edge, seg_off, seg_len, edge_len = _decompose_segments(shapes)
 
-    edge_osmlr, edge_osmlr_off, osmlr_id, osmlr_len = _chain_osmlr(
-        net, edge_len, edge_src, edge_dst, edge_opp, fwd_of_leg, rev_of_leg,
-        params.osmlr_max_length)
+    if mode is not None:
+        # mode tilesets share ONE full-graph OSMLR association, so a
+        # road's segment id is identical across modes (_full_graph_osmlr)
+        edge_osmlr, edge_osmlr_off, osmlr_id, osmlr_len = _full_graph_osmlr(
+            full_net, net, len(edge_len), fwd_of_leg, rev_of_leg,
+            params.osmlr_max_length)
+    else:
+        edge_osmlr, edge_osmlr_off, osmlr_id, osmlr_len = _chain_osmlr(
+            net, edge_len, edge_src, edge_dst, edge_opp, fwd_of_leg,
+            rev_of_leg, params.osmlr_max_length)
 
     grid, grid_dims, grid_origin, overflow = _build_grid(
         seg_a, seg_b, params.cell_size, params.cell_capacity,

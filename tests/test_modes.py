@@ -97,6 +97,33 @@ class TestModeFixture:
         total = sum(r.length for r in recs)
         assert total > 600.0
 
+    def test_segment_ids_shared_across_modes(self, mode_tiles):
+        """Full-graph association (reference parity: osmlr +
+        valhalla_associate_segments run ONCE for all modes): a road
+        present in several mode tilesets carries the same segment ids,
+        and the id/length tables are the shared full-graph tables."""
+        a, b = mode_tiles["auto"], mode_tiles["bicycle"]
+        np.testing.assert_array_equal(a.osmlr_id, b.osmlr_id)
+        np.testing.assert_array_equal(a.osmlr_len, b.osmlr_len)
+
+        def ids_by_way(ts):
+            out: dict = {}
+            for e in range(ts.num_edges):
+                r = int(ts.edge_osmlr[e])
+                if r >= 0:
+                    out.setdefault(int(ts.edge_way[e]),
+                                   set()).add(int(ts.osmlr_id[r]))
+            return out
+
+        ia, ib = ids_by_way(a), ids_by_way(b)
+        shared = set(ia) & set(ib)
+        assert shared                       # the street ring is in both
+        for w in shared:
+            assert ia[w] == ib[w], (w, ia[w], ib[w])
+        # the cycleway's segments exist in the shared table but have no
+        # member edges in the auto tileset
+        assert CYCLEWAY_ID in ib and CYCLEWAY_ID not in ia
+
     def test_mode_subgraph_shapes(self, mode_tiles):
         a, b = mode_tiles["auto"], mode_tiles["bicycle"]
         assert a.stats["mode"] == "auto"
