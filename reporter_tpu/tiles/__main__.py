@@ -74,10 +74,14 @@ def main(argv: list[str] | None = None) -> int:
     i.add_argument("path")
 
     g = sub.add_parser("osmlr",
-                       help="export OSMLR segment definitions as GeoJSON")
+                       help="export OSMLR segment definitions (GeoJSON, "
+                            "or the compact binary tile with --binary)")
     g.add_argument("path", help="compiled tileset .npz")
     g.add_argument("-o", "--output", required=True,
-                   help="output .geojson path")
+                   help="output .geojson (or .osmlr with --binary) path")
+    g.add_argument("--binary", action="store_true",
+                   help="write the protobuf-wire binary segment tile "
+                        "(tiles/osmlr_tiles.py) instead of GeoJSON")
 
     c = sub.add_parser("convert", help="convert an OSM XML extract to PBF")
     c.add_argument("xml", help="input .osm/.xml file")
@@ -88,10 +92,17 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     if args.cmd == "osmlr":
-        from reporter_tpu.tiles.osmlr_export import export_osmlr_geojson
         from reporter_tpu.tiles.tileset import TileSet
 
-        n = export_osmlr_geojson(TileSet.load(args.path), args.output)
+        ts = TileSet.load(args.path)
+        if args.binary:
+            from reporter_tpu.tiles.osmlr_tiles import write_osmlr_tile
+
+            n = write_osmlr_tile(ts, args.output)
+        else:
+            from reporter_tpu.tiles.osmlr_export import export_osmlr_geojson
+
+            n = export_osmlr_geojson(ts, args.output)
         print(json.dumps({"written": args.output, "segments": n}))
         return 0
 

@@ -1,0 +1,78 @@
+"""Binary OSMLR segment tiles (tiles/osmlr_tiles.py): exact round trips
+against the GeoJSON export's view of the same segments."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from reporter_tpu.tiles.osmlr_export import osmlr_features
+from reporter_tpu.tiles.osmlr_tiles import (_COORD_SCALE, read_osmlr_tile,
+                                            write_osmlr_tile)
+
+
+class TestRoundTrip:
+    def test_segments_survive_exactly(self, tiny_tiles, tmp_path):
+        path = str(tmp_path / "tiny.osmlr")
+        n = write_osmlr_tile(tiny_tiles, path)
+        feats = osmlr_features(tiny_tiles)
+        assert n == len(feats) > 0
+
+        tile = read_osmlr_tile(path)
+        assert tile["name"] == tiny_tiles.name
+        assert len(tile["segments"]) == n
+        for seg, feat in zip(tile["segments"], feats):
+            props = feat["properties"]
+            assert seg["id"] == feat["id"]
+            assert abs(seg["length_m"] - props["length_m"]) <= 0.005
+            assert seg["way_ids"] == props["way_ids"]
+            got = np.asarray(seg["coordinates"])
+            want = np.asarray(feat["geometry"]["coordinates"])
+            assert got.shape == want.shape
+            # fixed point at 1e-7 deg: exact to ~1 cm
+            np.testing.assert_allclose(got, want,
+                                       atol=1.5 / _COORD_SCALE, rtol=0)
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = tmp_path / "junk.osmlr"
+        p.write_bytes(b"NOTATILE" + b"\x00" * 16)
+        with pytest.raises(ValueError, match="magic"):
+            read_osmlr_tile(str(p))
+
+    def test_truncated_tile_rejected(self, tiny_tiles, tmp_path):
+        path = str(tmp_path / "t.osmlr")
+        write_osmlr_tile(tiny_tiles, path)
+        blob = open(path, "rb").read()
+        cut = tmp_path / "cut.osmlr"
+        cut.write_bytes(blob[:len(blob) // 2])
+        with pytest.raises(ValueError, match="truncated"):
+            read_osmlr_tile(str(cut))
+
+    def test_compactness(self, tiny_tiles, tmp_path):
+        """Delta-coded fixed point must beat the GeoJSON text form by a
+        wide margin — the format exists to be shipped."""
+        from reporter_tpu.tiles.osmlr_export import export_osmlr_geojson
+
+        bin_path = str(tmp_path / "t.osmlr")
+        gj_path = str(tmp_path / "t.geojson")
+        write_osmlr_tile(tiny_tiles, bin_path)
+        export_osmlr_geojson(tiny_tiles, gj_path)
+        import os
+
+        assert os.path.getsize(bin_path) < os.path.getsize(gj_path) / 4
+
+
+def test_cli_binary_export(tiny_tiles, tmp_path):
+    ts_path = str(tmp_path / "t.npz")
+    tiny_tiles.save(ts_path)
+    out = str(tmp_path / "t.osmlr")
+    proc = subprocess.run(
+        [sys.executable, "-m", "reporter_tpu.tiles", "osmlr", ts_path,
+         "-o", out, "--binary"],
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    info = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert info["segments"] > 0
+    assert read_osmlr_tile(out)["name"] == tiny_tiles.name
