@@ -62,36 +62,18 @@ def _cached_tileset(city: str, restricted: bool = False):
     from reporter_tpu.tiles.compiler import compile_network
     from reporter_tpu.tiles.tileset import TileSet
 
-    import zlib
-
-    import numpy as np
-
     key = f"{city}_r{int(_RESTRICT_FRACTION * 100)}" if restricted else city
     t0 = time.perf_counter()
     # Generating the RoadNetwork is cheap (~1 s even for bayarea-xl); the
     # compile + reach build is what the cache buys. Fingerprinting the
-    # generated net keys the cache by CONTENT, so generator changes can
-    # never serve a stale tileset.
+    # generated net (topology + attributes + restrictions, the shared
+    # RoadNetwork.fingerprint) keys the cache by CONTENT, so generator
+    # changes can never serve a stale tileset.
     net = generate_city(city)
     if restricted:
         net = add_random_restrictions(net, fraction=_RESTRICT_FRACTION,
                                       seed=_RESTRICT_SEED)
-    fp = zlib.crc32(net.node_lonlat.tobytes())
-    # topology + attributes, not just counts: a generator change that
-    # moves no node but rewires ways/oneways/restrictions must miss
-    way_words = []
-    for w in net.ways:
-        way_words.extend((w.way_id, len(w.nodes), int(w.oneway),
-                          w.access_mask, int(w.speed_mps * 100)))
-        way_words.extend(w.nodes)
-        for leg in sorted(w.geometry):        # curve shape points count too
-            way_words.append(leg)
-            fp = zlib.crc32(np.ascontiguousarray(
-                w.geometry[leg], np.float64).tobytes(), fp)
-    for r in net.restrictions:
-        way_words.extend((r.from_way, r.via_node, r.to_way,
-                          zlib.crc32(r.kind.encode())))
-    fp = zlib.crc32(np.asarray(way_words, np.int64).tobytes(), fp)
+    fp = net.fingerprint()
     path = _repo_path(f".bench_tiles_{key}_v4_{fp & 0xFFFFFFFF:08x}.npz")
     if os.path.exists(path):
         try:

@@ -218,6 +218,10 @@ class SegmentMatcher:
 
     def matched_points(self, trace: Trace) -> list[MatchedPoint]:
         """Per-point decode (no segment association) — test/diagnostic hook."""
+        if self.backend != "jax":
+            raise NotImplementedError(
+                "matched_points decodes through the device path; "
+                "construct the matcher with matcher_backend='jax'")
         trip = self._decode_many([trace])[0]
         return [MatchedPoint(int(e), float(o), bool(s))
                 for e, o, s in zip(*trip)]

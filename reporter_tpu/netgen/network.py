@@ -71,6 +71,9 @@ class RoadNetwork:
     ways: list[Way]
     name: str = "net"
     restrictions: list[TurnRestriction] = field(default_factory=list)
+    # set by for_mode: marks this network as one mode's subgraph, so
+    # compile_network knows an unqualified compile of it is deliberate
+    mode: "str | None" = None
 
     @property
     def num_nodes(self) -> int:
@@ -84,6 +87,29 @@ class RoadNetwork:
     def origin(self) -> np.ndarray:
         lo, hi = self.bbox()
         return (lo + hi) / 2.0
+
+    def fingerprint(self) -> int:
+        """Content crc of the graph (node positions, way topology and
+        attributes, per-leg geometry, restrictions) — the shared key for
+        content-addressed caches (the compiler's full-graph OSMLR memo,
+        bench tile/fleet caches). A generator or mutation that changes
+        anything the compiler reads must change this value."""
+        import zlib
+
+        crc = zlib.crc32(np.ascontiguousarray(self.node_lonlat).tobytes())
+        words: list[int] = []
+        for w in self.ways:
+            words.extend((w.way_id, len(w.nodes), int(w.oneway),
+                          w.access_mask, int(w.speed_mps * 100)))
+            words.extend(w.nodes)
+            for leg in sorted(w.geometry):
+                words.append(leg)
+                crc = zlib.crc32(np.ascontiguousarray(
+                    w.geometry[leg], np.float64).tobytes(), crc)
+        for r in self.restrictions:
+            words.extend((r.from_way, r.via_node, r.to_way,
+                          zlib.crc32(r.kind.encode())))
+        return zlib.crc32(np.asarray(words, np.int64).tobytes(), crc)
 
     def for_mode(self, mode: str) -> "RoadNetwork":
         """The mode's legal subgraph: ways whose access_mask includes
@@ -134,4 +160,4 @@ class RoadNetwork:
         suffix = "" if mode == "auto" else f"-{mode}"
         return RoadNetwork(node_lonlat=node_lonlat, ways=ways,
                            name=f"{self.name}{suffix}",
-                           restrictions=restrictions)
+                           restrictions=restrictions, mode=mode)

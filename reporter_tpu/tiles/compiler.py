@@ -21,7 +21,7 @@ import numpy as np
 
 from reporter_tpu.config import CompilerParams
 from reporter_tpu.geometry import lonlat_to_xy
-from reporter_tpu.netgen.network import RoadNetwork
+from reporter_tpu.netgen.network import ACCESS_AUTO, RoadNetwork
 from reporter_tpu.tiles.tileset import TileMeta, TileSet
 
 
@@ -202,8 +202,12 @@ def _full_graph_osmlr(full_net: RoadNetwork, sub_net: RoadNetwork,
     stay internal (-1): directional OSMLR refs have no counter-flow id in
     the reference either.
     """
+    # Memo key = content fingerprint, not identity: callers mutate nets in
+    # place between compiles (add_random_restrictions, test fixtures), and
+    # an identity-keyed memo would silently serve a stale association.
+    fp = (max_len, full_net.fingerprint())
     cached = getattr(full_net, "_osmlr_assoc", None)
-    if cached is not None and cached[0] == max_len:
+    if cached is not None and cached[0] == fp:
         f_osmlr, f_off, ids, lens, by_key = cached[1]
     else:
         origin = full_net.origin()
@@ -223,9 +227,9 @@ def _full_graph_osmlr(full_net: RoadNetwork, sub_net: RoadNetwork,
             by_key[(full_net.ways[wi].way_id, leg, 0)] = e
         for (wi, leg), e in f_rev.items():
             by_key[(full_net.ways[wi].way_id, leg, 1)] = e
-        # one association per full net serves every mode compile
+        # one association per full net content serves every mode compile
         full_net._osmlr_assoc = (
-            max_len, (f_osmlr, f_off, ids, lens, by_key))
+            fp, (f_osmlr, f_off, ids, lens, by_key))
 
     edge_osmlr = np.full(sub_E, -1, dtype=np.int32)
     edge_osmlr_off = np.zeros(sub_E, dtype=np.float32)
@@ -332,8 +336,17 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None,
     mode's legal subgraph (RoadNetwork.for_mode — the per-mode costing
     boundary, SURVEY.md §2.1): candidate tables, reach routing, and OSMLR
     chains are then all consistent with what the mode may travel. None
-    keeps the network as-is (synthetic cities default to all-access ways,
-    so None and "auto" compile identically there).
+    keeps the network as-is when every way is drivable (synthetic cities
+    default to all-access ways, so None and "auto" compile identically
+    there) — but a MIXED network compiled with mode=None falls back to
+    the auto subgraph, with a warning: the legacy unqualified API means
+    "the drivable graph", and must not let cars match onto footpaths.
+    Networks already filtered by for_mode (net.mode set), and networks
+    with no drivable ways at all, always compile as-is — but note an
+    as-is compile of a pre-filtered subgraph chains OSMLR on the SUBGRAPH
+    (ids are subgraph-local): deployments that join segments across modes
+    must compile via compile_network(full_net, mode=...) so every mode
+    shares the full-graph association below.
 
     OSMLR association for mode tilesets is computed on the FULL (all
     modes) network and mapped onto the subgraph (_full_graph_osmlr), so a
@@ -342,6 +355,24 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None,
     cross-mode segment joins in the datastore depend on it."""
     params = params or CompilerParams()
     full_net = net
+    if (mode is None and net.mode is None
+            and any(not (w.access_mask & ACCESS_AUTO) for w in net.ways)
+            and any(w.access_mask & ACCESS_AUTO for w in net.ways)):
+        # (a net with NO drivable ways at all compiles as-is: the caller
+        # built a non-auto graph on purpose, and an auto subgraph of it
+        # would be empty)
+        # Legacy drivable-only semantics: the parsers keep bike/foot-only
+        # ways in the RoadNetwork (access bits) since the per-mode split,
+        # so an unqualified compile of a mixed network must not let cars
+        # match onto footpaths. Routing through the auto subgraph also
+        # keeps name-keyed artifacts unambiguous: one name, one content.
+        import warnings
+
+        warnings.warn(
+            f"network {net.name!r} contains non-drivable ways; "
+            "compiling the auto subgraph (pass mode=... to silence)",
+            stacklevel=2)
+        mode = "auto"
     if mode is not None:
         net = net.for_mode(mode)
     if net.num_nodes == 0 or not net.ways:

@@ -252,6 +252,49 @@ class TestModeFuzz:
             assert agree / total >= 0.9, (mode, agree / total)
 
 
+class TestLegacyCompileSemantics:
+    """ADVICE r3: compile_network(net) with mode=None must keep its
+    historical drivable-only meaning on mixed-access networks."""
+
+    def test_mixed_net_defaults_to_auto_subgraph(self, mode_tiles):
+        with pytest.warns(UserWarning, match="non-drivable"):
+            ts = compile_network(_mode_city(), CompilerParams())
+        # identical graph to the explicit auto compile: no cycleway edges
+        assert ts.num_edges == mode_tiles["auto"].num_edges
+        assert set(np.asarray(ts.edge_way)) == {1, 2, 3, 4}
+
+    def test_prefiltered_subgraph_compiles_as_is(self):
+        sub = _mode_city().for_mode("bicycle")
+        ts = compile_network(sub, CompilerParams())   # no warning, no filter
+        assert CYCLEWAY_ID in set(np.asarray(ts.edge_way))
+
+    def test_all_nonauto_net_compiles_as_is(self):
+        # a hand-built foot-only net is deliberate: no fallback (whose
+        # auto subgraph would be empty), no warning, all ways compiled
+        net = _mode_city()
+        for w in net.ways:
+            w.access_mask = ACCESS_FOOT
+        ts = compile_network(net, CompilerParams())
+        assert CYCLEWAY_ID in set(np.asarray(ts.edge_way))
+
+    def test_pure_auto_net_unchanged(self):
+        net = _mode_city()
+        net.ways = [w for w in net.ways if w.access_mask & ACCESS_AUTO]
+        ts = compile_network(net, CompilerParams())   # silent legacy path
+        assert "mode" not in ts.stats
+
+    def test_osmlr_memo_invalidates_on_mutation(self):
+        net = _mode_city()
+        a1 = compile_network(net, CompilerParams(), mode="auto")
+        # mutate the net in place the way callers do, then recompile: the
+        # full-graph association memo must miss (content-fingerprint key)
+        net.ways.append(Way(way_id=7, nodes=[1, 4], name="new-cut"))
+        a2 = compile_network(net, CompilerParams(), mode="auto")
+        assert a2.num_edges == a1.num_edges + 2
+        assert (np.asarray(a2.edge_osmlr)[np.asarray(a2.edge_way) == 7]
+                >= 0).all()
+
+
 class TestModePlumbing:
     def test_config_for_mode_presets(self):
         cfg = Config.for_mode("foot")
