@@ -192,9 +192,13 @@ def _timed_pair(m, traces, repeats: int) -> tuple[float, float]:
     return dt, dt_dec
 
 
-def _oracle_audit(ts, jax_matcher, traces, n: int):
+def _oracle_audit(ts, jax_matcher, traces, n: int, config=None):
     """Fidelity vs the exact-Dijkstra CPU oracle on n traces. Returns
-    (disagreement, cpu_pps, n).
+    (disagreement, cpu_pps, n, source) — source is "cache" when the
+    oracle records were replayed from disk, "fresh" when recomputed
+    (VERDICT r3 weak #3: fidelity provenance must be visible in the
+    capture). ``config`` carries mode presets (bicycle audit); the
+    matcher params are part of the cache key either way.
 
     The oracle's output is a PURE function of (tile, traces, params), so
     its (segment_id, length) pairs — all the fidelity metric reads — are
@@ -216,21 +220,25 @@ def _oracle_audit(ts, jax_matcher, traces, n: int):
     import reporter_tpu.matcher.fidelity as _fid_mod
     import reporter_tpu.matcher.segments as _seg_mod
 
+    import dataclasses
+
+    cfg = config or Config()
     crc = zlib.crc32(ts.edge_len.tobytes())
     crc = zlib.crc32(ts.ban_from.tobytes(), crc)
     crc = zlib.crc32(ts.ban_to.tobytes(), crc)
     # the oracle's CODE and params key the cache too: editing the CPU
-    # matcher (or MatcherParams defaults) must invalidate, or the bench
-    # would publish fidelity vs a stale oracle's output
+    # matcher (or MatcherParams defaults/presets) must invalidate, or the
+    # bench would publish fidelity vs a stale oracle's output
     for mod in (_cpu_mod, _seg_mod, _fid_mod):
         with open(mod.__file__, "rb") as f:
             crc = zlib.crc32(f.read(), crc)
-    crc = zlib.crc32(repr(Config().matcher).encode(), crc)
+    crc = zlib.crc32(repr(cfg.matcher).encode(), crc)
     for t in traces[:n]:
         crc = zlib.crc32(np.ascontiguousarray(t.xy).tobytes(), crc)
     path = _repo_path(f".bench_oracle_{ts.name}_{n}_"
                       f"{crc & 0xFFFFFFFF:08x}.npz")
-    cpu = SegmentMatcher(ts, Config(matcher_backend="reference_cpu"))
+    cpu = SegmentMatcher(ts, dataclasses.replace(
+        cfg, matcher_backend="reference_cpu"))
     rc = None
     if os.path.exists(path):
         try:
@@ -250,7 +258,9 @@ def _oracle_audit(ts, jax_matcher, traces, n: int):
                        / (time.perf_counter() - t0))
         except Exception:
             rc = None               # stale/corrupt cache: recompute
+    source = "cache"
     if rc is None:
+        source = "fresh"
         t0 = time.perf_counter()
         rc = cpu.match_many(traces[:n])
         cpu_pps = (sum(len(t.xy) for t in traces[:n])
@@ -262,7 +272,198 @@ def _oracle_audit(ts, jax_matcher, traces, n: int):
                  length=np.asarray([x.length for r in rc for x in r]),
                  bounds=bounds.astype(np.int64))
     rj = jax_matcher.match_many(traces[:n])
-    return mean_disagreement(rj, rc), cpu_pps, n
+    return mean_disagreement(rj, rc), cpu_pps, n, source
+
+
+def _reach_audit_cached(ts, traces_xy, label: str) -> dict:
+    """Reach-table miss-rate audit (tiles/reach_audit) with a disk cache:
+    the audit is a pure function of (tile, traces, params, audit code) and
+    costs ~16 s/trace at xl scale on this one-core host. Summary dict
+    gains a ``source`` field (cache|fresh) like the oracle's."""
+    import json as _json
+    import zlib
+
+    import numpy as np
+
+    import reporter_tpu.tiles.reach_audit as _ra_mod
+    from reporter_tpu.config import MatcherParams
+    from reporter_tpu.tiles.reach_audit import audit_reach
+
+    crc = zlib.crc32(ts.edge_len.tobytes())
+    crc = zlib.crc32(ts.reach_dist.tobytes(), crc)
+    with open(_ra_mod.__file__, "rb") as f:
+        crc = zlib.crc32(f.read(), crc)
+    crc = zlib.crc32(repr(MatcherParams()).encode(), crc)
+    for xy in traces_xy:
+        crc = zlib.crc32(np.ascontiguousarray(xy).tobytes(), crc)
+    path = _repo_path(f".bench_reach_{label}_{len(traces_xy)}_"
+                      f"{crc & 0xFFFFFFFF:08x}.json")
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                return {**_json.load(f), "source": "cache"}
+        except Exception:
+            pass
+    summary = audit_reach(ts, traces_xy).summary()
+    with open(path, "w") as f:
+        _json.dump(summary, f)
+    return {**summary, "source": "fresh"}
+
+
+def _streaming_bench(ts, traces, n_stream: int) -> dict:
+    """BASELINE config 5: sustained probes/s through the full streaming
+    worker (ingest queue poll → per-uuid buffers → device match → report
+    build → histogram update → delta flush). The producer side (payload
+    dicts, queue appends) is pre-staged untimed — the pipeline's consume/
+    flush/commit loop is the measured system, as it would be with an
+    external broker feeding it."""
+    import numpy as np
+
+    from reporter_tpu.config import Config, StreamingConfig
+    from reporter_tpu.geometry import xy_to_lonlat
+    from reporter_tpu.streaming.pipeline import StreamPipeline
+    from reporter_tpu.streaming.queue import IngestQueue
+
+    sub = traces[:n_stream]
+    queue = IngestQueue(4)
+    # firehose interleaving: every vehicle's point k before any point k+1
+    # (the shape a real broker delivers a city's probes in). 40-point
+    # flush waves keep the matcher fed with mid-size chunks instead of
+    # re-running the batch bench.
+    cfg = Config(matcher_backend="jax",
+                 streaming=StreamingConfig(flush_min_points=40,
+                                           poll_max_records=65536,
+                                           hist_flush_interval=0.0))
+    origin = np.asarray(ts.meta.origin_lonlat)
+    n_pts = len(sub[0].xy)
+    lonlat = [xy_to_lonlat(np.asarray(t.xy, np.float64), origin)
+              for t in sub]
+    for k in range(n_pts):
+        for i, t in enumerate(sub):
+            queue.append({"uuid": t.uuid, "lat": float(lonlat[i][k, 1]),
+                          "lon": float(lonlat[i][k, 0]),
+                          "time": float(t.times[k])})
+    pipe = StreamPipeline(ts, cfg, queue=queue)
+    t0 = time.perf_counter()
+    reports = 0
+    while queue.lag(pipe.committed) > 0:
+        reports += pipe.step()
+    reports += pipe.drain()
+    flush_t0 = time.perf_counter()
+    flushed = pipe.flush_histograms()
+    dt_flush = time.perf_counter() - flush_t0
+    dt = time.perf_counter() - t0
+    probes = n_stream * n_pts
+    return {
+        "config": f"{n_stream} vehicles x {n_pts}pt firehose, tile={ts.name}",
+        "probes_per_sec": round(probes / dt, 1),
+        "reports": int(reports),
+        "steps": pipe.steps,
+        "hist_segments_flushed": int(flushed),
+        "hist_flush_ms": round(dt_flush * 1e3, 2),
+        "hist_rows_nonzero": int(len(pipe.hist.nonzero_rows())),
+        "seconds": round(dt, 3),
+    }
+
+
+def _device_compute_probe(m, traces, link_rtt: float) -> dict:
+    """Device-only decode rate (VERDICT r3 #6): stage one full uniform
+    slice's quantized inputs on the device, dispatch the match kernel K
+    times back-to-back, sync ONCE via a host readback (the only real sync
+    on the remote-attached link — see CLAUDE.md). The window then holds
+    K dispatch+computes plus one readback, so
+        device_s_per_dispatch ≈ (elapsed - link_rtt) / K.
+    Also times host-side submit of the full batch (async dispatches, no
+    harvest): co-located throughput is bounded by the slower of the two
+    pipeline legs — that bound is the published projection."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from reporter_tpu.matcher.api import _bucket_len
+    from reporter_tpu.ops.match import OFFSET_QUANTUM, match_batch_wire_q
+
+    K = 24
+    B = max(1, m.params.max_device_batch)
+    sub = [t for t in traces if len(t.xy) == len(traces[0].xy)][:B]
+    T = len(sub[0].xy)
+    b = _bucket_len(T)
+    pts = np.zeros((len(sub), b, 2), np.float32)
+    pts[:, :T] = np.stack([t.xy for t in sub])
+    pts[:, T:] = pts[:, :1]
+    lens = np.full(len(sub), T, np.int32)
+    origins = pts[:, 0, :].copy()
+    dq = np.round((pts - origins[:, None, :]) * np.float32(1 / OFFSET_QUANTUM))
+    args = (jax.device_put(dq.astype(np.int16)), jax.device_put(origins),
+            jax.device_put(lens))
+    np.asarray(args[0][0, 0])                   # sync the uploads
+    wire = match_batch_wire_q(*args, m._tables, m.ts.meta, m.params, None)
+    np.asarray(wire)                            # warm executable + readback
+    t0 = time.perf_counter()
+    for _ in range(K):
+        wire = match_batch_wire_q(*args, m._tables, m.ts.meta,
+                                  m.params, None)
+    np.asarray(wire)
+    per_dispatch = max((time.perf_counter() - t0 - link_rtt) / K, 1e-6)
+
+    t0 = time.perf_counter()
+    work, inflight = m._submit_many(traces)
+    dt_submit = time.perf_counter() - t0        # host leg, dispatches async
+    np.asarray(inflight[-1][1])                 # let the queue drain
+    del work, inflight
+
+    probes_slice = len(sub) * T
+    probes_all = sum(len(t.xy) for t in traces)
+    device_s_batch = per_dispatch * (probes_all / probes_slice)
+    return {
+        "device_ms_per_dispatch": round(per_dispatch * 1e3, 2),
+        "dispatch_shape": f"{len(sub)}x{T}pts",
+        "device_probes_per_sec": round(probes_slice / per_dispatch, 1),
+        "host_submit_s_per_batch": round(dt_submit, 3),
+        "device_s_per_batch": round(device_s_batch, 3),
+        # co-located = no link in the loop: the slower pipeline leg rules
+        "colocated_probes_per_sec": round(
+            probes_all / max(dt_submit, device_s_batch), 1),
+    }
+
+
+def _cached_mode_tileset():
+    """sf with mixed mode access (8% bike-only, 5% foot-only ways),
+    compiled as the BICYCLE subgraph — the non-auto audit tile
+    (VERDICT r3 #7)."""
+    from reporter_tpu.config import CompilerParams
+    from reporter_tpu.netgen.synthetic import assign_mode_access, generate_city
+    from reporter_tpu.tiles.compiler import compile_network
+    from reporter_tpu.tiles.tileset import TileSet
+
+    t0 = time.perf_counter()
+    net = assign_mode_access(generate_city("sf"), seed=21)
+    fp = net.fingerprint()
+    path = _repo_path(f".bench_tiles_sfm-bicycle_v4_{fp & 0xFFFFFFFF:08x}.npz")
+    if os.path.exists(path):
+        try:
+            return TileSet.load(path), {
+                "source": "npz-cache",
+                "seconds": round(time.perf_counter() - t0, 2)}
+        except Exception:
+            pass
+    ts = compile_network(net, CompilerParams(), mode="bicycle")
+    ts.save(path)
+    return ts, {"source": "compiled",
+                "seconds": round(time.perf_counter() - t0, 2)}
+
+
+def _link_rtt() -> float:
+    """Median of 7 tiny dispatch+readback round trips, in seconds (the
+    link floor; re-probed before each mood window — VERDICT r3 weak #4)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    tiny = jnp.zeros(8, jnp.float32)
+    np.asarray(tiny + 1)                        # warm the tiny executable
+    rtts = sorted(_time_best(lambda: np.asarray(tiny + 1), repeats=1)
+                  for _ in range(7))
+    return rtts[len(rtts) // 2]
 
 
 def main() -> None:
@@ -328,13 +529,8 @@ def main() -> None:
     # Link RTT floor: one tiny dispatch + host readback. When the p50 above
     # is within a small multiple of this, the latency is the link's, not
     # the matcher's — the honest breakdown for a remote-attached chip.
-    import jax.numpy as jnp
     import numpy as np
-    tiny = jnp.zeros(8, jnp.float32)
-    np.asarray(tiny + 1)                          # warm the tiny executable
-    rtts = sorted(_time_best(lambda: np.asarray(tiny + 1), repeats=1)
-                  for _ in range(7))
-    link_rtt = rtts[len(rtts) // 2]
+    link_rtt = _link_rtt()
 
     # Mitigation: the service's leader-combining (service/app.py) coalesces
     # concurrent single-trace requests into ONE device batch, so N clients
@@ -395,15 +591,17 @@ def main() -> None:
     # disagreement, length-weighted — matcher/fidelity.py, the same metric
     # the CI gates enforce) + the CPU throughput anchor.
     t0 = time.perf_counter()
-    disagreement, cpu_pps, _ = _oracle_audit(ts, jax_matcher, traces, n_cpu)
+    disagreement, cpu_pps, _, fsrc = _oracle_audit(
+        ts, jax_matcher, traces, n_cpu)
     split["oracle_primary_s"] = round(time.perf_counter() - t0, 1)
-    audit = {ts.name: {"traces": n_cpu,
-                       "disagreement": round(disagreement, 4)}}
+    audit = {ts.name: {"traces": n_cpu, "disagreement": round(disagreement, 4),
+                       "fidelity_source": fsrc}}
     truth = _truth_rates(ts, jax_matcher, traces, true_edges,
                          n=min(2000, n_traces))
 
     detail = {
         "config": f"{n_traces}x{n_points}pt traces, tile={ts.name}",
+        "headline_tile": ts.name,
         "device": (str(jax.devices()[0]).split(":")[0] if tpu_ok
                    else "CPU (forced by REPORTER_BENCH_FORCE_CPU)"
                    if forced_cpu
@@ -440,8 +638,9 @@ def main() -> None:
         mts, mtile_info = _cached_tileset("bayarea")
         mtraces, _ = _cached_fleet(mts, n_traces, n_points)
         mm, m_pps, m_decode, _ = _throughput(mts, mtraces, repeats=3)
-        m_dis, _, m_n = _oracle_audit(mts, mm, mtraces, 100)
-        audit[mts.name] = {"traces": m_n, "disagreement": round(m_dis, 4)}
+        m_dis, _, m_n, m_src = _oracle_audit(mts, mm, mtraces, 100)
+        audit[mts.name] = {"traces": m_n, "disagreement": round(m_dis, 4),
+                           "fidelity_source": m_src}
         detail["metro"] = {
             "config": f"{len(mtraces)}x{n_points}pt traces, tile={mts.name}",
             "probes_per_sec_e2e": round(m_pps, 1),
@@ -451,7 +650,8 @@ def main() -> None:
             "tile_stats": mts.stats,
         }
         split["metro_s"] = round(time.perf_counter() - t0, 1)
-        del mm, mts, mtraces
+        del mts                 # matcher + fleet stay for the window-2
+        #                         same-mood re-measure below
 
         # -- restrictions on (VERDICT r2 #5: realistic ban density) -------
         t0 = time.perf_counter()
@@ -462,8 +662,9 @@ def main() -> None:
         # repeats must MATCH the primary's: best-of-5 vs best-of-3 would
         # bias the ratio below 1 on a ~2x-noise link regardless of cost
         rm, r_pps, r_decode, _ = _throughput(rts, rtraces, repeats=5)
-        r_dis, _, r_n = _oracle_audit(rts, rm, rtraces, 150)
-        audit[rts.name] = {"traces": r_n, "disagreement": round(r_dis, 4)}
+        r_dis, _, r_n, r_src = _oracle_audit(rts, rm, rtraces, 150)
+        audit[rts.name] = {"traces": r_n, "disagreement": round(r_dis, 4),
+                           "fidelity_source": r_src}
         detail["restricted"] = {
             "config": (f"{len(rtraces)}x{n_points}pt traces, tile={rts.name}"
                        f" ({int(_RESTRICT_FRACTION * 100)}% junction"
@@ -477,7 +678,7 @@ def main() -> None:
             "tile_stats": rts.stats,
         }
         split["restricted_s"] = round(time.perf_counter() - t0, 1)
-        del rm, rts, rtraces
+        del rts
 
         # -- realistic-scale HBM envelope (SURVEY §7 "HBM budget") --------
         # bayarea-xl: ~0.5M directed edges. No oracle leg (the exact-
@@ -489,7 +690,7 @@ def main() -> None:
         from reporter_tpu.tiles.capacity import plan_staging
 
         xts, xtile_info = _cached_tileset("bayarea-xl")
-        xtraces, _ = _cached_fleet(xts, 4000, n_points)
+        xtraces, xtrue = _cached_fleet(xts, 4000, n_points)
         xm, x_pps, x_decode, _ = _throughput(xts, xtraces, repeats=3)
         plan = plan_staging(xts)
         detail["xl"] = {
@@ -504,52 +705,228 @@ def main() -> None:
                 "edges_vs_sf": round(xts.num_edges / ts.num_edges, 1),
                 "decode_slowdown_vs_sf": round(decode_pps / x_decode, 1),
             },
+            # VERDICT r3 #5: xl fidelity WITHOUT the (impractical) exact
+            # oracle — synthesis ground truth at 91x sf's edges, plus the
+            # reach-table miss rate where 85% of nodes are truncated
+            "ground_truth": _truth_rates(xts, xm, xtraces, xtrue, n=1000),
+            "reach_audit": _reach_audit_cached(
+                xts, [np.asarray(t.xy, np.float64) for t in xtraces[:15]],
+                label=xts.name),
             "tile_source": xtile_info["source"],
             "tile_stats": xts.stats,
         }
         split["xl_s"] = round(time.perf_counter() - t0, 1)
-        del xm, xts, xtraces    # the matcher pins the largest tile's
-        #                         host + HBM tables otherwise
+        del xts                 # (host RAM is ample; HBM holds every
+        #                         tile's tables at once — xl's plan says so)
+
+        # -- organic topology (VERDICT r4 #3: every prior perf/fidelity
+        # number came from jittered grids; this tile is a radial metro
+        # with mixed degrees, 30 m-2 km edges, dead ends and a limited-
+        # access spine — netgen/organic.py) --------------------------------
+        t0 = time.perf_counter()
+        ots, otile_info = _cached_tileset("organic")
+        otraces, otrue = _cached_fleet(ots, 8000, n_points)
+        om, o_pps, o_decode, _ = _throughput(ots, otraces, repeats=3)
+        o_dis, _, o_n, o_src = _oracle_audit(ots, om, otraces, 80)
+        audit[ots.name] = {"traces": o_n, "disagreement": round(o_dis, 4),
+                           "fidelity_source": o_src}
+        detail["organic"] = {
+            "config": f"{len(otraces)}x{n_points}pt traces, tile={ots.name}",
+            "probes_per_sec_e2e": round(o_pps, 1),
+            "decode_only_probes_per_sec": round(o_decode, 1),
+            "throughput_vs_sf": round(o_pps / jax_pps, 3),
+            "ground_truth": _truth_rates(ots, om, otraces, otrue, n=1000),
+            "reach_audit": _reach_audit_cached(
+                ots, [np.asarray(t.xy, np.float64) for t in otraces[:20]],
+                label=ots.name),
+            "tile_source": otile_info["source"],
+            "tile_stats": ots.stats,
+        }
+        split["organic_s"] = round(time.perf_counter() - t0, 1)
+        del ots
+
+        # -- non-auto mode fidelity (VERDICT r4 #7): bicycle profile on a
+        # mixed-access sf, audited against the same oracle under the same
+        # bicycle presets ---------------------------------------------------
+        t0 = time.perf_counter()
+        from reporter_tpu.config import Config as _Cfg
+
+        bts, btile_info = _cached_mode_tileset()
+        btraces, _ = _cached_fleet(bts, 2000, n_points)
+        bcfg = _Cfg.for_mode("bicycle", matcher_backend="jax")
+        bm = SegmentMatcher(bts, bcfg)
+        b_dis, _, b_n, b_src = _oracle_audit(
+            bts, bm, btraces, 60, config=bcfg)
+        audit[bts.name] = {"traces": b_n, "disagreement": round(b_dis, 4),
+                           "fidelity_source": b_src, "mode": "bicycle"}
+        detail["bicycle"] = {
+            "config": (f"{b_n} oracle traces, tile={bts.name} "
+                       "(8% bike-only / 5% foot-only ways)"),
+            "tile_source": btile_info["source"],
+            "tile_stats": bts.stats,
+        }
+        split["bicycle_s"] = round(time.perf_counter() - t0, 1)
+        del bm, bts, btraces
 
         audit_total = sum(v["traces"] for v in audit.values())
         detail["audit"] = {"total_traces": audit_total, "per_tile": audit}
 
-        # Re-measure the primary in a SECOND mood window (~10 min after
-        # the first): the link's throughput swings ~1.5-2x over minutes,
-        # and one bad window under best-of-5 still records a trough. Same
-        # workload, same tile — best of the two windows is still an
-        # honest best-of-N, and both windows are recorded.
+        # -- streaming path (BASELINE config 5, VERDICT r4 #4) -------------
         t0 = time.perf_counter()
-        dt2, dt_dec2 = _timed_pair(jax_matcher, traces, repeats=3)
-        probes = n_traces * n_points
-        detail["primary_second_window"] = {
-            "probes_per_sec_e2e": round(probes / dt2, 1),
-            "decode_only_probes_per_sec": round(probes / dt_dec2, 1)}
-        if probes / dt2 > jax_pps:
-            jax_pps, decode_pps = probes / dt2, probes / dt_dec2
+        detail["streaming"] = _streaming_bench(ts, traces, n_stream=2000)
+        split["streaming_s"] = round(time.perf_counter() - t0, 1)
+
+        # -- device-only compute (VERDICT r4 #6): makes the "link-bound,
+        # not chip-bound" claim a measured field --------------------------
+        t0 = time.perf_counter()
+        detail["device_compute"] = _device_compute_probe(
+            jax_matcher, traces, link_rtt)
+        split["device_compute_s"] = round(time.perf_counter() - t0, 1)
+
+        # Re-measure EVERY tile back-to-back in a SECOND mood window
+        # (~15 min after the first): the link's throughput swings ~1.5-2x
+        # over minutes, so window-1 blocks measured minutes apart sit in
+        # different moods and their ratios mix them (round-4 run 1: the
+        # primary's trough window made the restriction cost look like 40%
+        # when the same-mood ratio is ~12%). Per-tile published number =
+        # best of the two windows (still an honest best-of-N); every
+        # cross-tile RATIO divides two measurements from THIS one window.
+        t0 = time.perf_counter()
+        rtt2 = _link_rtt()      # per-window link mood, recorded with the
+        #                         window it conditions (VERDICT r3 weak #4)
+        w2: dict = {"link_rtt_ms": round(rtt2 * 1e3, 2)}
+        # Window-2 repeats top every tile's cumulative draws up to the
+        # SAME count (8): best-of over unequal sample counts would bias
+        # every cross-tile ratio on a ~2x-noise link (window 1 ran sf and
+        # sf+r at best-of-5, the rest at best-of-3).
+        pairs = [("sf", jax_matcher, traces, 3), ("bayarea", mm, mtraces, 5),
+                 ("sf+r", rm, rtraces, 3), ("bayarea-xl", xm, xtraces, 5),
+                 ("organic", om, otraces, 5)]
+        w2_pps: dict = {}
+        w2_dec: dict = {}
+        for name, mobj, mtr, reps in pairs:
+            dt2, dt_dec2 = _timed_pair(mobj, mtr, reps)
+            p = sum(len(t.xy) for t in mtr)
+            w2_pps[name], w2_dec[name] = p / dt2, p / dt_dec2
+            w2[name] = {"probes_per_sec_e2e": round(p / dt2, 1),
+                        "decode_only_probes_per_sec": round(p / dt_dec2, 1)}
+        detail["second_window"] = w2
+        # One selection rule for EVERY tile: the window whose e2e won
+        # supplies BOTH that tile's published e2e and decode numbers, so
+        # each tile's pair is mood-consistent and derived ratios divide
+        # same-rule metrics.
+        if w2_pps["sf"] > jax_pps:
+            jax_pps, decode_pps = w2_pps["sf"], w2_dec["sf"]
             detail["decode_only_probes_per_sec"] = round(decode_pps, 1)
             detail["e2e_over_decode"] = round(jax_pps / decode_pps, 3)
-            detail["batch_seconds"] = round(dt2, 3)
-        # cross-block ratios must divide the PUBLISHED primary (whichever
-        # window won), or the JSON is internally inconsistent
+            detail["batch_seconds"] = round(
+                n_traces * n_points / jax_pps, 3)
+        for name, key in (("bayarea", "metro"), ("sf+r", "restricted"),
+                          ("bayarea-xl", "xl"), ("organic", "organic")):
+            if w2_pps[name] > detail[key]["probes_per_sec_e2e"]:
+                detail[key]["probes_per_sec_e2e"] = round(w2_pps[name], 1)
+                detail[key]["decode_only_probes_per_sec"] = round(
+                    w2_dec[name], 1)
+        # Cross-tile ratios divide the PUBLISHED (best-of-both-windows)
+        # numbers: the link's mood swings ~2x second-to-second (run logs
+        # show sf at 937k and sf+r at 1.20M seconds apart in ONE window),
+        # so single-pass same-mood ratios are noise; best-of-N converges
+        # on the true rate per tile, and ratios of bests estimate the
+        # true ratio. Effects smaller than the residual noise floor
+        # (~±10% at N=5+3... reps) are not resolvable — noted inline.
         detail["restricted"]["throughput_vs_unrestricted"] = round(
-            r_pps / jax_pps, 3)
+            detail["restricted"]["probes_per_sec_e2e"] / jax_pps, 3)
+        detail["organic"]["throughput_vs_sf"] = round(
+            detail["organic"]["probes_per_sec_e2e"] / jax_pps, 3)
         detail["xl"]["culling"]["decode_slowdown_vs_sf"] = round(
-            decode_pps / x_decode, 1)
-        split["primary_window2_s"] = round(time.perf_counter() - t0, 1)
+            decode_pps / detail["xl"]["decode_only_probes_per_sec"], 1)
+        detail["ratio_note"] = ("ratios divide best-of-8-draws numbers "
+                                "(equal draw counts per tile, window-"
+                                "paired e2e/decode); link noise ~2x "
+                                "dominates effects under ~10%")
+        split["window2_s"] = round(time.perf_counter() - t0, 1)
 
     detail["setup_split"] = split
     detail["setup_seconds"] = round(
         split["device_probe_s"] + split["tile_s"] + split["fleet_s"], 1)
     detail["total_seconds"] = round(time.perf_counter() - t_setup, 1)
 
-    print(json.dumps({
+    doc = {
         "metric": "probes_per_sec_e2e",
         "value": round(jax_pps, 1),
         "unit": "probes/s",
         "vs_baseline": round(jax_pps / cpu_pps, 2),
         "detail": detail,
-    }))
+    }
+    # Full composite detail: a side file + an EARLY stdout line. The
+    # driver records only the tail of stdout (round 3's single fat line
+    # overran it → BENCH_r03 parsed:null), so the FINAL line below is a
+    # compact summary that always fits the capture window; everything it
+    # drops is in BENCH_DETAIL.json.
+    with open(_repo_path("BENCH_DETAIL.json"), "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
+    print(json.dumps(_summary_line(doc)))
+
+
+def _summary_line(doc: dict) -> dict:
+    """Compact (<1.5 KB) machine-readable round summary: headline value,
+    per-tile throughput, per-tile audit disagreement, fidelity
+    provenance, streaming/device-compute/reach key numbers."""
+    d = doc["detail"]
+
+    def _g(*path, default=None):
+        cur = d
+        for p in path:
+            if not isinstance(cur, dict) or p not in cur:
+                return default
+            cur = cur[p]
+        return cur
+
+    tiles = {d.get("headline_tile", "sf"): doc["value"]}
+    for key, name in (("metro", "bayarea"), ("restricted", "sf+r"),
+                      ("xl", "bayarea-xl"), ("organic", "organic")):
+        v = _g(key, "probes_per_sec_e2e")
+        if v is not None:
+            tiles[name] = v
+    per_tile = _g("audit", "per_tile", default={})
+    summary = {
+        "metric": doc["metric"],
+        "value": doc["value"],
+        "unit": doc["unit"],
+        "vs_baseline": doc["vs_baseline"],
+        "device": d.get("device"),
+        "tiles_pps_e2e": tiles,
+        "e2e_over_decode": d.get("e2e_over_decode"),
+        "p50_single_trace_ms": d.get("p50_single_trace_latency_ms"),
+        "link_rtt_ms_by_window": [
+            d.get("link_rtt_ms"),
+            _g("second_window", "link_rtt_ms")],
+        "audit": {
+            "total_traces": _g("audit", "total_traces"),
+            "disagreement": {k: v.get("disagreement")
+                             for k, v in per_tile.items()},
+            "fidelity_source": sorted({v.get("fidelity_source", "?")
+                                       for v in per_tile.values()}),
+        },
+        "ground_truth_edge_rate": {
+            k: _g(*path, "point_edge_rate") for k, path in
+            ((d.get("headline_tile", "sf"), ("ground_truth",)),
+             ("bayarea-xl", ("xl", "ground_truth")),
+             ("organic", ("organic", "ground_truth")))
+            if _g(*path, "point_edge_rate") is not None},
+        "reach_step_miss_rate": {
+            k: _g(k2, "reach_audit", "step_miss_rate") for k, k2 in
+            (("bayarea-xl", "xl"), ("organic", "organic"))
+            if _g(k2, "reach_audit", "step_miss_rate") is not None},
+        "streaming_pps": _g("streaming", "probes_per_sec"),
+        "colocated_pps": _g("device_compute", "colocated_probes_per_sec"),
+        "device_ms_per_dispatch": _g("device_compute",
+                                     "device_ms_per_dispatch"),
+        "total_seconds": d.get("total_seconds"),
+        "detail_file": "BENCH_DETAIL.json",
+    }
+    return summary
 
 
 if __name__ == "__main__":

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from reporter_tpu.netgen.network import RoadNetwork, TurnRestriction, Way
+from reporter_tpu.netgen.network import (ACCESS_BICYCLE, ACCESS_FOOT,
+                                         RoadNetwork, TurnRestriction, Way)
 
 # name → (seed, nx, ny); sizes tuned so "sf" compiles in seconds and the trio
 # gives a meaningfully sharded multi-city set (BASELINE config 4).
@@ -153,6 +154,29 @@ def generate_city(
     add_chain([int(node_index[t, ny - 1 - t]) for t in range(min(nx, ny))], False, "diag_se", 17.9)
 
     return RoadNetwork(node_lonlat=node_lonlat, ways=ways, name=name)
+
+
+def assign_mode_access(net: RoadNetwork, seed: int = 21,
+                       p_bike_only: float = 0.08,
+                       p_foot_only: float = 0.05) -> RoadNetwork:
+    """Give a synthetic (all-access) city a realistic mode mix: a fraction
+    of ways become bike-only "cycleways" and foot-only "footpaths" (with
+    matching free-flow speeds), the rest stay all-access. Mutates and
+    returns ``net``; name gains ``+m`` so content-keyed caches split the
+    variant. The result is the fixture for per-mode compiles
+    (compile_network(net, mode=...)) at bench scale."""
+    rng = np.random.default_rng(seed)
+    for w in net.ways:
+        u = rng.random()
+        if u < p_bike_only:
+            w.access_mask = ACCESS_BICYCLE | ACCESS_FOOT
+            w.speed_mps = 5.6
+        elif u < p_bike_only + p_foot_only:
+            w.access_mask = ACCESS_FOOT
+            w.speed_mps = 1.4
+    if not net.name.endswith("+m"):
+        net.name = f"{net.name}+m"
+    return net
 
 
 def add_random_restrictions(net: RoadNetwork, fraction: float = 0.08,

@@ -295,6 +295,30 @@ class TestLegacyCompileSemantics:
                 >= 0).all()
 
 
+class TestAssignModeAccess:
+    def test_mixes_access_and_compiles_bicycle_subgraph(self):
+        from reporter_tpu.netgen.synthetic import (assign_mode_access,
+                                                   generate_city)
+
+        net = assign_mode_access(generate_city("tiny"), seed=21,
+                                 p_bike_only=0.25, p_foot_only=0.15)
+        assert net.name.endswith("+m")
+        masks = {w.access_mask for w in net.ways}
+        assert len(masks) > 1, "no mode mix assigned"
+        n_bike_only = sum(1 for w in net.ways
+                          if not w.access_mask & ACCESS_AUTO
+                          and w.access_mask & ACCESS_BICYCLE)
+        assert n_bike_only > 0
+        bts = compile_network(net, CompilerParams(), mode="bicycle")
+        ats = compile_network(net, CompilerParams(), mode="auto")
+        assert bts.stats["mode"] == "bicycle"
+        # bike-only ways exist only in the bicycle tileset; foot-only in
+        # neither — and the shared full-graph OSMLR ids line up
+        bike_ways = set(np.asarray(bts.edge_way))
+        auto_ways = set(np.asarray(ats.edge_way))
+        assert bike_ways - auto_ways, "no bike-only ways compiled"
+
+
 class TestModePlumbing:
     def test_config_for_mode_presets(self):
         cfg = Config.for_mode("foot")
