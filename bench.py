@@ -347,15 +347,20 @@ def _streaming_bench(ts, traces, n_stream: int) -> dict:
     t0 = time.perf_counter()
     reports = 0
     while queue.lag(pipe.committed) > 0:
+        before = queue.lag(pipe.committed)
         reports += pipe.step()
+        if queue.lag(pipe.committed) >= before:
+            # residual sub-flush_min_points buffers pin the commit floor;
+            # don't busy-spin until flush_max_age — drain now
+            break
     reports += pipe.drain()
     flush_t0 = time.perf_counter()
     flushed = pipe.flush_histograms()
     dt_flush = time.perf_counter() - flush_t0
     dt = time.perf_counter() - t0
-    probes = n_stream * n_pts
+    probes = len(sub) * n_pts
     return {
-        "config": f"{n_stream} vehicles x {n_pts}pt firehose, tile={ts.name}",
+        "config": f"{len(sub)} vehicles x {n_pts}pt firehose, tile={ts.name}",
         "probes_per_sec": round(probes / dt, 1),
         "reports": int(reports),
         "steps": pipe.steps,
@@ -772,15 +777,29 @@ def main() -> None:
         detail["audit"] = {"total_traces": audit_total, "per_tile": audit}
 
         # -- streaming path (BASELINE config 5, VERDICT r4 #4) -------------
+        # Best of two full pumps: a single multi-second link stall inside
+        # one flush wave once recorded 2.1k pps for a leg that otherwise
+        # reads 50-65k — the same best-of-N discipline as every tile.
         t0 = time.perf_counter()
-        detail["streaming"] = _streaming_bench(ts, traces, n_stream=2000)
+        s_runs = [_streaming_bench(ts, traces, n_stream=2000)
+                  for _ in range(2)]
+        detail["streaming"] = max(s_runs,
+                                  key=lambda r: r["probes_per_sec"])
+        detail["streaming"]["runs_pps"] = [r["probes_per_sec"]
+                                           for r in s_runs]
         split["streaming_s"] = round(time.perf_counter() - t0, 1)
 
         # -- device-only compute (VERDICT r4 #6): makes the "link-bound,
-        # not chip-bound" claim a measured field --------------------------
+        # not chip-bound" claim a measured field. Best of two probes:
+        # the submit leg enqueues the infeed over the link, so a stalled
+        # window inflates it ~2x. --------------------------------------
         t0 = time.perf_counter()
-        detail["device_compute"] = _device_compute_probe(
-            jax_matcher, traces, link_rtt)
+        d_runs = [_device_compute_probe(jax_matcher, traces, link_rtt)
+                  for _ in range(2)]
+        detail["device_compute"] = max(
+            d_runs, key=lambda r: r["colocated_probes_per_sec"])
+        detail["device_compute"]["runs_colocated_pps"] = [
+            r["colocated_probes_per_sec"] for r in d_runs]
         split["device_compute_s"] = round(time.perf_counter() - t0, 1)
 
         # Re-measure EVERY tile back-to-back in a SECOND mood window

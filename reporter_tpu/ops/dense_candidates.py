@@ -163,22 +163,36 @@ def _select_topk(d2, edge, offabs, k: int):
     lane-parallel VPU work. Same algorithm as candidates._topk_distinct_edges
     but extraction by masked reduction instead of argmin+gather (in-kernel
     gathers would reintroduce the serialization this kernel removes).
+
+    Distance TIES break toward the smallest edge id — the same order the
+    grid backend (cell rows in segment-index order, argmin keeps the
+    first) and the CPU oracle (stable sort over the segment arrays)
+    resolve them. Morton sorting permutes this kernel's scan order, so a
+    first-lane tie-break would pick a DIFFERENT tied candidate than the
+    other two backends; at organic degree-5/6 junctions several edges
+    tie at exactly the node distance and K fills up, which made the
+    divergence visible as ~2% phantom oracle disagreement (round 4).
+    Edge-id ties also make the block-merge order-independent.
     """
     P, C = d2.shape
-    lanes = jax.lax.broadcasted_iota(jnp.int32, (P, C), 1)
+    big_e = jnp.int32(2 ** 31 - 1)
     outs_d, outs_e, outs_o = [], [], []
     for _ in range(k):
         m = jnp.min(d2, axis=1, keepdims=True)                     # [P,1]
-        pick = jnp.min(jnp.where(d2 == m, lanes, C), axis=1,
-                       keepdims=True)                              # first min
-        sel = lanes == pick                                        # one lane
-        e_k = jnp.max(jnp.where(sel, edge, -(2 ** 31 - 1)), axis=1)
-        o_k = jnp.max(jnp.where(sel, offabs, -BIG), axis=1)
+        tied = d2 == m
+        pick_e = jnp.min(jnp.where(tied, edge, big_e), axis=1)     # [P]
+        # the picked edge IS the reduction result — no lane extraction
+        # pass needed; offset = the edge's lowest tied projection (same
+        # as the oracle's stable first-segment pick: segment order is
+        # increasing offset within an edge). Three column reductions per
+        # step vs the old first-lane scheme's four.
+        sel = tied & (edge == pick_e[:, None])
+        o_k = jnp.min(jnp.where(sel, offabs, BIG), axis=1)
         ok = m[:, 0] < BIG
         outs_d.append(m[:, 0])
-        outs_e.append(jnp.where(ok, e_k, -1))
+        outs_e.append(jnp.where(ok, pick_e, -1))
         outs_o.append(jnp.where(ok, o_k, 0.0))
-        d2 = jnp.where((edge == e_k[:, None]) & ok[:, None], BIG, d2)
+        d2 = jnp.where((edge == pick_e[:, None]) & ok[:, None], BIG, d2)
     return (jnp.stack(outs_d, 1), jnp.stack(outs_e, 1), jnp.stack(outs_o, 1))
 
 
