@@ -161,6 +161,28 @@ def match_batch_wire_q(points_q, origins, lengths, tables: dict[str, Any],
     return _pack_wire(out, tables["edge_len"].shape[0])
 
 
+@functools.partial(jax.jit, static_argnames=("meta", "params"))
+def match_batch_wire_q8(deltas_q, origins, lengths, tables: dict[str, Any],
+                        meta: TileMeta, params: MatcherParams,
+                        acc_scale=None):
+    """Delta-quantized input: deltas_q i8 [B, T, 2] are the per-step
+    DIFFERENCES of the i16 0.25 m quanta (first step 0 — the origin is
+    the first point). Integer cumsum reconstructs the i16 absolutes
+    EXACTLY, so this path is bit-identical to match_batch_wire_q on every
+    valid point at half the host→device bytes — consecutive GPS points
+    at 1 Hz move well under the ±31.75 m an i8 delta can express; the
+    host batcher zeroes pad-region deltas (padded positions sit at the
+    last valid point, mask-excluded) and falls back to i16 when a real
+    step doesn't fit."""
+    q = jnp.cumsum(deltas_q.astype(jnp.int32), axis=1)
+    points = origins[:, None, :] + q.astype(jnp.float32) * jnp.float32(
+        OFFSET_QUANTUM)
+    T = deltas_q.shape[1]
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
+    out = match_traces(points, valid, tables, meta, params, acc_scale)
+    return _pack_wire(out, tables["edge_len"].shape[0])
+
+
 # Compact 2-lane format: metros under _COMPACT_WIRE_EDGES directed edges
 # (most single-city tiles — sf's 5.3k qualifies, bayarea's 54k does not)
 # fit the edge id in 14 bits, so lane 1 carries id | start | matched and

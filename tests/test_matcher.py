@@ -173,6 +173,55 @@ class TestQuantizedInfeed:
         assert [r.segment_id for r in r_both[0] if r.segment_id >= 0]
 
 
+class TestDeltaInfeed:
+    def test_q8_bit_identical_to_q16_and_dispatch(self, short_seg_tiles):
+        """The i8-delta infeed must reconstruct the i16 absolutes exactly
+        (integer cumsum of integer diffs), so the wire outputs are
+        bit-identical; a trace with a >31.75 m step must fall back to
+        i16 and still decode the same records."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from reporter_tpu.config import Config, MatcherParams
+        from reporter_tpu.matcher.api import SegmentMatcher, Trace
+        from reporter_tpu.netgen.traces import synthesize_probe
+        from reporter_tpu.ops.match import (OFFSET_QUANTUM,
+                                            match_batch_wire_q,
+                                            match_batch_wire_q8)
+
+        ts = short_seg_tiles
+        tab = ts.device_tables()
+        params = MatcherParams()
+        probes = [synthesize_probe(ts, seed=s, num_points=40,
+                                   gps_sigma=3.0) for s in (1, 2, 3)]
+        B, T = len(probes), 40
+        pts = np.stack([p.xy for p in probes]).astype(np.float32)
+        lens = np.full(B, T, np.int32)
+        origins = pts[:, 0, :].copy()
+        dqi = np.round((pts - origins[:, None, :])
+                       / OFFSET_QUANTUM).astype(np.int32)
+        d8 = np.diff(dqi, axis=1, prepend=dqi[:, :1] * 0)
+        assert np.abs(d8).max() < 128     # 1 Hz fleet steps fit i8
+        w16 = np.asarray(match_batch_wire_q(
+            jnp.asarray(dqi.astype(np.int16)), jnp.asarray(origins),
+            jnp.asarray(lens), tab, ts.meta, params))
+        w8 = np.asarray(match_batch_wire_q8(
+            jnp.asarray(d8.astype(np.int8)), jnp.asarray(origins),
+            jnp.asarray(lens), tab, ts.meta, params))
+        np.testing.assert_array_equal(w16, w8)
+
+        # dispatch: a 50 m jump mid-trace overflows i8 — the matcher must
+        # still produce the same records as matching the jumpy trace alone
+        m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+        jump = pts[0].copy()
+        jump[20:] += 50.0
+        tj = Trace(uuid="j", xy=jump, times=probes[0].times)
+        solo = [r.segment_id for r in m.match_many([tj])[0]]
+        t_norm = Trace(uuid="n", xy=pts[1], times=probes[1].times)
+        both = m.match_many([tj, t_norm])
+        assert [r.segment_id for r in both[0]] == solo
+
+
 class TestMatchTopK:
     def test_topk_best_matches_primary(self, short_seg_tiles):
         import numpy as np
