@@ -89,13 +89,74 @@ def _morton(x: np.ndarray, y: np.ndarray) -> np.ndarray:
     return spread(x) | (spread(y) << np.uint64(1))
 
 
+def _split_long_segments(seg_a, seg_b, seg_edge, seg_off, seg_len,
+                         lmax: float):
+    """Tile segments longer than ``lmax`` into collinear sub-spans.
+
+    A 2 km rural edge is ONE line segment; its bbox inflates whichever
+    Morton block it lands in until half the metro's chunks "hit" that
+    block (organic/xl tiles carry many such edges — grid tiles none).
+    Sub-spans tile the segment exactly: min distance over pieces equals
+    distance to the whole segment, and offabs composes via the piece's
+    off0, so candidates are unchanged (to f32 rounding at the seams) —
+    only the culling gets tighter."""
+    long_i = np.nonzero(seg_len > lmax)[0]
+    if not len(long_i):
+        return seg_a, seg_b, seg_edge, seg_off, seg_len
+    keep = np.ones(len(seg_len), bool)
+    keep[long_i] = False
+    # grouped formulation (xl-scale tiles can carry tens of thousands of
+    # long rural edges; a per-edge Python loop is real time on one core):
+    # piece r of parent i spans fractions [r/n_i, (r+1)/n_i]
+    n = np.ceil(seg_len[long_i] / lmax).astype(np.int64)
+    parent = np.repeat(long_i, n)                      # [N] parent index
+    r = np.arange(len(parent)) - np.repeat(np.cumsum(n) - n, n)
+    nn = np.repeat(n, n).astype(np.float64)
+    f0 = (r / nn)[:, None]
+    f1 = ((r + 1) / nn)[:, None]
+    d = seg_b[parent] - seg_a[parent]
+    pb_long = seg_a[parent] + d * f1
+    # the final piece ends at the ORIGINAL endpoint bit-for-bit: junction
+    # nodes are segment endpoints, and an a+(b-a)*1.0 ulp there would
+    # break the exact d=0 ties the cross-backend tie-break relies on
+    last = (r + 1) == nn.astype(np.int64)
+    pb_long[last] = seg_b[parent[last]]
+    return (np.concatenate([seg_a[keep],
+                            seg_a[parent] + d * f0]).astype(np.float32),
+            np.concatenate([seg_b[keep], pb_long]).astype(np.float32),
+            np.concatenate([seg_edge[keep], seg_edge[parent]]),
+            np.concatenate([seg_off[keep], seg_off[parent]
+                            + seg_len[parent] * f0[:, 0]]).astype(np.float32),
+            np.concatenate([seg_len[keep], seg_len[parent]
+                            * (f1 - f0)[:, 0]]).astype(np.float32))
+
+
+def packed_columns(seg_len: np.ndarray, block: int = _SBLK,
+                   split_len: float = 256.0) -> int:
+    """Post-split padded column count of build_seg_pack's layout — the
+    shape math tiles/capacity needs WITHOUT rebuilding the Morton pack
+    (~seconds at 0.6M segments on one core). Must mirror
+    _split_long_segments' piece count exactly."""
+    s = len(seg_len)
+    if split_len and s:
+        long = seg_len > split_len
+        s = int(s - long.sum()
+                + np.ceil(seg_len[long] / split_len).sum())
+    return max(block, -(-s // block) * block)
+
+
 def build_seg_pack(seg_a: np.ndarray, seg_b: np.ndarray, seg_edge: np.ndarray,
                    seg_off: np.ndarray, seg_len: np.ndarray,
-                   block: int = _SBLK) -> SegPack:
+                   block: int = _SBLK, split_len: float = 256.0) -> SegPack:
     """Morton-sort segments, pack [8, S_pad] f32 component rows (edge ids
     bitcast into a row), record per-block bboxes. Padding columns carry
     edge = -1 → permanently invalid; padding blocks carry NaN bboxes →
-    never selected by the culling pre-pass."""
+    never selected by the culling pre-pass. Segments longer than
+    ``split_len`` are tiled into sub-spans first so no block bbox is
+    inflated by one long edge (_split_long_segments)."""
+    if split_len and len(seg_len):
+        seg_a, seg_b, seg_edge, seg_off, seg_len = _split_long_segments(
+            seg_a, seg_b, seg_edge, seg_off, seg_len, split_len)
     s = len(seg_edge)
     spad = max(block, ((s + block - 1) // block) * block)
 

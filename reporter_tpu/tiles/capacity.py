@@ -57,13 +57,15 @@ def dense_staged_bytes(ts: TileSet) -> tuple[int, int]:
     fixed — per-edge arrays + node-keyed reach rows, replicated by design
     (every shard's Viterbi needs them).
     """
-    from reporter_tpu.ops.dense_candidates import _SBLK, SP_NCOMP
+    from reporter_tpu.ops.dense_candidates import (_SBLK, SP_NCOMP,
+                                                   packed_columns)
 
     # exact shape math for build_seg_pack's layout ([SP_NCOMP, S_pad] f32
     # pack + [S_pad/_SBLK, 4] f32 bboxes) — computing it beats REBUILDING
-    # the Morton pack (~seconds at 0.6M segments on a one-core host)
-    s = int(len(ts.seg_edge))
-    spad = max(_SBLK, -(-s // _SBLK) * _SBLK)
+    # the Morton pack (~seconds at 0.6M segments on a one-core host).
+    # packed_columns accounts for the long-segment pre-split (the pack
+    # holds MORE columns than ts.seg_edge on tiles with >256 m segments).
+    spad = packed_columns(ts.seg_len)
     shardable = (SP_NCOMP * spad + (spad // _SBLK) * 4) * 4
     fixed = int(ts.edge_len.nbytes + ts.edge_reach_row.nbytes
                 + ts.edge_osmlr.nbytes + ts.reach_to.nbytes

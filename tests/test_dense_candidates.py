@@ -90,6 +90,72 @@ def test_dense_matches_grid(ts, tables):
                 ), f"point {i}"
 
 
+def test_long_segment_split():
+    """Tiles with multi-km edges (organic/xl): build_seg_pack tiles them
+    into sub-spans for tighter block bboxes. Candidates must be the SAME
+    as an unsplit pack and as the grid backend; node-endpoint ties stay
+    exact (the final piece pins the original endpoint bit-for-bit); and
+    capacity's shape math must match the actually-built pack."""
+    from reporter_tpu.geometry import xy_to_lonlat
+    from reporter_tpu.netgen.network import RoadNetwork, Way
+    from reporter_tpu.ops.dense_candidates import packed_columns
+
+    # a 2 km spine meeting short streets at both ends
+    xy = np.array([[-1000.0, 0.0], [1000.0, 0.0], [1000.0, 150.0],
+                   [-1000.0, -150.0], [0.0, 140.0]])
+    ll = xy_to_lonlat(xy, np.array([-122.3, 37.8]))
+    net = RoadNetwork(node_lonlat=ll, ways=[
+        Way(way_id=1, nodes=[0, 1], speed_mps=29.0),      # 2 km edge
+        Way(way_id=2, nodes=[1, 2]),
+        Way(way_id=3, nodes=[0, 3]),
+        Way(way_id=4, nodes=[4, 1]),                      # long diagonal
+    ])
+    lts = compile_network(net, CompilerParams(reach_radius=400.0))
+    assert float(lts.seg_len.max()) > 1000.0
+
+    split = build_seg_pack(lts.seg_a, lts.seg_b, lts.seg_edge,
+                           lts.seg_off, lts.seg_len)
+    unsplit = build_seg_pack(lts.seg_a, lts.seg_b, lts.seg_edge,
+                             lts.seg_off, lts.seg_len, split_len=0.0)
+    assert split.pack.shape[1] == packed_columns(lts.seg_len)
+    n_pieces = (split.pack[6].view(np.int32) >= 0).sum()
+    assert n_pieces > len(lts.seg_edge)        # the long edges DID split
+
+    tab = lts.device_tables()
+    rng = np.random.default_rng(2)
+    pts = np.vstack([
+        rng.uniform([-1100, -250], [1100, 250], (200, 2)),
+        lts.node_xy[[0, 1]],                   # exactly at the junctions
+    ]).astype(np.float32)
+    k = 8
+    cs = find_candidates_dense(jnp.asarray(pts),
+                               (jnp.asarray(split.pack),
+                                jnp.asarray(split.bbox)), 50.0, k)
+    cu = find_candidates_dense(jnp.asarray(pts),
+                               (jnp.asarray(unsplit.pack),
+                                jnp.asarray(unsplit.bbox)), 50.0, k)
+    cg = find_candidates_trace(jnp.asarray(pts), tab, lts.meta, 50.0, k)
+    es, eu, eg = (np.asarray(c.edge) for c in (cs, cu, cg))
+    for i in range(len(pts)):
+        # sub-ulp seam rounding may flip the ORDER of near-ties; the edge
+        # SET must be identical across all three packs
+        s_set = set(es[i][es[i] >= 0].tolist())
+        assert s_set == set(eu[i][eu[i] >= 0].tolist()), i
+        assert s_set == set(eg[i][eg[i] >= 0].tolist()), i
+    # at the junction nodes the ties are EXACT (endpoints bit-preserved),
+    # so even the order must survive the split
+    np.testing.assert_array_equal(es[-2:], eg[-2:])
+    # offsets compare per (row, edge) — column order differs at near-ties
+    os_, ou = np.asarray(cs.offset), np.asarray(cu.offset)
+    for i in range(len(pts)):
+        got = {int(e): float(o) for e, o in zip(es[i], os_[i]) if e >= 0}
+        want = {int(e): float(o) for e, o in zip(eu[i], ou[i]) if e >= 0}
+        for e, o in want.items():              # seam rounding ≤ ~0.5 m
+            assert abs(got[e] - o) < 0.51, (i, e, got[e], o)
+    np.testing.assert_allclose(np.sort(np.asarray(cs.dist), 1),
+                               np.sort(np.asarray(cg.dist), 1), atol=1e-3)
+
+
 def test_tie_break_at_star_junction():
     """12 ways meeting at one node: a query at the node ties every
     incident edge at distance ~0, overflowing K — all three candidate
