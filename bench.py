@@ -402,12 +402,14 @@ def _device_compute_probe(m, traces, link_rtt: float) -> dict:
     args = (jax.device_put(dq.astype(np.int16)), jax.device_put(origins),
             jax.device_put(lens))
     np.asarray(args[0][0, 0])                   # sync the uploads
-    wire = match_batch_wire_q(*args, m._tables, m.ts.meta, m.params, None)
+    spec = getattr(m, "_wire_spec", None)       # probe the PRODUCTION wire
+    wire = match_batch_wire_q(*args, m._tables, m.ts.meta, m.params, None,
+                              spec=spec)
     np.asarray(wire)                            # warm executable + readback
     t0 = time.perf_counter()
     for _ in range(K):
         wire = match_batch_wire_q(*args, m._tables, m.ts.meta,
-                                  m.params, None)
+                                  m.params, None, spec=spec)
     np.asarray(wire)
     per_dispatch = max((time.perf_counter() - t0 - link_rtt) / K, 1e-6)
 

@@ -164,6 +164,12 @@ class SegmentMatcher:
             # (the unused one is the largest table at metro scale)
             self._tables = tileset.device_tables(
                 self.params.candidate_backend)
+            # packed-u32 result wire for big metros (ops.match.wire_spec):
+            # -33% of the device→host bytes that bound big-tile decode
+            from reporter_tpu.ops.match import wire_spec
+            self._wire_spec = wire_spec(
+                tileset.num_edges,
+                float(tileset.edge_len.max()) if tileset.num_edges else 0.0)
             self._route_fn = reach_route_fn(tileset)
             # Native batch walker (walker.cc): same walk as build_segments
             # with the reach-table route_fn, multithreaded across traces.
@@ -412,16 +418,19 @@ class SegmentMatcher:
                     wire = match_batch_wire_q8(
                         jnp.asarray(d8.astype(np.int8)),
                         jnp.asarray(origins), jnp.asarray(lens),
-                        self._tables, self.ts.meta, self.params, acc_scale)
+                        self._tables, self.ts.meta, self.params, acc_scale,
+                        spec=self._wire_spec)
                 else:
                     wire = match_batch_wire_q(
                         jnp.asarray(dqi.astype(np.int16)),
                         jnp.asarray(origins), jnp.asarray(lens),
-                        self._tables, self.ts.meta, self.params, acc_scale)
+                        self._tables, self.ts.meta, self.params, acc_scale,
+                        spec=self._wire_spec)
             else:
                 wire = match_batch_wire(
                     jnp.asarray(pts), jnp.asarray(lens),
-                    self._tables, self.ts.meta, self.params, acc_scale)
+                    self._tables, self.ts.meta, self.params, acc_scale,
+                    spec=self._wire_spec)
             inflight.append((ws, wire))
         return work, inflight
 
@@ -437,7 +446,7 @@ class SegmentMatcher:
         # slice k runs in a worker thread while slice k+1's wire bytes
         # stream back over the link.
         def split_slice(_k, ws, arr):
-            edges, offs, starts = unpack_wire(arr)
+            edges, offs, starts = unpack_wire(arr, self._wire_spec)
             for r, w in enumerate(ws):
                 i, lo, xy = work[w]
                 T = len(xy)
@@ -484,7 +493,7 @@ class SegmentMatcher:
 
         def walk_slice(k, ws, arr):
             nonlocal unmatched
-            edges, offs, starts = unpack_wire(arr)
+            edges, offs, starts = unpack_wire(arr, self._wire_spec)
             B, T = edges.shape
             times = np.zeros((B, T), np.float64)
             pad = 0

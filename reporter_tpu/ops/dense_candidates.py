@@ -65,6 +65,7 @@ _INTERPRET = os.environ.get("RTPU_PALLAS_INTERPRET", "") == "1"
 _P = 128          # points per chunk (sublane-friendly)
 _SBLK = 512       # segment columns per block (small: culling granularity)
 _NSUB = 4         # chunk sub-bboxes (tighter than one bbox for long chunks)
+SPLIT_LEN = 256.0  # long-segment pre-split span (shared with tiles/capacity)
 
 
 class SegPack(NamedTuple):
@@ -132,7 +133,7 @@ def _split_long_segments(seg_a, seg_b, seg_edge, seg_off, seg_len,
 
 
 def packed_columns(seg_len: np.ndarray, block: int = _SBLK,
-                   split_len: float = 256.0) -> int:
+                   split_len: float = SPLIT_LEN) -> int:
     """Post-split padded column count of build_seg_pack's layout — the
     shape math tiles/capacity needs WITHOUT rebuilding the Morton pack
     (~seconds at 0.6M segments on one core). Must mirror
@@ -147,7 +148,7 @@ def packed_columns(seg_len: np.ndarray, block: int = _SBLK,
 
 def build_seg_pack(seg_a: np.ndarray, seg_b: np.ndarray, seg_edge: np.ndarray,
                    seg_off: np.ndarray, seg_len: np.ndarray,
-                   block: int = _SBLK, split_len: float = 256.0) -> SegPack:
+                   block: int = _SBLK, split_len: float = SPLIT_LEN) -> SegPack:
     """Morton-sort segments, pack [8, S_pad] f32 component rows (edge ids
     bitcast into a row), record per-block bboxes. Padding columns carry
     edge = -1 → permanently invalid; padding blocks carry NaN bboxes →

@@ -14,6 +14,7 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from reporter_tpu.config import MatcherParams
 from reporter_tpu.ops.candidates import CandidateSet, find_candidates_trace
@@ -121,20 +122,22 @@ def match_batch(points, valid_pt, tables: dict[str, Any], meta: TileMeta,
     return match_traces(points, valid_pt, tables, meta, params)
 
 
-# Wire format (match_batch_wire): one u16 [B, 2|3, T] array so the decode
-# result crosses the device→host link as a SINGLE transfer (a remote-attached
-# chip pays a round-trip per fetched array, and bytes are the bottleneck).
-# Full 3-lane layout:
-#   lane 0: offset along edge, 0.25 m fixed-point (u16 ⇒ edges to 16.4 km)
-#   lane 1: edge id low 16 bits
-#   lane 2: edge id bits 16..28 | chain_start << 14 | matched << 15
-# Small metros use the compact 2-lane layout (see _COMPACT_WIRE_EDGES).
+# Wire format (match_batch_wire): ONE array so the decode result crosses
+# the device→host link as a single transfer. Three layouts, chosen
+# statically from the tile (unpack_wire dispatches on lane count/dtype):
+#   compact u16 [B, 2, T]  — metros ≤ _COMPACT_WIRE_EDGES edges:
+#     lane 0 offset (0.25 m fixed point), lane 1 id(14)|start|matched
+#   packed  u32 [B, 1, T]  — bigger metros whenever wire_spec() accepts:
+#     offset(ob) | edge(30-ob) | start<<30 | matched<<31 (same bytes as
+#     compact; -33% vs the 3-lane fallback on the readback-bound path)
+#   full    u16 [B, 3, T]  — the fallback (multi-km edges at ~0.5M ids):
+#     lane 0 offset, lane 1 id low 16, lane 2 id hi(13)|start|matched
 OFFSET_QUANTUM = 0.25
 
 
-@functools.partial(jax.jit, static_argnames=("meta", "params"))
+@functools.partial(jax.jit, static_argnames=("meta", "params", "spec"))
 def match_batch_wire(points, lengths, tables: dict[str, Any], meta: TileMeta,
-                     params: MatcherParams, acc_scale=None):
+                     params: MatcherParams, acc_scale=None, spec=None):
     """points f32 [B, T, 2], lengths i32 [B] (valid prefix per trace) →
     u16 [B, 2|3, T] wire array; unpack with unpack_wire(). acc_scale: see
     match_traces (None traces a separate, scale-free executable, so
@@ -142,12 +145,13 @@ def match_batch_wire(points, lengths, tables: dict[str, Any], meta: TileMeta,
     T = points.shape[1]
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
     out = match_traces(points, valid, tables, meta, params, acc_scale)
-    return _pack_wire(out, tables["edge_len"].shape[0])
+    return _pack_wire(out, tables["edge_len"].shape[0], spec)
 
 
-@functools.partial(jax.jit, static_argnames=("meta", "params"))
+@functools.partial(jax.jit, static_argnames=("meta", "params", "spec"))
 def match_batch_wire_q(points_q, origins, lengths, tables: dict[str, Any],
-                       meta: TileMeta, params: MatcherParams, acc_scale=None):
+                       meta: TileMeta, params: MatcherParams, acc_scale=None,
+                       spec=None):
     """Quantized-input variant: points_q i16 [B, T, 2] are 0.25 m
     fixed-point offsets from per-trace origins f32 [B, 2] (host→device
     bytes halve vs f32; 0.125 m quantization ≪ sigma_z). Traces spanning
@@ -158,13 +162,13 @@ def match_batch_wire_q(points_q, origins, lengths, tables: dict[str, Any],
         OFFSET_QUANTUM)
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
     out = match_traces(points, valid, tables, meta, params, acc_scale)
-    return _pack_wire(out, tables["edge_len"].shape[0])
+    return _pack_wire(out, tables["edge_len"].shape[0], spec)
 
 
-@functools.partial(jax.jit, static_argnames=("meta", "params"))
+@functools.partial(jax.jit, static_argnames=("meta", "params", "spec"))
 def match_batch_wire_q8(deltas_q, origins, lengths, tables: dict[str, Any],
                         meta: TileMeta, params: MatcherParams,
-                        acc_scale=None):
+                        acc_scale=None, spec=None):
     """Delta-quantized input: deltas_q i8 [B, T, 2] are the per-step
     DIFFERENCES of the i16 0.25 m quanta (first step 0 — the origin is
     the first point). Integer cumsum reconstructs the i16 absolutes
@@ -180,7 +184,7 @@ def match_batch_wire_q8(deltas_q, origins, lengths, tables: dict[str, Any],
     T = deltas_q.shape[1]
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
     out = match_traces(points, valid, tables, meta, params, acc_scale)
-    return _pack_wire(out, tables["edge_len"].shape[0])
+    return _pack_wire(out, tables["edge_len"].shape[0], spec)
 
 
 # Compact 2-lane format: metros under _COMPACT_WIRE_EDGES directed edges
@@ -193,8 +197,38 @@ def match_batch_wire_q8(deltas_q, origins, lengths, tables: dict[str, Any],
 _COMPACT_WIRE_EDGES = 1 << 14
 
 
-def _pack_wire(out: MatchOutput, num_edges: int):
+def wire_spec(num_edges: int, max_edge_len: float) -> "tuple | None":
+    """Packed-u32 wire layout for metros past the compact-u16 range, or
+    None where the 3-lane u16 fallback must carry the result.
+
+    Layout: offset quanta in the low ``ob`` bits, edge id in the next
+    30-ob bits, chain_start at 30, matched at 31 — ONE u32 lane instead
+    of three u16 lanes (-33% of the device→host bytes that bound big-
+    metro decode; the downlink streams ~11 MB/s). ``ob`` shrinks as the
+    edge count grows; the offset quantum is max(0.25 m, Lmax/(2^ob-1)),
+    and when that would exceed 0.5 m (multi-km edges on a ~500k-edge
+    tile) the format is rejected (None) rather than degrading offsets."""
+    if num_edges <= _COMPACT_WIRE_EDGES:
+        return None                      # compact u16 is already 4 B/pt
+    eb = max(15, int(np.ceil(np.log2(max(num_edges, 2)))))
+    ob = 30 - eb
+    if ob < 8:
+        return None
+    q = max(OFFSET_QUANTUM, float(max_edge_len) / ((1 << ob) - 1))
+    return (ob, q) if q <= 0.5 else None
+
+
+def _pack_wire(out: MatchOutput, num_edges: int,
+               spec: "tuple | None" = None):
     edge = jnp.maximum(out.edge, 0).astype(jnp.uint32)
+    if spec is not None and num_edges > _COMPACT_WIRE_EDGES:
+        ob, q = spec
+        off_q = jnp.clip(jnp.round(out.offset / q),
+                         0, (1 << ob) - 1).astype(jnp.uint32)
+        w = (off_q | (edge << ob)
+             | (out.chain_start.astype(jnp.uint32) << 30)
+             | (out.matched.astype(jnp.uint32) << 31))
+        return w[:, None, :]
     off_q = jnp.clip(jnp.round(out.offset / OFFSET_QUANTUM), 0, 65535)
     w0 = off_q.astype(jnp.uint16)
     if num_edges <= _COMPACT_WIRE_EDGES:
@@ -209,11 +243,19 @@ def _pack_wire(out: MatchOutput, num_edges: int):
     return jnp.stack([w0, w1, w2], axis=1)
 
 
-def unpack_wire(wire) -> tuple[Any, Any, Any]:
-    """numpy unpack: u16 [B, 2|3, T] → (edges i32 [B,T] with -1 unmatched,
+def unpack_wire(wire, spec: "tuple | None" = None) -> tuple[Any, Any, Any]:
+    """numpy unpack: u16 [B, 2|3, T] (or packed u32 [B, 1, T] with its
+    ``spec`` from wire_spec) → (edges i32 [B,T] with -1 unmatched,
     offsets f32 [B,T], chain_starts bool [B,T])."""
-    import numpy as np
-
+    if wire.dtype == np.uint32:             # packed u32: off | edge | s | m
+        ob, q = spec
+        w = np.asarray(wire[:, 0], np.int64)
+        matched = (w >> 31) & 1
+        edges = np.where(matched == 1,
+                         (w >> ob) & ((1 << (30 - ob)) - 1), -1)
+        starts = ((w >> 30) & 1).astype(bool)
+        offsets = ((w & ((1 << ob) - 1)) * q).astype(np.float32)
+        return edges.astype(np.int32), offsets, starts
     w0 = wire[:, 0].astype(np.int64)
     w1 = wire[:, 1].astype(np.int64)
     if wire.shape[1] == 2:                  # compact: id(14) | start | matched

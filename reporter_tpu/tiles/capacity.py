@@ -63,8 +63,9 @@ def dense_staged_bytes(ts: TileSet) -> tuple[int, int]:
     # exact shape math for build_seg_pack's layout ([SP_NCOMP, S_pad] f32
     # pack + [S_pad/_SBLK, 4] f32 bboxes) — computing it beats REBUILDING
     # the Morton pack (~seconds at 0.6M segments on a one-core host).
-    # packed_columns accounts for the long-segment pre-split (the pack
-    # holds MORE columns than ts.seg_edge on tiles with >256 m segments).
+    # packed_columns accounts for the long-segment pre-split at the
+    # shared dense_candidates.SPLIT_LEN (the pack holds MORE columns than
+    # ts.seg_edge on tiles with long segments).
     spad = packed_columns(ts.seg_len)
     shardable = (SP_NCOMP * spad + (spad // _SBLK) * 4) * 4
     fixed = int(ts.edge_len.nbytes + ts.edge_reach_row.nbytes

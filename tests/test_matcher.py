@@ -222,6 +222,56 @@ class TestDeltaInfeed:
         assert [r.segment_id for r in both[0]] == solo
 
 
+class TestPackedU32Wire:
+    def test_u32_wire_matches_3lane_on_big_metro(self):
+        """Metros past the compact-u16 range: the packed-u32 single-lane
+        wire must unpack to EXACTLY the 3-lane result (the offset
+        quantum stays 0.25 m whenever the bit budget allows, which it
+        does for every synthetic tile) at 2/3 the bytes."""
+        import jax.numpy as jnp
+
+        from reporter_tpu.config import MatcherParams
+        from reporter_tpu.netgen.synthetic import generate_city
+        from reporter_tpu.netgen.traces import synthesize_fleet
+        from reporter_tpu.ops.match import (OFFSET_QUANTUM,
+                                            match_batch_wire, unpack_wire,
+                                            wire_spec)
+        from reporter_tpu.tiles.compiler import compile_network
+
+        ts = compile_network(generate_city("big", nx=78, ny=78, seed=9))
+        assert ts.num_edges > 16384      # 3-lane territory
+        spec = wire_spec(ts.num_edges, float(ts.edge_len.max()))
+        assert spec is not None and spec[1] == OFFSET_QUANTUM
+
+        params = MatcherParams()
+        tab = ts.device_tables()
+        fleet = synthesize_fleet(ts, 6, num_points=60, seed=4)
+        pts = np.stack([p.xy for p in fleet]).astype(np.float32)
+        lens = np.full(len(fleet), 60, np.int32)
+        w3 = np.asarray(match_batch_wire(
+            jnp.asarray(pts), jnp.asarray(lens), tab, ts.meta, params))
+        w1 = np.asarray(match_batch_wire(
+            jnp.asarray(pts), jnp.asarray(lens), tab, ts.meta, params,
+            spec=spec))
+        assert w3.dtype == np.uint16 and w3.shape[1] == 3
+        assert w1.dtype == np.uint32 and w1.shape[1] == 1
+        assert w1.nbytes * 3 == w3.nbytes * 2
+        e3, o3, s3 = unpack_wire(w3)
+        e1, o1, s1 = unpack_wire(w1, spec)
+        np.testing.assert_array_equal(e3, e1)
+        np.testing.assert_array_equal(o3, o1)
+        np.testing.assert_array_equal(s3, s1)
+
+    def test_wire_spec_boundaries(self):
+        from reporter_tpu.ops.match import wire_spec
+
+        assert wire_spec(5000, 500.0) is None          # compact handles it
+        assert wire_spec(60000, 2200.0) is not None    # organic-scale
+        ob, q = wire_spec(500000, 500.0)               # xl-scale: 19-bit id
+        assert q == 0.25 and ob == 11
+        assert wire_spec(500000, 5000.0) is None       # q would be 2.4 m
+
+
 class TestMatchTopK:
     def test_topk_best_matches_primary(self, short_seg_tiles):
         import numpy as np
