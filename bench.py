@@ -752,6 +752,30 @@ def main() -> None:
         split["organic_s"] = round(time.perf_counter() - t0, 1)
         del ots
 
+        # -- organic at several-times-metro scale: does the irregular-
+        # topology story hold as the map grows? (~32k nodes / 152k
+        # directed edges, 3.4 km max edges; ground truth + reach audit,
+        # no oracle — same policy as bayarea-xl) ---------------------------
+        t0 = time.perf_counter()
+        oxts, oxtile_info = _cached_tileset("organic-xl")
+        oxtraces, oxtrue = _cached_fleet(oxts, 4000, n_points)
+        oxm, ox_pps, ox_decode, _ = _throughput(oxts, oxtraces, repeats=3)
+        detail["organic_xl"] = {
+            "config": f"{len(oxtraces)}x{n_points}pt traces, "
+                      f"tile={oxts.name}",
+            "probes_per_sec_e2e": round(ox_pps, 1),
+            "decode_only_probes_per_sec": round(ox_decode, 1),
+            "ground_truth": _truth_rates(oxts, oxm, oxtraces, oxtrue,
+                                         n=1000),
+            "reach_audit": _reach_audit_cached(
+                oxts, [np.asarray(t.xy, np.float64)
+                       for t in oxtraces[:8]], label=oxts.name),
+            "tile_source": oxtile_info["source"],
+            "tile_stats": oxts.stats,
+        }
+        split["organic_xl_s"] = round(time.perf_counter() - t0, 1)
+        del oxts
+
         # -- non-auto mode fidelity (VERDICT r4 #7): bicycle profile on a
         # mixed-access sf, audited against the same oracle under the same
         # bicycle presets ---------------------------------------------------
@@ -822,7 +846,8 @@ def main() -> None:
         # sf+r at best-of-5, the rest at best-of-3).
         pairs = [("sf", jax_matcher, traces, 3), ("bayarea", mm, mtraces, 5),
                  ("sf+r", rm, rtraces, 3), ("bayarea-xl", xm, xtraces, 5),
-                 ("organic", om, otraces, 5)]
+                 ("organic", om, otraces, 5),
+                 ("organic-xl", oxm, oxtraces, 5)]
         w2_pps: dict = {}
         w2_dec: dict = {}
         for name, mobj, mtr, reps in pairs:
@@ -843,7 +868,8 @@ def main() -> None:
             detail["batch_seconds"] = round(
                 n_traces * n_points / jax_pps, 3)
         for name, key in (("bayarea", "metro"), ("sf+r", "restricted"),
-                          ("bayarea-xl", "xl"), ("organic", "organic")):
+                          ("bayarea-xl", "xl"), ("organic", "organic"),
+                          ("organic-xl", "organic_xl")):
             if w2_pps[name] > detail[key]["probes_per_sec_e2e"]:
                 detail[key]["probes_per_sec_e2e"] = round(w2_pps[name], 1)
                 detail[key]["decode_only_probes_per_sec"] = round(
@@ -906,7 +932,8 @@ def _summary_line(doc: dict) -> dict:
 
     tiles = {d.get("headline_tile", "sf"): doc["value"]}
     for key, name in (("metro", "bayarea"), ("restricted", "sf+r"),
-                      ("xl", "bayarea-xl"), ("organic", "organic")):
+                      ("xl", "bayarea-xl"), ("organic", "organic"),
+                      ("organic_xl", "organic-xl")):
         v = _g(key, "probes_per_sec_e2e")
         if v is not None:
             tiles[name] = v
@@ -934,11 +961,13 @@ def _summary_line(doc: dict) -> dict:
             k: _g(*path, "point_edge_rate") for k, path in
             ((d.get("headline_tile", "sf"), ("ground_truth",)),
              ("bayarea-xl", ("xl", "ground_truth")),
-             ("organic", ("organic", "ground_truth")))
+             ("organic", ("organic", "ground_truth")),
+             ("organic-xl", ("organic_xl", "ground_truth")))
             if _g(*path, "point_edge_rate") is not None},
         "reach_step_miss_rate": {
             k: _g(k2, "reach_audit", "step_miss_rate") for k, k2 in
-            (("bayarea-xl", "xl"), ("organic", "organic"))
+            (("bayarea-xl", "xl"), ("organic", "organic"),
+             ("organic-xl", "organic_xl"))
             if _g(k2, "reach_audit", "step_miss_rate") is not None},
         "streaming_pps": _g("streaming", "probes_per_sec"),
         "colocated_pps": _g("device_compute", "colocated_probes_per_sec"),

@@ -61,9 +61,11 @@ def generate_city(
     removed, some ways are one-way, some legs get curved shape geometry, and a
     pair of diagonal boulevards crosses the grid.
     """
-    if name == "organic":
-        # irregular radial metro (VERDICT r3: non-grid topology evidence);
-        # lives in netgen/organic.py — same RoadNetwork contract
+    if name in ("organic", "organic-xl"):
+        # irregular radial metros (VERDICT r3: non-grid topology evidence);
+        # live in netgen/organic.py — same RoadNetwork contract. The -xl
+        # variant (~32k nodes / ~152k directed edges) carries the
+        # irregular structure to several times metro scale.
         if (nx, ny) != (None, None) or (spacing, jitter) != (120.0, 12.0) \
                 or (p_missing_block, p_oneway, p_curved) != (0.06, 0.25,
                                                              0.25):
@@ -72,6 +74,10 @@ def generate_city(
                 "call netgen.organic.generate_organic_city directly")
         from reporter_tpu.netgen.organic import generate_organic_city
 
+        if name == "organic-xl":
+            return generate_organic_city(
+                name, seed=seed if seed is not None else 12,
+                radius=16000.0, core_scale=2800.0, n_candidates=420000)
         return generate_organic_city(name, seed=seed if seed is not None
                                      else 11)
     preset = CITY_PRESETS.get(name)
