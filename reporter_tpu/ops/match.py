@@ -248,6 +248,10 @@ def unpack_wire(wire, spec: "tuple | None" = None) -> tuple[Any, Any, Any]:
     ``spec`` from wire_spec) → (edges i32 [B,T] with -1 unmatched,
     offsets f32 [B,T], chain_starts bool [B,T])."""
     if wire.dtype == np.uint32:             # packed u32: off | edge | s | m
+        if spec is None:
+            raise ValueError(
+                "unpack_wire: uint32 wire requires the wire_spec it was "
+                "packed with (pass spec=wire_spec(...) from the matcher)")
         ob, q = spec
         w = np.asarray(wire[:, 0], np.int64)
         matched = (w >> 31) & 1

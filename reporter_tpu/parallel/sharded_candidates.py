@@ -11,8 +11,9 @@ merged candidates (reach tables replicated — node-keyed [N, M] and small
 relative to shape data).
 
 Segments of one edge may straddle a shard boundary; the merge dedupes by
-edge id keeping the closer projection, exactly as the in-kernel block
-merge does, so results match the unsharded sweep (up to distance ties).
+edge id keeping the closer projection with the dense kernel's own
+distance-tie resolution (``_select_topk``), so results are bit-identical
+to the unsharded sweep — including at exact ties (test-asserted).
 
 Collective traffic per batch: one all-gather of [shards, B·T, K] candidate
 triples over ICI — bytes ≈ shards × points × K × 12, tiny next to the
@@ -33,6 +34,7 @@ from reporter_tpu.ops.candidates import CandidateSet
 from reporter_tpu.ops.dense_candidates import (
     _SBLK,
     SegPack,
+    _select_topk,
     build_seg_pack,
     find_candidates_dense,
 )
@@ -84,29 +86,19 @@ def shard_tables(mesh: Mesh, ts: TileSet, axis: str = "tile",
 
 def _merge_topk(edge, dist, off, k: int):
     """Merge gathered per-shard K-lists: fields [shards, N, K] → [N, K].
-    Distinct-edge K-merge (same semantics as the dense kernel's block
-    merge): per pass pick the global min distance, drop every other entry
-    of that edge."""
+    Delegates to the dense kernel's ``_select_topk`` so the distinct-edge
+    K-merge and its distance-tie resolution (smallest tied edge id, then
+    its lowest tied offset) are ONE implementation: exact node-distance
+    ties at high-degree junctions can span shard boundaries, and any
+    drift here would let the sharded path pick a different tied edge than
+    the single-device sweep (test-asserted bit-identical)."""
     s, n, kk = edge.shape
     e = jnp.moveaxis(edge, 0, 1).reshape(n, s * kk)
     d = jnp.moveaxis(dist, 0, 1).reshape(n, s * kk)
     o = jnp.moveaxis(off, 0, 1).reshape(n, s * kk)
     d = jnp.where(e >= 0, d, jnp.float32(1e30))
-
-    cols = jnp.arange(s * kk, dtype=jnp.int32)[None, :]
-    outs_e, outs_d, outs_o = [], [], []
-    for _ in range(k):
-        m = jnp.min(d, axis=1, keepdims=True)
-        pick = jnp.min(jnp.where(d == m, cols, s * kk), axis=1, keepdims=True)
-        sel = cols == pick
-        e_k = jnp.max(jnp.where(sel, e, -(2 ** 31 - 1)), axis=1)
-        o_k = jnp.max(jnp.where(sel, o, -jnp.float32(1e30)), axis=1)
-        ok = m[:, 0] < 1e30
-        outs_e.append(jnp.where(ok, e_k, -1))
-        outs_d.append(jnp.where(ok, m[:, 0], 1e30))
-        outs_o.append(jnp.where(ok, o_k, 0.0))
-        d = jnp.where((e == e_k[:, None]) & ok[:, None], 1e30, d)
-    return (jnp.stack(outs_e, 1), jnp.stack(outs_d, 1), jnp.stack(outs_o, 1))
+    md, me, mo = _select_topk(d, e, o, k)
+    return me, md, mo
 
 
 def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,

@@ -262,6 +262,15 @@ class TestPackedU32Wire:
         np.testing.assert_array_equal(o3, o1)
         np.testing.assert_array_equal(s3, s1)
 
+    def test_u32_wire_without_spec_is_actionable(self):
+        """A u32 wire can't be unpacked without the spec it was packed
+        with — misuse must name wire_spec, not die on NoneType unpack."""
+        from reporter_tpu.ops.match import unpack_wire
+
+        wire = np.zeros((2, 1, 8), np.uint32)
+        with pytest.raises(ValueError, match="wire_spec"):
+            unpack_wire(wire)
+
     def test_wire_spec_boundaries(self):
         from reporter_tpu.ops.match import wire_spec
 

@@ -187,8 +187,9 @@ class TestDispatch:
 
 
 class TestShardedCandidates:
-    """Segment-table sharding (the TP analog): results must match the
-    unsharded matcher up to distance ties."""
+    """Segment-table sharding (the TP analog): results must be
+    bit-identical to the unsharded dense matcher, including at exact
+    distance ties (the merge reuses the kernel's _select_topk)."""
 
     def test_sharded_matches_unsharded(self, tiny_tiles):
         import jax
@@ -203,7 +204,10 @@ class TestShardedCandidates:
         )
 
         ts = tiny_tiles
-        params = MatcherParams()
+        # pin dense on the unsharded side: the sharded path sweeps dense
+        # per shard, and with the edge-id tie-break aligned in _merge_topk
+        # the two must agree EXACTLY, not just on >95% of points
+        params = MatcherParams(candidate_backend="dense")
         devices = jax.devices()[:8]
         mesh = make_mesh(tile=4, dp=2, devices=devices)
         step = make_sharded_matcher(mesh, ts, params, axis="tile")
@@ -218,8 +222,10 @@ class TestShardedCandidates:
 
         np.testing.assert_array_equal(np.asarray(out_s.matched),
                                       np.asarray(out_u.matched))
-        agree = (np.asarray(out_s.edge) == np.asarray(out_u.edge)).mean()
-        assert agree > 0.95, f"sharded vs unsharded agreement {agree:.3f}"
+        np.testing.assert_array_equal(np.asarray(out_s.edge),
+                                      np.asarray(out_u.edge))
+        np.testing.assert_allclose(np.asarray(out_s.offset),
+                                   np.asarray(out_u.offset), atol=1e-4)
 
 
 class TestDenseBackendSharded:
