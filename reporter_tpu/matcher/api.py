@@ -148,28 +148,88 @@ def _dijkstra_route_fn(ts: TileSet, bound: float,
     return route
 
 
+class _LocalWire:
+    """Single-device wire dispatch: the three jitted wire entries over
+    tables staged on the default device. Duck-type shared with
+    parallel.dp_e2e.DpWireMatcher (mesh-sharded rows) — _submit_many
+    speaks to whichever the matcher was constructed with."""
+
+    def __init__(self, tables, meta, params: MatcherParams,
+                 spec: "tuple | None"):
+        self.tables = tables
+        self.meta = meta
+        self.params = params
+        self.spec = spec
+
+    def f32(self, pts, lens, acc):
+        import jax.numpy as jnp
+
+        from reporter_tpu.ops.match import match_batch_wire
+        return match_batch_wire(
+            jnp.asarray(pts), jnp.asarray(lens), self.tables, self.meta,
+            self.params, None if acc is None else jnp.asarray(acc),
+            spec=self.spec)
+
+    def q16(self, pts_q, origins, lens, acc):
+        import jax.numpy as jnp
+
+        from reporter_tpu.ops.match import match_batch_wire_q
+        return match_batch_wire_q(
+            jnp.asarray(pts_q), jnp.asarray(origins), jnp.asarray(lens),
+            self.tables, self.meta, self.params,
+            None if acc is None else jnp.asarray(acc), spec=self.spec)
+
+    def q8(self, deltas_q, origins, lens, acc):
+        import jax.numpy as jnp
+
+        from reporter_tpu.ops.match import match_batch_wire_q8
+        return match_batch_wire_q8(
+            jnp.asarray(deltas_q), jnp.asarray(origins), jnp.asarray(lens),
+            self.tables, self.meta, self.params,
+            None if acc is None else jnp.asarray(acc), spec=self.spec)
+
+
 class SegmentMatcher:
-    """The backend boundary (reference: SegmentMatcher.Match, SURVEY §3.1)."""
+    """The backend boundary (reference: SegmentMatcher.Match, SURVEY §3.1).
+
+    ``mesh``: a jax.sharding.Mesh makes THIS matcher (and everything built
+    on it — ReporterApp, StreamPipeline) the multi-device product path:
+    every device dispatch shards batch rows over the mesh
+    (parallel/dp_e2e), while the host pipeline around it is unchanged and
+    the results are bit-identical to single-device (test-asserted).
+    jax backend only."""
 
     def __init__(self, tileset: TileSet, config: Config | None = None,
-                 metrics: MetricsRegistry | None = None):
+                 metrics: MetricsRegistry | None = None,
+                 mesh=None):
         self.ts = tileset
         self.config = (config or Config()).validate()
         self.params: MatcherParams = self.config.matcher
         self.metrics = metrics or MetricsRegistry()
         backend = self.config.matcher_backend
         self._native_walker = None
+        if mesh is not None and backend != "jax":
+            raise ValueError("mesh sharding requires matcher_backend='jax'")
         if backend == "jax":
-            # stage only the layout the resolved candidate backend sweeps
-            # (the unused one is the largest table at metro scale)
-            self._tables = tileset.device_tables(
-                self.params.candidate_backend)
             # packed-u32 result wire for big metros (ops.match.wire_spec):
             # -33% of the device→host bytes that bound big-tile decode
             from reporter_tpu.ops.match import wire_spec
             self._wire_spec = wire_spec(
                 tileset.num_edges,
                 float(tileset.edge_len.max()) if tileset.num_edges else 0.0)
+            if mesh is None:
+                # stage only the layout the resolved candidate backend
+                # sweeps (the unused one is the largest table at metro
+                # scale)
+                self._tables = tileset.device_tables(
+                    self.params.candidate_backend)
+                self._wire = _LocalWire(self._tables, self.ts.meta,
+                                        self.params, self._wire_spec)
+            else:
+                from reporter_tpu.parallel.dp_e2e import DpWireMatcher
+                self._wire = DpWireMatcher(mesh, tileset, self.params,
+                                           self._wire_spec)
+                self._tables = self._wire.tables    # mesh-replicated
             self._route_fn = reach_route_fn(tileset)
             # Native batch walker (walker.cc): same walk as build_segments
             # with the reach-table route_fn, multithreaded across traces.
@@ -326,11 +386,7 @@ class SegmentMatcher:
         submission order. Harvesting an inflight wire (np.asarray) blocks
         on the link; callers decide what to overlap with that wait.
         """
-        import jax.numpy as jnp
-
-        from reporter_tpu.ops.match import (OFFSET_QUANTUM, match_batch_wire,
-                                            match_batch_wire_q,
-                                            match_batch_wire_q8)
+        from reporter_tpu.ops.match import OFFSET_QUANTUM
 
         max_b = _BUCKETS[-1]
         # Traces beyond the largest bucket are decoded in consecutive chunks
@@ -398,7 +454,6 @@ class SegmentMatcher:
                     if a is not None:
                         scale[r] = _accuracy_scale(
                             a[lo:lo + len(xy)], self.params.sigma_z, b)
-            acc_scale = None if scale is None else jnp.asarray(scale)
             origins = pts[:, 0, :].copy()
             dq = np.round((pts - origins[:, None, :])
                           * np.float32(1.0 / OFFSET_QUANTUM))
@@ -415,22 +470,13 @@ class SegmentMatcher:
                 d8 = np.diff(dqi, axis=1, prepend=dqi[:, :1] * 0)
                 d8[np.arange(b)[None, :] >= lens[:, None]] = 0
                 if np.abs(d8).max(initial=0) < 128:
-                    wire = match_batch_wire_q8(
-                        jnp.asarray(d8.astype(np.int8)),
-                        jnp.asarray(origins), jnp.asarray(lens),
-                        self._tables, self.ts.meta, self.params, acc_scale,
-                        spec=self._wire_spec)
+                    wire = self._wire.q8(d8.astype(np.int8), origins, lens,
+                                         scale)
                 else:
-                    wire = match_batch_wire_q(
-                        jnp.asarray(dqi.astype(np.int16)),
-                        jnp.asarray(origins), jnp.asarray(lens),
-                        self._tables, self.ts.meta, self.params, acc_scale,
-                        spec=self._wire_spec)
+                    wire = self._wire.q16(dqi.astype(np.int16), origins,
+                                          lens, scale)
             else:
-                wire = match_batch_wire(
-                    jnp.asarray(pts), jnp.asarray(lens),
-                    self._tables, self.ts.meta, self.params, acc_scale,
-                    spec=self._wire_spec)
+                wire = self._wire.f32(pts, lens, scale)
             inflight.append((ws, wire))
         return work, inflight
 
@@ -446,7 +492,8 @@ class SegmentMatcher:
         # slice k runs in a worker thread while slice k+1's wire bytes
         # stream back over the link.
         def split_slice(_k, ws, arr):
-            edges, offs, starts = unpack_wire(arr, self._wire_spec)
+            # mesh path pads rows to a device-count multiple: drop them
+            edges, offs, starts = unpack_wire(arr[:len(ws)], self._wire_spec)
             for r, w in enumerate(ws):
                 i, lo, xy = work[w]
                 T = len(xy)
@@ -493,7 +540,8 @@ class SegmentMatcher:
 
         def walk_slice(k, ws, arr):
             nonlocal unmatched
-            edges, offs, starts = unpack_wire(arr, self._wire_spec)
+            # mesh path pads rows to a device-count multiple: drop them
+            edges, offs, starts = unpack_wire(arr[:len(ws)], self._wire_spec)
             B, T = edges.shape
             times = np.zeros((B, T), np.float64)
             pad = 0

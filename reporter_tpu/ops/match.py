@@ -135,23 +135,23 @@ def match_batch(points, valid_pt, tables: dict[str, Any], meta: TileMeta,
 OFFSET_QUANTUM = 0.25
 
 
-@functools.partial(jax.jit, static_argnames=("meta", "params", "spec"))
-def match_batch_wire(points, lengths, tables: dict[str, Any], meta: TileMeta,
-                     params: MatcherParams, acc_scale=None, spec=None):
+def wire_from_f32(points, lengths, tables: dict[str, Any], meta: TileMeta,
+                  params: MatcherParams, acc_scale=None, spec=None):
     """points f32 [B, T, 2], lengths i32 [B] (valid prefix per trace) →
     u16 [B, 2|3, T] wire array; unpack with unpack_wire(). acc_scale: see
     match_traces (None traces a separate, scale-free executable, so
-    accuracy-less batches pay nothing)."""
+    accuracy-less batches pay nothing). Undecorated body: jit via
+    match_batch_wire, or wrap in shard_map (parallel/dp_e2e) — the SAME
+    device program serves both so the sharded product path cannot drift."""
     T = points.shape[1]
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
     out = match_traces(points, valid, tables, meta, params, acc_scale)
     return _pack_wire(out, tables["edge_len"].shape[0], spec)
 
 
-@functools.partial(jax.jit, static_argnames=("meta", "params", "spec"))
-def match_batch_wire_q(points_q, origins, lengths, tables: dict[str, Any],
-                       meta: TileMeta, params: MatcherParams, acc_scale=None,
-                       spec=None):
+def wire_from_q16(points_q, origins, lengths, tables: dict[str, Any],
+                  meta: TileMeta, params: MatcherParams, acc_scale=None,
+                  spec=None):
     """Quantized-input variant: points_q i16 [B, T, 2] are 0.25 m
     fixed-point offsets from per-trace origins f32 [B, 2] (host→device
     bytes halve vs f32; 0.125 m quantization ≪ sigma_z). Traces spanning
@@ -165,10 +165,9 @@ def match_batch_wire_q(points_q, origins, lengths, tables: dict[str, Any],
     return _pack_wire(out, tables["edge_len"].shape[0], spec)
 
 
-@functools.partial(jax.jit, static_argnames=("meta", "params", "spec"))
-def match_batch_wire_q8(deltas_q, origins, lengths, tables: dict[str, Any],
-                        meta: TileMeta, params: MatcherParams,
-                        acc_scale=None, spec=None):
+def wire_from_q8(deltas_q, origins, lengths, tables: dict[str, Any],
+                 meta: TileMeta, params: MatcherParams,
+                 acc_scale=None, spec=None):
     """Delta-quantized input: deltas_q i8 [B, T, 2] are the per-step
     DIFFERENCES of the i16 0.25 m quanta (first step 0 — the origin is
     the first point). Integer cumsum reconstructs the i16 absolutes
@@ -185,6 +184,14 @@ def match_batch_wire_q8(deltas_q, origins, lengths, tables: dict[str, Any],
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
     out = match_traces(points, valid, tables, meta, params, acc_scale)
     return _pack_wire(out, tables["edge_len"].shape[0], spec)
+
+
+match_batch_wire = functools.partial(
+    jax.jit, static_argnames=("meta", "params", "spec"))(wire_from_f32)
+match_batch_wire_q = functools.partial(
+    jax.jit, static_argnames=("meta", "params", "spec"))(wire_from_q16)
+match_batch_wire_q8 = functools.partial(
+    jax.jit, static_argnames=("meta", "params", "spec"))(wire_from_q8)
 
 
 # Compact 2-lane format: metros under _COMPACT_WIRE_EDGES directed edges

@@ -17,6 +17,7 @@ inserts the collectives.
 
 from reporter_tpu.parallel.mesh import make_mesh
 from reporter_tpu.parallel.dp import make_dp_matcher
+from reporter_tpu.parallel.dp_e2e import DpWireMatcher
 from reporter_tpu.parallel.sharded_candidates import make_sharded_matcher
 from reporter_tpu.parallel.multimetro import (
     MetroBatch,
@@ -27,6 +28,7 @@ from reporter_tpu.parallel.multimetro import (
 )
 
 __all__ = [
+    "DpWireMatcher",
     "make_sharded_matcher",
     "make_mesh",
     "make_dp_matcher",

@@ -108,13 +108,17 @@ def _validate_payload(payload: Any,
 
 
 class ReporterApp:
-    """Request pipeline around a SegmentMatcher (any backend)."""
+    """Request pipeline around a SegmentMatcher (any backend).
+
+    ``mesh``: deploy this app's matcher across a device mesh (dp-sharded
+    dispatches, parallel/dp_e2e); the request pipeline, cache, and report
+    build are unchanged and results are bit-identical to single-device."""
 
     def __init__(self, tileset: TileSet, config: Config | None = None,
-                 transport: Transport | None = None):
+                 transport: Transport | None = None, mesh=None):
         self.config = (config or Config()).validate()
         svc = self.config.service
-        self.matcher = SegmentMatcher(tileset, self.config)
+        self.matcher = SegmentMatcher(tileset, self.config, mesh=mesh)
         self.cache = PartialTraceCache(ttl=svc.cache_ttl,
                                        max_uuids=svc.cache_max_uuids)
         self.publisher = DatastorePublisher(url=svc.datastore_url,
@@ -329,6 +333,6 @@ def _respond(start_response: Callable, status: int, payload: dict):
 
 
 def make_app(tileset: TileSet, config: Config | None = None,
-             transport: Transport | None = None) -> ReporterApp:
+             transport: Transport | None = None, mesh=None) -> ReporterApp:
     """Construct the WSGI app (reference: service init, SURVEY.md §3.2)."""
-    return ReporterApp(tileset, config, transport)
+    return ReporterApp(tileset, config, transport, mesh=mesh)
