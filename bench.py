@@ -371,6 +371,162 @@ def _streaming_bench(ts, traces, n_stream: int) -> dict:
     }
 
 
+def _stage_round_batches(ts, traces, n_stream: int, steps_per_batch: int):
+    """Pre-stage the firehose as ProbeColumns round batches (producer side,
+    untimed): every vehicle's point k before any point k+1, steps_per_batch
+    time-steps per batch."""
+    import numpy as np
+
+    from reporter_tpu.geometry import xy_to_lonlat
+    from reporter_tpu.streaming.columnar import ProbeColumns
+
+    sub = traces[:n_stream]
+    origin = np.asarray(ts.meta.origin_lonlat)
+    n_pts = len(sub[0].xy)
+    V = len(sub)
+    uuids = np.array([t.uuid for t in sub])
+    lonlat = np.stack([xy_to_lonlat(np.asarray(t.xy, np.float64), origin)
+                       for t in sub])                      # [V, T, 2]
+    times = np.stack([np.asarray(t.times, np.float64) for t in sub])
+    batches = []
+    for lo in range(0, n_pts, steps_per_batch):
+        hi = min(n_pts, lo + steps_per_batch)
+        k = hi - lo
+        u = np.repeat(uuids[None, :], k, 0).ravel()
+        ll = lonlat[:, lo:hi].transpose(1, 0, 2).reshape(-1, 2)
+        tt = times[:, lo:hi].T.ravel()
+        batches.append(ProbeColumns(u, ll[:, 1].copy(), ll[:, 0].copy(),
+                                    tt.copy(),
+                                    np.full(k * V, np.nan, np.float32)))
+    return batches, V, n_pts
+
+
+def _streaming_columnar_bench(ts, traces, n_stream: int) -> dict:
+    """config 5, columnar worker (streaming/columnar.py — VERDICT r4 #2):
+    the same firehose as _streaming_bench through ColumnarStreamPipeline.
+    Producer pre-staged untimed; the measured system is batch poll →
+    columnar consume → flush (device match → vectorized report build →
+    histograms)."""
+    from reporter_tpu.config import Config, StreamingConfig
+    from reporter_tpu.streaming.columnar import (ColumnarIngestQueue,
+                                                 ColumnarStreamPipeline)
+
+    batches, V, n_pts = _stage_round_batches(ts, traces, n_stream,
+                                             steps_per_batch=10)
+    queue = ColumnarIngestQueue(4)
+    for b in batches:
+        queue.append_columns(b)
+    cfg = Config(matcher_backend="jax",
+                 streaming=StreamingConfig(flush_min_points=40,
+                                           poll_max_records=300_000,
+                                           hist_flush_interval=0.0))
+    pipe = ColumnarStreamPipeline(ts, cfg, queue=queue)
+    t0 = time.perf_counter()
+    reports = 0
+    while queue.lag(pipe.committed) > 0:
+        before = queue.lag(pipe.committed)
+        reports += pipe.step()
+        if queue.lag(pipe.committed) >= before:
+            break
+    reports += pipe.drain()
+    flushed = pipe.flush_histograms()
+    dt = time.perf_counter() - t0
+    probes = V * n_pts
+    st = pipe.stats()
+    return {
+        "config": (f"{V} vehicles x {n_pts}pt columnar firehose, "
+                   f"tile={ts.name}"),
+        "probes_per_sec": round(probes / dt, 1),
+        "reports": int(reports),
+        "steps": pipe.steps,
+        "match_seconds": round(st["match_seconds"], 3),
+        "host_seconds": round(dt - st["match_seconds"], 3),
+        "hist_segments_flushed": int(flushed),
+        "hist_rows_nonzero": st["hist_rows"],
+        "seconds": round(dt, 3),
+    }
+
+
+def _streaming_soak(ts, traces, n_stream: int, seconds: float = 32.0,
+                    offered_pps: int = 250_000) -> dict:
+    """Steady-arrival soak (VERDICT r4 next #2): a paced producer offers
+    ``offered_pps`` into the columnar broker while the worker polls,
+    flushes, and truncates retention, for ≥30 s of wall clock. Reports
+    sustained consume rate, end/max lag (bounded lag == keeping up), and
+    the p50/p99 consume→report latency over every flushed probe (buffer
+    wait + device match; arrival-to-consume is ≤ one step in this
+    single-threaded drive)."""
+    import numpy as np
+
+    from reporter_tpu.config import Config, StreamingConfig
+    from reporter_tpu.streaming.columnar import (ColumnarIngestQueue,
+                                                 ColumnarStreamPipeline)
+
+    batches, V, n_pts = _stage_round_batches(ts, traces, n_stream,
+                                             steps_per_batch=2)
+    cycle_span = float(n_pts)       # shift times each replay cycle so a
+    #                                 vehicle's stream keeps moving forward
+    queue = ColumnarIngestQueue(4)
+    cfg = Config(matcher_backend="jax",
+                 streaming=StreamingConfig(flush_min_points=40,
+                                           poll_max_records=300_000,
+                                           hist_flush_interval=0.0))
+    pipe = ColumnarStreamPipeline(ts, cfg, queue=queue)
+    lat_chunks = []
+    max_lag = 0
+    produced = 0
+    bi = 0
+    t0 = time.perf_counter()
+    deadline = t0 + seconds
+    while True:
+        now = time.perf_counter()
+        if now >= deadline:
+            break
+        # pace: stay at or below the offered cumulative probe count
+        while produced < (now - t0) * offered_pps:
+            b = batches[bi % len(batches)]
+            cyc = bi // len(batches)
+            if cyc:
+                b = b._replace(time=b.time + cyc * cycle_span)
+            queue.append_columns(b)
+            produced += b.n
+            bi += 1
+            now = time.perf_counter()
+        pipe.step()
+        if pipe.last_flush_latency is not None:
+            lat_chunks.append(pipe.last_flush_latency)
+            pipe.last_flush_latency = None
+        lag = queue.lag(pipe.committed)
+        max_lag = max(max_lag, lag)
+        if pipe.steps % 32 == 0:
+            queue.truncate(pipe.committed)   # broker retention
+    dt = time.perf_counter() - t0
+    st = pipe.stats()
+    # exact probes taken off the broker (committed floor); counting
+    # matched+buffered instead would double-count cache-tail points that
+    # re-enter each flush's merged trace
+    consumed = int(sum(pipe.committed))
+    lat = (np.concatenate(lat_chunks) if lat_chunks
+           else np.zeros(1))
+    return {
+        "config": (f"{V} vehicles, offered {offered_pps / 1e3:.0f}k pps "
+                   f"for {seconds:.0f}s, tile={ts.name}"),
+        "seconds": round(dt, 1),
+        "offered_pps": offered_pps,
+        "produced_probes": int(produced),
+        "consumed_probes": int(consumed),
+        "sustained_pps": round(consumed / dt, 1),
+        "end_lag": int(queue.lag(pipe.committed)),
+        "max_lag": int(max_lag),
+        "reports": st["reports"],
+        "p50_probe_to_report_ms": round(float(np.median(lat)) * 1e3, 1),
+        "p99_probe_to_report_ms": round(
+            float(np.percentile(lat, 99)) * 1e3, 1),
+        "latency_samples": int(lat.size),
+        "match_seconds": round(st["match_seconds"], 2),
+    }
+
+
 def _device_compute_probe(m, traces, link_rtt: float) -> dict:
     """Device-only decode rate (VERDICT r3 #6): stage one full uniform
     slice's quantized inputs on the device, dispatch the match kernel K
@@ -802,18 +958,33 @@ def main() -> None:
         audit_total = sum(v["traces"] for v in audit.values())
         detail["audit"] = {"total_traces": audit_total, "per_tile": audit}
 
-        # -- streaming path (BASELINE config 5, VERDICT r4 #4) -------------
-        # Best of two full pumps: a single multi-second link stall inside
-        # one flush wave once recorded 2.1k pps for a leg that otherwise
-        # reads 50-65k — the same best-of-N discipline as every tile.
+        # -- streaming path (BASELINE config 5) ----------------------------
+        # detail.streaming = the COLUMNAR worker (the firehose deployment
+        # shape, r5); the dict worker stays as streaming_dict for the
+        # compat surface. Best of two full pumps: a single multi-second
+        # link stall inside one flush wave once recorded 2.1k pps for a
+        # leg that otherwise reads 50-65k — same best-of-N as every tile.
         t0 = time.perf_counter()
-        s_runs = [_streaming_bench(ts, traces, n_stream=2000)
+        s_runs = [_streaming_columnar_bench(ts, traces, n_stream=2000)
                   for _ in range(2)]
         detail["streaming"] = max(s_runs,
                                   key=lambda r: r["probes_per_sec"])
         detail["streaming"]["runs_pps"] = [r["probes_per_sec"]
                                            for r in s_runs]
+        sd_runs = [_streaming_bench(ts, traces, n_stream=2000)
+                   for _ in range(2)]
+        detail["streaming_dict"] = max(sd_runs,
+                                       key=lambda r: r["probes_per_sec"])
+        detail["streaming_dict"]["runs_pps"] = [r["probes_per_sec"]
+                                                for r in sd_runs]
         split["streaming_s"] = round(time.perf_counter() - t0, 1)
+
+        # -- streaming soak (VERDICT r4 next #2): ≥30 s steady arrival,
+        # bounded lag, p50 probe→report latency ---------------------------
+        t0 = time.perf_counter()
+        detail["streaming_soak"] = _streaming_soak(ts, traces,
+                                                   n_stream=2000)
+        split["streaming_soak_s"] = round(time.perf_counter() - t0, 1)
 
         # -- device-only compute (VERDICT r4 #6): makes the "link-bound,
         # not chip-bound" claim a measured field. Best of two probes:
@@ -970,6 +1141,10 @@ def _summary_line(doc: dict) -> dict:
              ("organic-xl", "organic_xl"))
             if _g(k2, "reach_audit", "step_miss_rate") is not None},
         "streaming_pps": _g("streaming", "probes_per_sec"),
+        # dict-pipeline pps + soak p99/offered/duration live in the detail
+        # file only: the FINAL line must stay under the driver's ~1 KB tail
+        "soak": {k: _g("streaming_soak", k) for k in
+                 ("sustained_pps", "end_lag", "p50_probe_to_report_ms")},
         "colocated_pps": _g("device_compute", "colocated_probes_per_sec"),
         "device_ms_per_dispatch": _g("device_compute",
                                      "device_ms_per_dispatch"),

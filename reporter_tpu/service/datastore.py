@@ -56,24 +56,45 @@ class DatastorePublisher:
             log.debug("datastore disabled; dropping %d reports on the floor",
                       len(reports))
             return True
+        return self._post([r.to_json() for r in reports])
+
+    def publish_columns(self, seg, nxt, t0, t1, length, queue) -> bool:
+        """Columnar publish: the same ``{"mode", "reports": [...]}``
+        payload as publish(), built straight from report columns
+        (streaming/columnar.py) — no per-Report objects. ``nxt`` uses -1
+        for "exit to unknown" (serialized as null, like Report.to_json)."""
+        if not len(seg):
+            return True
+        if not self.url:
+            log.debug("datastore disabled; dropping %d reports on the floor",
+                      len(seg))
+            return True
+        rows = [{"id": s, "next_id": (None if x < 0 else x),
+                 "t0": a, "t1": b, "length": ln, "queue_length": q}
+                for s, x, a, b, ln, q in zip(
+                    seg.tolist(), nxt.tolist(), t0.tolist(), t1.tolist(),
+                    length.tolist(), queue.tolist())]
+        return self._post(rows)
+
+    def _post(self, report_rows: list[dict]) -> bool:
         payload = json.dumps({
             "mode": self.mode,
-            "reports": [r.to_json() for r in reports],
+            "reports": report_rows,
         }).encode()
         self.requests += 1
         try:
             status = self._transport(self.url, payload)
         except (urllib.error.URLError, OSError, TimeoutError) as exc:
             log.warning("datastore POST failed: %s (%d reports dropped)",
-                        exc, len(reports))
-            self.dropped += len(reports)
+                        exc, len(report_rows))
+            self.dropped += len(report_rows)
             return False
         if 200 <= status < 300:
-            self.published += len(reports)
+            self.published += len(report_rows)
             return True
         log.warning("datastore POST returned %d (%d reports dropped)",
-                    status, len(reports))
-        self.dropped += len(reports)
+                    status, len(report_rows))
+        self.dropped += len(report_rows)
         return False
 
     def publish_json(self, payload: dict) -> bool:

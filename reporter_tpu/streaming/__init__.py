@@ -16,6 +16,13 @@ checkpoints offsets + buffers + histograms for crash recovery
 """
 
 from reporter_tpu.streaming.broker import ProbeConsumer
+from reporter_tpu.streaming.columnar import (
+    ColumnarIngestQueue,
+    ColumnarStreamPipeline,
+    ColumnarTraceCache,
+    ProbeColumns,
+    pack_records,
+)
 from reporter_tpu.streaming.formatter import ProbeFormatter
 from reporter_tpu.streaming.queue import IngestQueue
 from reporter_tpu.streaming.durable_queue import DurableIngestQueue
@@ -23,6 +30,8 @@ from reporter_tpu.streaming.histogram import SpeedHistogram
 from reporter_tpu.streaming.pipeline import StreamPipeline
 from reporter_tpu.streaming.worker import StreamWorker
 
-__all__ = ["DurableIngestQueue", "IngestQueue", "ProbeConsumer",
-           "ProbeFormatter", "SpeedHistogram", "StreamPipeline",
-           "StreamWorker"]
+__all__ = ["ColumnarIngestQueue", "ColumnarStreamPipeline",
+           "ColumnarTraceCache", "DurableIngestQueue", "IngestQueue",
+           "ProbeColumns", "ProbeConsumer", "ProbeFormatter",
+           "SpeedHistogram", "StreamPipeline", "StreamWorker",
+           "pack_records"]

@@ -1,0 +1,779 @@
+"""Columnar streaming ingest — the config-5 throughput path.
+
+The dict-based StreamPipeline (streaming/pipeline.py) is semantically
+complete but host-bound: at firehose rates the per-record Python dict
+handling in poll → _consume → _flush → report build costs more than the
+device match itself (VERDICT r4 missing #2: 71.6k probes/s vs 2.19M on
+the batch path). This module re-plumbs the SAME pipeline semantics as
+numpy record batches end to end:
+
+  ProbeColumns            one batch of probes as flat columns
+  ColumnarIngestQueue     partitioned offset log storing column batches
+                          (ProbeConsumer-compatible via a dict-poll shim)
+  ColumnarTraceCache      per-uuid trailing points as arrays (the
+                          PartialTraceCache semantics, columnar storage)
+  ColumnarStreamPipeline  consume/flush/report/histogram with per-RECORD
+                          Python eliminated: uuid interning at np.unique
+                          speed, per-code counters, one lonlat→xy batch
+                          conversion per flush, the matcher's columnar
+                          MatchBatch, and a vectorized report builder
+                          (group-id chaining replaces the per-record
+                          state machine in service/reports.build_reports)
+
+Behavior parity with the dict pipeline — reports, histograms, commit
+floors, malformed counts, cache contents, checkpoint format — is
+test-asserted on identical streams (tests/test_streaming_columnar.py).
+The per-record path stays the compatibility surface for external brokers;
+this is the deployment shape for sustained firehose rates.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, NamedTuple, Sequence
+
+import numpy as np
+
+from reporter_tpu.config import Config
+from reporter_tpu.geometry import lonlat_to_xy
+from reporter_tpu.matcher.api import MatchBatch, SegmentMatcher, Trace
+from reporter_tpu.service.datastore import DatastorePublisher, Transport
+from reporter_tpu.streaming.histogram import SpeedHistogram
+from reporter_tpu.streaming.queue import partition_of
+from reporter_tpu.tiles.tileset import TileSet
+
+
+# ---------------------------------------------------------------------------
+# Probe batches
+
+
+class ProbeColumns(NamedTuple):
+    """One batch of canonical probe records as flat columns. NaN marks an
+    absent time/accuracy (the canonical dict shape simply omits the key)."""
+
+    uuid: np.ndarray       # str_ [N]
+    lat: np.ndarray        # f64 [N]
+    lon: np.ndarray        # f64 [N]
+    time: np.ndarray       # f64 [N]; NaN ⇒ absent (index seconds assigned)
+    accuracy: np.ndarray   # f32 [N]; NaN ⇒ absent
+
+    @property
+    def n(self) -> int:    # NamedTuple.__len__ is the field count
+        return len(self.lat)
+
+    def rows(self, idx) -> "ProbeColumns":
+        return ProbeColumns(*(a[idx] for a in self))
+
+
+def empty_probe_columns() -> ProbeColumns:
+    return ProbeColumns(np.empty(0, np.str_), np.empty(0), np.empty(0),
+                        np.empty(0), np.empty(0, np.float32))
+
+
+def pack_records(records: Sequence[dict]) -> ProbeColumns:
+    """Canonical record dicts → one column batch (compatibility path for
+    dict producers; columnar producers build ProbeColumns directly)."""
+    n = len(records)
+    uuid = np.array([str(r.get("uuid", "")) for r in records])
+    lat = np.full(n, np.nan)
+    lon = np.full(n, np.nan)
+    t = np.full(n, np.nan)
+    acc = np.full(n, np.nan, np.float32)
+    for i, r in enumerate(records):
+        try:
+            lat[i] = float(r["lat"])
+            lon[i] = float(r["lon"])
+        except (KeyError, TypeError, ValueError):
+            continue                      # row stays NaN ⇒ malformed
+        if "time" in r:
+            try:
+                t[i] = float(r["time"])
+            except (TypeError, ValueError):
+                lat[i] = np.nan           # dict pipeline treats a bad
+                continue                  # time as a poison record
+        if "accuracy" in r:
+            try:
+                acc[i] = float(r["accuracy"])
+            except (TypeError, ValueError):
+                pass                      # advisory field: drop it, keep
+                                          # the point (dict-path parity)
+    if n and uuid.dtype == object:
+        uuid = uuid.astype(np.str_)
+    return ProbeColumns(uuid, lat, lon, t, acc)
+
+
+# ---------------------------------------------------------------------------
+# Columnar broker
+
+
+class ColumnarIngestQueue:
+    """Partitioned offset log whose unit of storage is a column batch.
+
+    Offset semantics are identical to IngestQueue (dense per-partition
+    offsets, replayable, LookupError below the retention floor —
+    streaming/broker.py); ``poll`` materializes dicts for per-record
+    consumers, ``poll_batch`` hands column slices to the columnar
+    pipeline without touching Python objects per record."""
+
+    def __init__(self, num_partitions: int = 4):
+        self.num_partitions = int(num_partitions)
+        # per partition: parallel lists of batch base offsets and batches
+        self._bases: list[list[int]] = [[] for _ in range(self.num_partitions)]
+        self._batches: list[list[ProbeColumns]] = [
+            [] for _ in range(self.num_partitions)]
+        self._end = [0] * self.num_partitions
+        self._floor = [0] * self.num_partitions
+        self._lock = threading.Lock()
+
+    # ---- producer surface ----------------------------------------------
+
+    def append_columns(self, cols: ProbeColumns) -> None:
+        """Route a batch's rows to uuid-hash partitions (vectorized at
+        unique-uuid granularity) and append one sub-batch per partition."""
+        if not cols.n:
+            return
+        uniq, inv = np.unique(cols.uuid, return_inverse=True)
+        pu = np.array([partition_of(str(u), self.num_partitions)
+                       for u in uniq], np.int32)
+        prow = pu[inv]
+        with self._lock:
+            for p in range(self.num_partitions):
+                idx = np.nonzero(prow == p)[0]
+                if not len(idx):
+                    continue
+                self._bases[p].append(self._end[p])
+                self._batches[p].append(cols.rows(idx))
+                self._end[p] += len(idx)
+
+    def append(self, record: dict) -> None:
+        self.append_columns(pack_records([record]))
+
+    def append_many(self, records: Sequence[dict]) -> None:
+        self.append_columns(pack_records(records))
+
+    # ---- consumer surface ----------------------------------------------
+
+    def poll_batch(self, partition: int, offset: int, max_records: int,
+                   ) -> "list[tuple[int, ProbeColumns]]":
+        """Column slices covering [offset, offset+max_records), in offset
+        order: [(base_offset, columns)…]."""
+        with self._lock:
+            if offset < self._floor[partition]:
+                raise LookupError(
+                    f"offset {offset} below retention floor "
+                    f"{self._floor[partition]} (partition {partition})")
+            bases = self._bases[partition]
+            batches = self._batches[partition]
+            out: list[tuple[int, ProbeColumns]] = []
+            k = bisect.bisect_right(bases, offset) - 1
+            if k < 0:
+                k = 0
+            left = max_records
+            while k < len(bases) and left > 0:
+                base, b = bases[k], batches[k]
+                lo = max(0, offset - base)
+                hi = min(b.n, lo + left)
+                if lo < hi:
+                    sl = b if (lo == 0 and hi == b.n) else b.rows(
+                        slice(lo, hi))
+                    out.append((base + lo, sl))
+                    left -= hi - lo
+                k += 1
+            return out
+
+    def poll(self, partition: int, offset: int,
+             max_records: int) -> "list[tuple[int, dict]]":
+        """Per-record compatibility shim (ProbeConsumer protocol)."""
+        out: list[tuple[int, dict]] = []
+        for base, cols in self.poll_batch(partition, offset, max_records):
+            for i in range(cols.n):
+                rec = {"uuid": str(cols.uuid[i]), "lat": float(cols.lat[i]),
+                       "lon": float(cols.lon[i])}
+                if np.isfinite(cols.time[i]):
+                    rec["time"] = float(cols.time[i])
+                if np.isfinite(cols.accuracy[i]):
+                    rec["accuracy"] = float(cols.accuracy[i])
+                out.append((base + i, rec))
+        return out
+
+    def end_offset(self, partition: int) -> int:
+        with self._lock:
+            return self._end[partition]
+
+    def lag(self, committed: Sequence[int]) -> int:
+        return sum(self.end_offset(p) - committed[p]
+                   for p in range(self.num_partitions))
+
+    def truncate(self, committed: Sequence[int]) -> None:
+        """Drop whole batches entirely below the committed offsets. The
+        retention floor advances to the first RETAINED offset (a batch
+        straddling the commit keeps its early rows pollable)."""
+        with self._lock:
+            for p, off in enumerate(committed):
+                bases, batches = self._bases[p], self._batches[p]
+                k = 0
+                while k < len(bases) and bases[k] + batches[k].n <= off:
+                    k += 1
+                if k:
+                    self._bases[p] = bases[k:]
+                    self._batches[p] = batches[k:]
+                new_floor = (self._bases[p][0] if self._bases[p]
+                             else min(off, self._end[p]))
+                self._floor[p] = max(self._floor[p], new_floor)
+
+
+# ---------------------------------------------------------------------------
+# Columnar per-uuid tail cache
+
+
+class _TailEntry:
+    __slots__ = ("lat", "lon", "time", "acc", "wall")
+
+    def __init__(self, lat, lon, time_, acc, wall):
+        self.lat, self.lon, self.time, self.acc = lat, lon, time_, acc
+        self.wall = wall
+
+
+class ColumnarTraceCache:
+    """PartialTraceCache semantics (TTL + LRU + straddling-tail retention,
+    service/cache.py) with the per-uuid points stored as numpy arrays.
+    dump()/load() speak the dict cache's checkpoint schema, so a
+    checkpoint written by either pipeline restores into the other."""
+
+    def __init__(self, ttl: float = 60.0, max_uuids: int = 100_000,
+                 max_points: int = 256, clock=time.monotonic):
+        from collections import OrderedDict
+
+        self.ttl = float(ttl)
+        self.max_uuids = int(max_uuids)
+        self.max_points = int(max_points)
+        self._clock = clock
+        self._entries: "OrderedDict[str, _TailEntry]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def merge(self, uuid: str, lat, lon, time_, acc):
+        """(cached tail ⊕ new rows) deduped by timestamp, time-ascending —
+        exactly PartialTraceCache.merge, on arrays. Callers pass new rows
+        time-sorted (the pipeline lexsorts the flush), and entries store
+        sorted tails, so the common streaming case — every new timestamp
+        past the cached tail — is a plain concat with no dedup/sort."""
+        e = self._entries.get(uuid)
+        if e is not None and self._clock() - e.wall > self.ttl:
+            del self._entries[uuid]
+            e = None
+        if e is None:
+            return lat, lon, time_, acc
+        if len(time_) and e.time[-1] < time_[0]:
+            return (np.concatenate([e.lat, lat]),
+                    np.concatenate([e.lon, lon]),
+                    np.concatenate([e.time, time_]),
+                    np.concatenate([e.acc, acc]))
+        fresh = ~np.isin(time_, e.time)
+        lat = np.concatenate([e.lat, lat[fresh]])
+        lon = np.concatenate([e.lon, lon[fresh]])
+        t = np.concatenate([e.time, time_[fresh]])
+        acc = np.concatenate([e.acc, acc[fresh]])
+        order = np.argsort(t, kind="stable")
+        return lat[order], lon[order], t[order], acc[order]
+
+    def retain(self, uuid: str, lat, lon, time_, acc,
+               from_time: float) -> None:
+        """Keep rows from one before the first time >= from_time (the
+        straddling pair rule of PartialTraceCache.retain)."""
+        at = np.nonzero(time_ >= from_time)[0]
+        cut = max(0, int(at[0]) - 1) if len(at) else max(0, len(time_) - 1)
+        lo = max(cut, len(time_) - self.max_points)
+        if lo >= len(time_):
+            self._entries.pop(uuid, None)
+            return
+        self._entries[uuid] = _TailEntry(
+            lat[lo:].copy(), lon[lo:].copy(), time_[lo:].copy(),
+            acc[lo:].copy(), self._clock())
+        self._entries.move_to_end(uuid)
+        self._evict()
+
+    def dump(self) -> dict:
+        now = self._clock()
+        out = {}
+        for u, e in self._entries.items():
+            pts = []
+            for i in range(len(e.time)):
+                p = {"lat": float(e.lat[i]), "lon": float(e.lon[i]),
+                     "time": float(e.time[i])}
+                if np.isfinite(e.acc[i]):
+                    p["accuracy"] = float(e.acc[i])
+                pts.append(p)
+            out[u] = {"points": pts, "age": now - e.wall}
+        return out
+
+    def load(self, state: dict, extra_age: float = 0.0) -> None:
+        now = self._clock()
+        self._entries.clear()
+        for u, rec in sorted(state.items(), key=lambda kv: -kv[1]["age"]):
+            age = float(rec["age"]) + extra_age
+            pts = rec["points"]
+            if age > self.ttl or not pts:
+                continue
+            cols = pack_records(pts)
+            self._entries[u] = _TailEntry(cols.lat, cols.lon, cols.time,
+                                          cols.accuracy, now - age)
+        self._evict()
+
+    def _evict(self) -> None:
+        now = self._clock()
+        while self._entries:
+            _, e = next(iter(self._entries.items()))
+            if now - e.wall <= self.ttl:
+                break
+            self._entries.popitem(last=False)
+        while len(self._entries) > self.max_uuids:
+            self._entries.popitem(last=False)
+
+
+# ---------------------------------------------------------------------------
+# Vectorized report building
+
+
+def build_report_columns(cols, n_traces: "int | None", min_length: float):
+    """service/reports.build_reports, vectorized over RecordColumns.
+
+    The per-record state machine becomes a group-id computation: a chain
+    boundary between consecutive records survives iff the records are
+    time-adjacent (|t0[r+1] − t1[r]| < 1e-3, within one trace) and the
+    next record can carry the run (reportable, or a complete internal
+    connector). Records sharing a group id are one unbroken run, so each
+    reportable record's ``next_segment_id`` is simply the next reportable
+    record in its group. Parity with the scalar builder is test-asserted.
+
+    Returns (seg i64[R], next i64[R] (-1 ⇒ None), t0, t1, length, queue
+    f64[R], per_trace_counts i64[n_traces] | None). ``n_traces=None``
+    skips the per-trace bincount (the flush hot path doesn't use it).
+    """
+    n = cols.n_records
+    if not n:
+        z = np.empty(0, np.int64)
+        zf = np.empty(0)
+        return z, z, zf, zf, zf, zf, (
+            None if n_traces is None else np.zeros(n_traces, np.int64))
+    complete = (cols.start_time >= 0.0) & (cols.end_time >= 0.0)
+    reportable = complete & ~cols.internal & (cols.length >= min_length)
+    carry = reportable | (cols.internal & complete)
+    same_trace = cols.trace[1:] == cols.trace[:-1]
+    adj = np.abs(cols.start_time[1:] - cols.end_time[:-1]) < 1e-3
+    link = same_trace & adj & carry[1:] & carry[:-1]
+    group = np.concatenate([[0], np.cumsum(~link)])
+    rep = np.nonzero(reportable)[0]
+    nxt = np.full(len(rep), -1, np.int64)
+    if len(rep) > 1:
+        chained = group[rep[1:]] == group[rep[:-1]]
+        nxt[:-1][chained] = cols.segment_id[rep[1:][chained]]
+    per_trace = (None if n_traces is None else
+                 np.bincount(cols.trace[rep],
+                             minlength=n_traces).astype(np.int64))
+    return (cols.segment_id[rep], nxt, cols.start_time[rep],
+            cols.end_time[rep], cols.length[rep], cols.queue_length[rep],
+            per_trace)
+
+
+# ---------------------------------------------------------------------------
+# The pipeline
+
+
+class _Log:
+    """Growable columnar buffer of consumed-but-unflushed probe rows."""
+
+    def __init__(self):
+        self.n = 0
+        self.cap = 0
+        self.code = np.empty(0, np.int64)
+        self.lat = np.empty(0)
+        self.lon = np.empty(0)
+        self.time = np.empty(0)
+        self.acc = np.empty(0, np.float32)
+        self.part = np.empty(0, np.int16)
+        self.off = np.empty(0, np.int64)
+        self.arrive = np.empty(0)
+
+    _COLS = ("code", "lat", "lon", "time", "acc", "part", "off", "arrive")
+
+    def append(self, **cols) -> None:
+        k = len(cols["code"])
+        if self.n + k > self.cap:
+            self.cap = max(1024, 2 * (self.n + k))
+            for f in self._COLS:
+                a = getattr(self, f)
+                grown = np.empty(self.cap, a.dtype)
+                grown[:self.n] = a[:self.n]
+                setattr(self, f, grown)
+        for f in self._COLS:
+            getattr(self, f)[self.n:self.n + k] = cols[f]
+        self.n += k
+
+    def compact(self, keep_mask: np.ndarray) -> None:
+        k = int(keep_mask.sum())
+        for f in self._COLS:
+            a = getattr(self, f)
+            a[:k] = a[:self.n][keep_mask]
+        self.n = k
+
+
+class ColumnarStreamPipeline:
+    """StreamPipeline semantics at columnar speed (see module docstring).
+
+    Public surface mirrors StreamPipeline: step/drain/flush_histograms/
+    stats/checkpoint/restore, committed offsets, injectable clock and
+    partition ownership. ``mesh`` deploys the matcher across a device
+    mesh (parallel/dp_e2e). The broker must offer ``poll_batch`` (e.g.
+    ColumnarIngestQueue); a per-record ProbeConsumer also works through a
+    packing shim, at per-record cost on the poll leg only."""
+
+    def __init__(self, tileset: TileSet, config: "Config | None" = None,
+                 queue=None, transport: "Transport | None" = None,
+                 clock=time.monotonic,
+                 partitions: "Sequence[int] | None" = None,
+                 mesh=None):
+        self.config = (config or Config()).validate()
+        sc = self.config.streaming
+        svc = self.config.service
+        self.queue = queue or ColumnarIngestQueue(sc.num_partitions)
+        if self.queue.num_partitions != sc.num_partitions:
+            raise ValueError("queue/config partition count mismatch")
+        owned = range(sc.num_partitions) if partitions is None else partitions
+        self.partitions = sorted(set(int(p) for p in owned))
+        if any(p < 0 or p >= sc.num_partitions for p in self.partitions):
+            raise ValueError(
+                f"partitions {self.partitions} out of range "
+                f"0..{sc.num_partitions - 1}")
+        self.matcher = SegmentMatcher(tileset, self.config, mesh=mesh)
+        self.cache = ColumnarTraceCache(ttl=svc.cache_ttl,
+                                        max_uuids=svc.cache_max_uuids)
+        self.publisher = DatastorePublisher(url=svc.datastore_url,
+                                            mode=svc.mode,
+                                            transport=transport)
+        self.min_segment_length = svc.min_segment_length
+        self.clock = clock
+        self.committed = [0] * sc.num_partitions
+        self._consumed = [0] * sc.num_partitions
+
+        # uuid interning + per-code buffer state
+        self._code_of: dict[str, int] = {}
+        self._uuid_of: list[str] = []
+        self._count = np.zeros(0, np.int64)     # buffered points per code
+        self._born = np.zeros(0)                # buffer birth (clock)
+        self._log = _Log()
+
+        self.hist = SpeedHistogram(len(tileset.osmlr_id), sc.speed_bins)
+        self.qhist = SpeedHistogram(len(tileset.osmlr_id), sc.queue_bins)
+        self._osmlr_ids = np.asarray(tileset.osmlr_id)
+        self._row_order = np.argsort(self._osmlr_ids, kind="stable")
+        self._row_sorted = self._osmlr_ids[self._row_order]
+        self._hist_flushed = self.hist.snapshot()
+        self._qhist_flushed = self.qhist.snapshot()
+        self._hist_flush_at = self.clock()
+        self.hist_flushes = 0
+        self.steps = 0
+        self.malformed = 0
+        self.stats_counters = {"traces": 0, "points": 0, "reports": 0,
+                               "match_seconds": 0.0, "batches": 0}
+        # probe→report latency sample of the most recent flush (wall
+        # seconds from arrival to report build, per flushed probe row)
+        self.last_flush_latency: "np.ndarray | None" = None
+
+    # ---- one poll/flush cycle -------------------------------------------
+
+    def step(self, force_flush: bool = False) -> int:
+        sc = self.config.streaming
+        for p in self.partitions:
+            batches = self._poll_batches(p, self._consumed[p],
+                                         sc.poll_max_records)
+            for offs, cols in batches:
+                self._consume_columns(p, offs, cols)
+                self._consumed[p] = int(offs[-1]) + 1
+
+        now = self.clock()
+        if force_flush:
+            ripe = np.nonzero(self._count > 0)[0]
+        else:
+            ripe = np.nonzero(
+                (self._count >= sc.flush_min_points)
+                | ((self._count > 0)
+                   & (now - self._born >= sc.flush_max_age)))[0]
+        n_reports = self._flush(ripe) if len(ripe) else 0
+        self._commit()
+        if (sc.hist_flush_interval > 0
+                and now - self._hist_flush_at >= sc.hist_flush_interval):
+            self.flush_histograms()
+        self.steps += 1
+        return n_reports
+
+    def drain(self) -> int:
+        return self.step(force_flush=True)
+
+    def _poll_batches(self, p: int, offset: int, max_records: int,
+                      ) -> "list[tuple[np.ndarray, ProbeColumns]]":
+        """[(per-row offsets i64[N], columns)…]. Offsets are carried
+        per row, not as base+arange: the ProbeConsumer contract only
+        promises offset ORDER, not density — a broker may skip offsets
+        (compacted topics), and assuming density would re-poll past rows
+        (duplicate probes) and corrupt the commit floor. A batch broker's
+        poll_batch may return either (int base, cols) — declaring its
+        batch offsets DENSE, as ColumnarIngestQueue's are by construction
+        — or (i64[N] per-row offsets, cols) when they are not."""
+        pb = getattr(self.queue, "poll_batch", None)
+        if pb is not None:
+            return [(base + np.arange(cols.n, dtype=np.int64)
+                     if np.ndim(base) == 0 else np.asarray(base, np.int64),
+                     cols)
+                    for base, cols in pb(p, offset, max_records)]
+        pairs = self.queue.poll(p, offset, max_records)   # per-record shim
+        if not pairs:
+            return []
+        return [(np.array([o for o, _ in pairs], np.int64),
+                 pack_records([r for _, r in pairs]))]
+
+    # ---- consume ---------------------------------------------------------
+
+    def _consume_columns(self, p: int, offs: np.ndarray,
+                         cols: ProbeColumns) -> None:
+        now = self.clock()
+        ok = (np.char.str_len(np.asarray(cols.uuid, np.str_)) > 0) \
+            & np.isfinite(cols.lat) & np.isfinite(cols.lon)
+        bad = int((~ok).sum())
+        if bad:
+            self.malformed += bad
+            offs = offs[ok]
+            cols = cols.rows(ok)
+        if (cols.accuracy < 0).any():
+            # advisory field: a negative accuracy is dropped, not the
+            # point (formatter + dict-consume behavior)
+            cols = cols._replace(accuracy=np.where(
+                cols.accuracy < 0, np.nan, cols.accuracy))
+        if not cols.n:
+            return
+
+        # intern uuids at unique granularity (the only per-string work)
+        uniq, inv = np.unique(cols.uuid, return_inverse=True)
+        ucodes = np.empty(len(uniq), np.int64)
+        for i, u in enumerate(uniq):
+            s = str(u)
+            c = self._code_of.get(s)
+            if c is None:
+                c = len(self._uuid_of)
+                self._code_of[s] = c
+                self._uuid_of.append(s)
+            ucodes[i] = c
+        if len(self._uuid_of) > len(self._count):
+            grow = len(self._uuid_of)
+            cnt = np.zeros(grow, np.int64)
+            cnt[:len(self._count)] = self._count
+            brn = np.zeros(grow)
+            brn[:len(self._born)] = self._born
+            self._count, self._born = cnt, brn
+        codes = ucodes[inv]
+
+        # per-row ordinal within this batch's per-code groups (stable):
+        # timeless rows get index seconds = prior buffered count + ordinal,
+        # matching the dict pipeline's per-record len(buf.points)
+        t = cols.time.copy()
+        nan = ~np.isfinite(t)
+        if nan.any():
+            order = np.argsort(codes, kind="stable")
+            sorted_codes = codes[order]
+            starts = np.nonzero(np.concatenate(
+                [[True], sorted_codes[1:] != sorted_codes[:-1]]))[0]
+            within = np.arange(cols.n, dtype=np.int64)
+            within -= np.repeat(starts, np.diff(
+                np.concatenate([starts, [cols.n]])))
+            ordinal = np.empty(cols.n, np.int64)
+            ordinal[order] = within
+            t[nan] = (self._count[codes] + ordinal)[nan].astype(np.float64)
+
+        fresh = self._count[ucodes] == 0
+        self._born[ucodes[fresh]] = now
+        np.add.at(self._count, codes, 1)
+
+        self._log.append(code=codes, lat=cols.lat, lon=cols.lon, time=t,
+                         acc=cols.accuracy, part=np.full(cols.n, p, np.int16),
+                         off=offs, arrive=np.full(cols.n, now))
+
+    # ---- flush -----------------------------------------------------------
+
+    def _flush(self, ripe_codes: np.ndarray) -> int:
+        L = self._log
+        mask = np.isin(L.code[:L.n], ripe_codes)
+        rows = np.nonzero(mask)[0]
+        # ONE stable (code, time) lexsort orders every flushed vehicle's
+        # slice time-ascending at once — the dict path's _validate_payload
+        # sorts every payload before the cache merge, and parity requires
+        # the same point order into the matcher (a per-vehicle argsort
+        # here was the top host cost at firehose rates).
+        order = rows[np.lexsort((L.time[rows], L.code[rows]))]
+        codes_sorted = L.code[order]
+        starts = np.nonzero(np.concatenate(
+            [[True], codes_sorted[1:] != codes_sorted[:-1]]))[0]
+        bounds = np.concatenate([starts, [len(order)]])
+
+        # cache-merge per flushed vehicle (array slices, no per-point work)
+        merged: list[tuple] = []
+        uuids: list[str] = []
+        for gi in range(len(starts)):
+            sl = order[bounds[gi]:bounds[gi + 1]]
+            u = self._uuid_of[int(codes_sorted[starts[gi]])]
+            m = self.cache.merge(u, L.lat[sl], L.lon[sl], L.time[sl],
+                                 L.acc[sl])
+            merged.append(m)
+            uuids.append(u)
+
+        # one lonlat→xy conversion for every flushed point
+        lens = np.array([len(m[2]) for m in merged], np.int64)
+        splits = np.cumsum(lens)[:-1]
+        lonlat = np.empty((int(lens.sum()), 2))
+        lonlat[:, 0] = np.concatenate([m[1] for m in merged])
+        lonlat[:, 1] = np.concatenate([m[0] for m in merged])
+        xy = lonlat_to_xy(lonlat, np.asarray(
+            self.matcher.ts.meta.origin_lonlat)).astype(np.float32)
+        xys = np.split(xy, splits)
+
+        traces = []
+        for u, m, xy_t in zip(uuids, merged, xys):
+            acc = m[3]
+            has_acc = np.isfinite(acc).any()
+            traces.append(Trace(
+                uuid=u, xy=xy_t, times=m[2],
+                accuracy=(np.nan_to_num(acc, nan=0.0)
+                          if has_acc else None)))
+
+        t0 = time.perf_counter()
+        result = self.matcher.match_many(traces)
+        self.stats_counters["match_seconds"] += time.perf_counter() - t0
+        self.stats_counters["batches"] += 1
+        self.stats_counters["traces"] += len(traces)
+        self.stats_counters["points"] += int(lens.sum())
+
+        if isinstance(result, MatchBatch):
+            n = self._reports_from_columns(result, uuids, merged)
+        else:   # python-walk fallback (no native lib): per-trace records
+            n = self._reports_from_records(result, uuids, merged)
+
+        # flushed rows leave the buffer; retained tails live in the cache
+        self.last_flush_latency = self.clock() - L.arrive[rows]
+        L.compact(~mask)
+        self._count[ripe_codes] = 0
+        return n
+
+    def _reports_from_columns(self, batch: MatchBatch, uuids, merged) -> int:
+        cols = batch.columns
+        seg, nxt, rt0, rt1, rlen, rqueue, _ = build_report_columns(
+            cols, None, self.min_segment_length)
+        self.stats_counters["reports"] += len(seg)
+
+        # per-trace latest complete time → tail retention cut
+        done = np.full(len(uuids), -np.inf)
+        keep = (cols.start_time >= 0.0) & (cols.end_time >= 0.0) \
+            & ~cols.internal
+        if keep.any():
+            np.maximum.at(done, cols.trace[keep], cols.end_time[keep])
+        for ti, (u, m) in enumerate(zip(uuids, merged)):
+            from_time = done[ti] if np.isfinite(done[ti]) else float(m[2][0])
+            self.cache.retain(u, m[0], m[1], m[2], m[3], from_time)
+
+        dur = rt1 - rt0
+        okd = dur > 0
+        pos = np.searchsorted(self._row_sorted, seg[okd])
+        pos = np.minimum(pos, len(self._row_sorted) - 1)
+        hrows = np.where(self._row_sorted[pos] == seg[okd],
+                         self._row_order[pos], -1).astype(np.int32)
+        self.hist.update(hrows, rlen[okd] / dur[okd])
+        self.qhist.update(hrows, rqueue[okd])
+
+        self.publisher.publish_columns(seg, nxt, rt0, rt1, rlen, rqueue)
+        return int(len(seg))
+
+    def _reports_from_records(self, per_trace, uuids, merged) -> int:
+        """Fallback parity path over SegmentRecord lists (no native lib)."""
+        from reporter_tpu.service.reports import (Report, build_reports,
+                                                  latest_complete_time)
+
+        n = 0
+        all_reports: list[Report] = []
+        for (u, m, records) in zip(uuids, merged, per_trace):
+            reports = build_reports(records, self.min_segment_length)
+            all_reports.extend(reports)
+            done = latest_complete_time(records)
+            from_time = float(m[2][0]) if done is None else done
+            self.cache.retain(u, m[0], m[1], m[2], m[3], from_time)
+            n += len(reports)
+        self.stats_counters["reports"] += n
+        rows, speeds, queues = [], [], []
+        for r in all_reports:
+            dur = r.end_time - r.start_time
+            if dur <= 0:
+                continue
+            pos = int(np.searchsorted(self._row_sorted, r.segment_id))
+            pos = min(pos, len(self._row_sorted) - 1)
+            row = (int(self._row_order[pos])
+                   if self._row_sorted[pos] == r.segment_id else -1)
+            rows.append(row)
+            speeds.append(r.length / dur)
+            queues.append(r.queue_length)
+        self.hist.update(np.asarray(rows, np.int32),
+                         np.asarray(speeds, np.float64))
+        self.qhist.update(np.asarray(rows, np.int32),
+                          np.asarray(queues, np.float64))
+        self.publisher.publish(all_reports)
+        return n
+
+    def _commit(self) -> None:
+        floor = list(self._consumed)
+        L = self._log
+        if L.n:
+            for p in self.partitions:
+                m = L.part[:L.n] == p
+                if m.any():
+                    floor[p] = min(floor[p], int(L.off[:L.n][m].min()))
+        self.committed = floor
+
+    # ---- histograms (same delta-flush contract as StreamPipeline) -------
+
+    def flush_histograms(self) -> int:
+        from reporter_tpu.streaming.state import flush_histogram_delta
+        return flush_histogram_delta(self)
+
+    # ---- observability ---------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "steps": self.steps,
+            "malformed": self.malformed,
+            "lag": sum(self.queue.end_offset(p) - self.committed[p]
+                       for p in self.partitions),
+            "buffered_uuids": int((self._count > 0).sum()),
+            "buffered_points": int(self._count.sum()),
+            "published": self.publisher.published,
+            "hist_rows": int(len(self.hist.nonzero_rows())),
+            "qhist_rows": int(len(self.qhist.nonzero_rows())),
+            **self.stats_counters,
+        }
+
+    # ---- checkpoint / resume (StreamPipeline-compatible npz) -------------
+
+    def checkpoint(self, path: str) -> None:
+        from reporter_tpu.streaming.state import save_checkpoint
+        save_checkpoint(path, self.committed, self.cache.dump(),
+                        self.hist.snapshot(), self._hist_flushed,
+                        self.qhist.snapshot(), self._qhist_flushed)
+
+    def restore(self, path: str) -> None:
+        from reporter_tpu.streaming.state import load_checkpoint
+        state = load_checkpoint(path, self)
+        self.committed = list(state["committed"])
+        self._consumed = list(state["committed"])
+        self._log = _Log()
+        self._count[:] = 0
+        outage = max(0.0, time.time()
+                     - float(state.get("saved_at", time.time())))
+        self.cache.load(state["cache"], extra_age=outage)

@@ -179,6 +179,22 @@ class ProbeFormatter:
             self.normalized += 1
         return rec
 
+    def normalize_columns(self, payloads, fmt: "str | None" = None):
+        """Normalize raw payloads into ONE ProbeColumns batch (the
+        columnar ingest path, streaming/columnar.py). Vendor parsing is
+        inherently per-payload Python — the win is downstream: the batch
+        enters the broker and the matcher worker as flat columns, so the
+        per-record cost stops at this (formatter-worker) stage instead of
+        riding the matcher worker's core."""
+        from reporter_tpu.streaming.columnar import pack_records
+
+        recs = []
+        for p in payloads:
+            rec = self.normalize(p, fmt)
+            if rec is not None:
+                recs.append(rec)
+        return pack_records(recs)
+
     def format_stream(self, payloads, queue, fmt: "str | None" = None,
                       ) -> int:
         """Normalize raw payloads into ``queue`` (any object with the

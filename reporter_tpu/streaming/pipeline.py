@@ -16,7 +16,6 @@ checkpointing buffers and histograms too).
 
 from __future__ import annotations
 
-import json
 import time
 from typing import Any, Sequence
 
@@ -47,7 +46,8 @@ class StreamPipeline:
                  queue: ProbeConsumer | None = None,
                  transport: Transport | None = None,
                  clock=time.monotonic,
-                 partitions: "Sequence[int] | None" = None):
+                 partitions: "Sequence[int] | None" = None,
+                 mesh=None):
         self.config = (config or Config()).validate()
         sc = self.config.streaming
         # Any ProbeConsumer works here (streaming/broker.py): the in-proc
@@ -68,7 +68,8 @@ class StreamPipeline:
             raise ValueError(
                 f"partitions {self.partitions} out of range "
                 f"0..{sc.num_partitions - 1}")
-        self.app = ReporterApp(tileset, self.config, transport=transport)
+        self.app = ReporterApp(tileset, self.config, transport=transport,
+                               mesh=mesh)
         self.clock = clock
         self.committed = [0] * sc.num_partitions
         self._consumed = [0] * sc.num_partitions   # read position (ahead of committed)
@@ -86,6 +87,12 @@ class StreamPipeline:
         self.hist_flushes = 0
         self.steps = 0
         self.malformed = 0
+
+    @property
+    def publisher(self):
+        """The app's datastore publisher (shared state.py helpers address
+        the publisher uniformly across both pipeline flavors)."""
+        return self.app.publisher
 
     # ---- one poll/flush cycle -------------------------------------------
 
@@ -145,10 +152,11 @@ class StreamPipeline:
         point = {"lat": lat, "lon": lon, "time": t}
         if "accuracy" in rec:   # same optional field the HTTP path keeps
             try:
-                point["accuracy"] = float(rec["accuracy"])
-            except (TypeError, ValueError):
-                pass            # malformed accuracy: drop the field, not
-                                # the point (it is advisory weighting)
+                acc = float(rec["accuracy"])
+                if acc >= 0:    # negative would 400 the whole flush at
+                    point["accuracy"] = acc   # _validate_payload — drop
+            except (TypeError, ValueError):   # the field, keep the point
+                pass            # (it is advisory weighting)
         buf.points.append(point)
 
     def _flush(self, uuids: list[str]) -> int:
@@ -192,40 +200,10 @@ class StreamPipeline:
         """Publish the per-segment speed-histogram DELTA since the last
         flush (SURVEY.md §7.7 / BASELINE config 5: "online per-segment speed
         histograms … periodic flush to datastore path"). Returns the number
-        of segments flushed. The baseline advances only on successful
-        publish, so a failed POST retries the same delta next interval."""
-        snap = self.hist.snapshot()
-        qsnap = self.qhist.snapshot()
-        delta = snap - self._hist_flushed
-        qdelta = qsnap - self._qhist_flushed
-        rows = np.nonzero(delta.sum(axis=1))[0]
-        qrows = np.nonzero(qdelta.sum(axis=1))[0]
-        self._hist_flush_at = self.clock()
-        if not len(rows) and not len(qrows):
-            return 0
-        payload = {
-            "mode": self.config.service.mode,
-            "bin_edges_mps": list(self.config.streaming.speed_bins),
-            "histograms": [
-                {"segment_id": int(self._osmlr_ids[r]),
-                 "counts": delta[r].astype(int).tolist()}
-                for r in rows
-            ],
-            "queue_bin_edges_m": list(self.config.streaming.queue_bins),
-            "queue_histograms": [
-                {"segment_id": int(self._osmlr_ids[r]),
-                 "counts": qdelta[r].astype(int).tolist()}
-                for r in qrows
-            ],
-        }
-        if self.app.publisher.publish_json(payload):
-            self._hist_flushed = snap
-            self._qhist_flushed = qsnap
-            self.hist_flushes += 1
-            # Count any segment with a published delta (speed OR queue):
-            # callers use 0 to mean "nothing flushed / publish failed".
-            return int(len(np.union1d(rows, qrows)))
-        return 0
+        of segments flushed. One shared implementation with the columnar
+        pipeline — streaming/state.py."""
+        from reporter_tpu.streaming.state import flush_histogram_delta
+        return flush_histogram_delta(self)
 
     # ---- observability ---------------------------------------------------
 
@@ -247,47 +225,21 @@ class StreamPipeline:
     # ---- checkpoint / resume (SURVEY.md §5) ------------------------------
 
     def checkpoint(self, path: str) -> None:
-        """Snapshot offsets + uuid cache + histogram to one file.
-
-        Buffers are NOT stored: committed offsets sit at the oldest
-        unflushed record, so replaying from them reconstructs every buffer
-        exactly — the buffer is derived state, the log is the truth.
-        """
-        state = {
-            "committed": self.committed,
-            "cache": self.app.cache.dump(),
-            "saved_at": time.time(),   # wall clock: outage spans processes
-        }
-        if not path.endswith(".npz"):
-            path += ".npz"   # savez appends it; normalize so restore(path) matches
-        np.savez_compressed(
-            path,
-            state=np.frombuffer(json.dumps(state).encode(), dtype=np.uint8),
-            hist=self.hist.snapshot(),
-            hist_flushed=self._hist_flushed,
-            qhist=self.qhist.snapshot(),
-            qhist_flushed=self._qhist_flushed)
+        """Snapshot offsets + uuid cache + histograms to one file (shared
+        schema with the columnar pipeline — streaming/state.py: buffers
+        are derived state, the offset log is the truth)."""
+        from reporter_tpu.streaming.state import save_checkpoint
+        save_checkpoint(path, self.committed, self.app.cache.dump(),
+                        self.hist.snapshot(), self._hist_flushed,
+                        self.qhist.snapshot(), self._qhist_flushed)
 
     def restore(self, path: str) -> None:
         """Reset to a checkpoint; consumption resumes at the committed
         offsets, replaying the unflushed tail (at-least-once: records whose
         uuid was flushed after the snapshot may produce duplicate reports,
         never lost ones)."""
-        if not path.endswith(".npz"):
-            path += ".npz"
-        with np.load(path) as z:
-            state = json.loads(bytes(z["state"]).decode())
-            self.hist.load(z["hist"])
-            if "hist_flushed" in z.files:
-                self._hist_flushed = z["hist_flushed"]
-            else:   # older checkpoint: re-flush everything (at-least-once)
-                self._hist_flushed = np.zeros_like(self.hist.snapshot())
-            if "qhist" in z.files:
-                self.qhist.load(z["qhist"])
-                self._qhist_flushed = z["qhist_flushed"]
-            else:   # pre-queue checkpoint: start the queue track empty
-                self.qhist.load(np.zeros_like(self.qhist.snapshot()))
-                self._qhist_flushed = self.qhist.snapshot()
+        from reporter_tpu.streaming.state import load_checkpoint
+        state = load_checkpoint(path, self)
         self.committed = list(state["committed"])
         self._consumed = list(state["committed"])
         self._buffers = {}

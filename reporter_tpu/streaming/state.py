@@ -1,0 +1,105 @@
+"""Shared stream-worker state logic: histogram delta-flush and the
+checkpoint file format.
+
+Both pipeline flavors — the dict-record StreamPipeline and the columnar
+ColumnarStreamPipeline — speak exactly this flush payload and this npz
+checkpoint schema, ONE implementation, so a checkpoint written by either
+restores into the other and a payload-field change cannot drift between
+them (they are duck-typed over the attribute surface used here)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def flush_histogram_delta(pl) -> int:
+    """Publish the per-segment speed + queue histogram DELTA since the
+    last flush (SURVEY.md §7.7 / BASELINE config 5). Returns the number
+    of segments flushed. The baseline advances only on successful
+    publish, so a failed POST retries the same delta next interval.
+
+    ``pl``: any pipeline with hist/qhist, _hist_flushed/_qhist_flushed,
+    _hist_flush_at, clock, config, _osmlr_ids, publisher, hist_flushes.
+    """
+    snap = pl.hist.snapshot()
+    qsnap = pl.qhist.snapshot()
+    delta = snap - pl._hist_flushed
+    qdelta = qsnap - pl._qhist_flushed
+    rows = np.nonzero(delta.sum(axis=1))[0]
+    qrows = np.nonzero(qdelta.sum(axis=1))[0]
+    pl._hist_flush_at = pl.clock()
+    if not len(rows) and not len(qrows):
+        return 0
+    payload = {
+        "mode": pl.config.service.mode,
+        "bin_edges_mps": list(pl.config.streaming.speed_bins),
+        "histograms": [
+            {"segment_id": int(pl._osmlr_ids[r]),
+             "counts": delta[r].astype(int).tolist()}
+            for r in rows
+        ],
+        "queue_bin_edges_m": list(pl.config.streaming.queue_bins),
+        "queue_histograms": [
+            {"segment_id": int(pl._osmlr_ids[r]),
+             "counts": qdelta[r].astype(int).tolist()}
+            for r in qrows
+        ],
+    }
+    if pl.publisher.publish_json(payload):
+        pl._hist_flushed = snap
+        pl._qhist_flushed = qsnap
+        pl.hist_flushes += 1
+        # Count any segment with a published delta (speed OR queue):
+        # callers use 0 to mean "nothing flushed / publish failed".
+        return int(len(np.union1d(rows, qrows)))
+    return 0
+
+
+def save_checkpoint(path: str, committed: list, cache_dump: dict,
+                    hist_snap, hist_flushed, qhist_snap,
+                    qhist_flushed) -> None:
+    """One-file snapshot: offsets + uuid cache + both histograms.
+
+    Buffers are NOT stored: committed offsets sit at the oldest unflushed
+    record, so replaying from them reconstructs every buffer exactly —
+    the buffer is derived state, the log is the truth."""
+    state = {
+        "committed": committed,
+        "cache": cache_dump,
+        "saved_at": time.time(),   # wall clock: outage spans processes
+    }
+    if not path.endswith(".npz"):
+        path += ".npz"   # savez appends it; normalize so restore matches
+    np.savez_compressed(
+        path,
+        state=np.frombuffer(json.dumps(state).encode(), dtype=np.uint8),
+        hist=hist_snap,
+        hist_flushed=hist_flushed,
+        qhist=qhist_snap,
+        qhist_flushed=qhist_flushed)
+
+
+def load_checkpoint(path: str, pl) -> dict:
+    """Restore histograms + flush baselines into ``pl`` (hist, qhist,
+    _hist_flushed, _qhist_flushed) and return the JSON state
+    {committed, cache, saved_at}. Handles pre-queue / pre-baseline
+    checkpoints the way the original restore() did."""
+    if not path.endswith(".npz"):
+        path += ".npz"
+    with np.load(path) as z:
+        state = json.loads(bytes(z["state"]).decode())
+        pl.hist.load(z["hist"])
+        if "hist_flushed" in z.files:
+            pl._hist_flushed = z["hist_flushed"]
+        else:   # older checkpoint: re-flush everything (at-least-once)
+            pl._hist_flushed = np.zeros_like(pl.hist.snapshot())
+        if "qhist" in z.files:
+            pl.qhist.load(z["qhist"])
+            pl._qhist_flushed = z["qhist_flushed"]
+        else:   # pre-queue checkpoint: start the queue track empty
+            pl.qhist.load(np.zeros_like(pl.qhist.snapshot()))
+            pl._qhist_flushed = pl.qhist.snapshot()
+    return state

@@ -1,0 +1,392 @@
+"""Columnar streaming parity: ColumnarStreamPipeline must reproduce the
+dict StreamPipeline's observable behavior — published reports, histograms,
+commit floors, malformed counts, cache contents, checkpoint files — on
+identical streams (VERDICT r4 missing #2 / next #2)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import (CompilerParams, Config, ServiceConfig,
+                                 StreamingConfig)
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_probe
+from reporter_tpu.streaming import (ColumnarIngestQueue,
+                                    ColumnarStreamPipeline, IngestQueue,
+                                    StreamPipeline, pack_records)
+from reporter_tpu.streaming.columnar import ProbeColumns, build_report_columns
+from reporter_tpu.tiles.compiler import compile_network
+
+
+@pytest.fixture(scope="module")
+def stream_tiles():
+    return compile_network(
+        generate_city("tiny"),
+        CompilerParams(reach_radius=500.0, osmlr_max_length=200.0))
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def _records(probes, accuracy_for=()):
+    """Round-robin interleave of the probes' points (firehose shape)."""
+    out = []
+    T = max(len(p.times) for p in probes)
+    for t in range(T):
+        for i, p in enumerate(probes):
+            if t < len(p.times):
+                rec = {"uuid": p.uuid, "lat": float(p.lonlat[t, 1]),
+                       "lon": float(p.lonlat[t, 0]),
+                       "time": float(p.times[t])}
+                if i in accuracy_for:
+                    rec["accuracy"] = 8.0 + (t % 5)
+                out.append(rec)
+    return out
+
+
+def _dual(tiles, **stream_kw):
+    """One dict pipeline + one columnar pipeline, same config, separate
+    capture lists, lock-stepped fake clocks."""
+    cfg = Config(service=ServiceConfig(datastore_url="http://ds.test/"),
+                 streaming=StreamingConfig(**stream_kw))
+    caps = ([], [])
+
+    def transport(sink):
+        return lambda url, body: sink.append(json.loads(body)) or 200
+
+    cd, cc = FakeClock(), FakeClock()
+    dpipe = StreamPipeline(tiles, cfg, transport=transport(caps[0]),
+                           clock=cd)
+    cpipe = ColumnarStreamPipeline(tiles, cfg, transport=transport(caps[1]),
+                                   clock=cc)
+    return dpipe, cpipe, caps, (cd, cc)
+
+
+def _published_reports(captured):
+    """Flatten every published report row, as sortable tuples."""
+    rows = []
+    for payload in captured:
+        for r in payload.get("reports", []):
+            rows.append((r["id"], r["next_id"] if r["next_id"] is not None
+                         else -1, round(r["t0"], 6), round(r["t1"], 6),
+                         round(r["length"], 4), round(r["queue_length"], 4)))
+    return sorted(rows)
+
+
+def _hist_payloads(captured):
+    return [p for p in captured if "histograms" in p]
+
+
+def _assert_parity(dpipe, cpipe, caps):
+    assert _published_reports(caps[1]) == _published_reports(caps[0])
+    np.testing.assert_array_equal(cpipe.hist.snapshot(),
+                                  dpipe.hist.snapshot())
+    np.testing.assert_array_equal(cpipe.qhist.snapshot(),
+                                  dpipe.qhist.snapshot())
+    assert cpipe.committed == dpipe.committed
+    assert cpipe.malformed == dpipe.malformed
+    # cache contents (points only; wall ages use the real clock)
+    ddump = dpipe.app.cache.dump()
+    cdump = cpipe.cache.dump()
+    assert sorted(ddump) == sorted(cdump)
+    for u in ddump:
+        assert ddump[u]["points"] == cdump[u]["points"], u
+
+
+class TestPipelineParity:
+    def test_firehose_parity(self, stream_tiles):
+        probes = [synthesize_probe(stream_tiles, seed=s, num_points=40,
+                                   gps_sigma=3.0) for s in range(12)]
+        recs = _records(probes, accuracy_for={3, 7})
+        dpipe, cpipe, caps, clocks = _dual(
+            stream_tiles, flush_min_points=16, flush_max_age=5.0,
+            poll_max_records=200, hist_flush_interval=0.0)
+        dpipe.queue.append_many(recs)
+        cpipe.queue.append_many(recs)
+        # several polls with ripeness both by count and by age
+        for dt in (0.0, 1.0, 6.0, 0.5):
+            for c in clocks:
+                c.now += dt
+            dpipe.step()
+            cpipe.step()
+        dpipe.drain()
+        cpipe.drain()
+        assert dpipe.flush_histograms() == cpipe.flush_histograms()
+        _assert_parity(dpipe, cpipe, caps)
+        dh, ch = _hist_payloads(caps[0]), _hist_payloads(caps[1])
+        assert dh == ch and len(dh) == 1
+        assert cpipe.stats()["reports"] == dpipe.stats()["reports"] > 0
+
+    def test_malformed_and_timeless_parity(self, stream_tiles):
+        probes = [synthesize_probe(stream_tiles, seed=90 + s, num_points=24,
+                                   gps_sigma=3.0) for s in range(4)]
+        recs = _records(probes)
+        # timeless vehicle (index seconds), malformed rows, bad accuracy
+        for i, r in enumerate(recs):
+            if r["uuid"] == probes[0].uuid:
+                del r["time"]
+            if i % 17 == 0:
+                r["accuracy"] = -3.0          # advisory: dropped, point kept
+        recs.insert(5, {"uuid": "", "lat": 1.0, "lon": 2.0})
+        recs.insert(9, {"uuid": "vx", "lat": "bogus", "lon": 2.0})
+        recs.insert(13, {"uuid": "vy", "lat": 1.0, "lon": 2.0,
+                         "time": "not-a-time"})
+        dpipe, cpipe, caps, _ = _dual(
+            stream_tiles, flush_min_points=8, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0)
+        dpipe.queue.append_many(recs)
+        cpipe.queue.append_many(recs)
+        dpipe.step()
+        cpipe.step()
+        dpipe.drain()
+        cpipe.drain()
+        assert cpipe.malformed == dpipe.malformed == 3
+        _assert_parity(dpipe, cpipe, caps)
+
+    def test_multi_flush_tail_retention_parity(self, stream_tiles):
+        """Points split across two flushes: the straddling-tail cache
+        must complete in-progress segments identically in both."""
+        probes = [synthesize_probe(stream_tiles, seed=40 + s, num_points=60,
+                                   gps_sigma=3.0) for s in range(6)]
+        recs = _records(probes)
+        half = len(recs) // 2
+        dpipe, cpipe, caps, _ = _dual(
+            stream_tiles, flush_min_points=10, flush_max_age=1e9,
+            poll_max_records=10_000, hist_flush_interval=0.0)
+        for chunk in (recs[:half], recs[half:]):
+            dpipe.queue.append_many(chunk)
+            cpipe.queue.append_many(chunk)
+            dpipe.step()
+            cpipe.step()
+        dpipe.drain()
+        cpipe.drain()
+        _assert_parity(dpipe, cpipe, caps)
+        assert _published_reports(caps[0])   # something actually reported
+
+    def test_checkpoint_cross_restore(self, stream_tiles, tmp_path):
+        """A columnar checkpoint restores into the dict pipeline (and
+        back) — shared schema, continued stream, same reports."""
+        probes = [synthesize_probe(stream_tiles, seed=70 + s, num_points=50,
+                                   gps_sigma=3.0) for s in range(5)]
+        recs = _records(probes)
+        half = len(recs) // 2
+        dpipe, cpipe, caps, _ = _dual(
+            stream_tiles, flush_min_points=12, flush_max_age=1e9,
+            poll_max_records=10_000, hist_flush_interval=0.0)
+        for pipe in (dpipe, cpipe):
+            pipe.queue.append_many(recs[:half])
+            pipe.step()
+        cpipe.checkpoint(str(tmp_path / "col.npz"))
+        dpipe.checkpoint(str(tmp_path / "dict.npz"))
+
+        # swap: columnar state into a fresh dict pipeline and vice versa
+        cfg = Config(service=ServiceConfig(datastore_url="http://ds.test/"),
+                     streaming=StreamingConfig(flush_min_points=12,
+                                               flush_max_age=1e9,
+                                               poll_max_records=10_000,
+                                               hist_flush_interval=0.0))
+        cap_d2, cap_c2 = [], []
+        d2 = StreamPipeline(
+            stream_tiles, cfg, queue=dpipe.queue,
+            transport=lambda u, b: cap_d2.append(json.loads(b)) or 200)
+        d2.restore(str(tmp_path / "col.npz"))
+        c2 = ColumnarStreamPipeline(
+            stream_tiles, cfg, queue=cpipe.queue,
+            transport=lambda u, b: cap_c2.append(json.loads(b)) or 200)
+        c2.restore(str(tmp_path / "dict.npz"))
+        np.testing.assert_array_equal(d2.hist.snapshot(),
+                                      c2.hist.snapshot())
+        for pipe, cap in ((d2, cap_d2), (c2, cap_c2)):
+            pipe.queue.append_many(recs[half:])
+            pipe.step()
+            pipe.drain()
+        assert _published_reports(cap_d2) == _published_reports(cap_c2)
+        np.testing.assert_array_equal(d2.hist.snapshot(), c2.hist.snapshot())
+
+    def test_flush_latency_sample(self, stream_tiles):
+        """last_flush_latency = consume→report wall per flushed probe
+        (buffer wait + match); consumed in one step, flushed 2.5 s later."""
+        probes = [synthesize_probe(stream_tiles, seed=7, num_points=30,
+                                   gps_sigma=3.0)]
+        _, cpipe, _, (_, cc) = _dual(
+            stream_tiles, flush_min_points=1000, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0)
+        cpipe.queue.append_many(_records(probes))
+        cpipe.step()                       # consume only: nothing ripe
+        assert cpipe.last_flush_latency is None
+        cc.now += 2.5
+        cpipe.drain()
+        lat = cpipe.last_flush_latency
+        assert lat is not None and len(lat) == 30
+        assert np.allclose(lat, 2.5)
+
+
+class TestColumnarQueue:
+    def test_poll_matches_ingest_queue(self):
+        recs = [{"uuid": f"v{i % 7}", "lat": float(i), "lon": -float(i),
+                 "time": float(i)} for i in range(40)]
+        recs[11]["accuracy"] = 4.5
+        q0 = IngestQueue(num_partitions=3)
+        q1 = ColumnarIngestQueue(num_partitions=3)
+        q0.append_many(recs)
+        q1.append_many(recs)
+        for p in range(3):
+            assert q0.end_offset(p) == q1.end_offset(p)
+            a = q0.poll(p, 0, 1000)
+            b = q1.poll(p, 0, 1000)
+            assert [o for o, _ in a] == [o for o, _ in b]
+            for (_, ra), (_, rb) in zip(a, b):
+                assert ra == rb
+
+    def test_poll_batch_slicing(self):
+        q = ColumnarIngestQueue(num_partitions=1)
+        for k in range(4):
+            q.append_columns(pack_records(
+                [{"uuid": "v", "lat": float(k * 10 + i), "lon": 0.0,
+                  "time": float(k * 10 + i)} for i in range(5)]))
+        got = q.poll_batch(0, 3, 9)       # mid-batch start, mid-batch end
+        offs = np.concatenate([base + np.arange(c.n)
+                               for base, c in got])
+        np.testing.assert_array_equal(offs, np.arange(3, 12))
+        lats = np.concatenate([c.lat for _, c in got])
+        np.testing.assert_array_equal(
+            lats, [3, 4, 10, 11, 12, 13, 14, 20, 21])
+
+    def test_truncate_floor(self):
+        q = ColumnarIngestQueue(num_partitions=1)
+        q.append_columns(pack_records(
+            [{"uuid": "v", "lat": float(i), "lon": 0.0} for i in range(6)]))
+        q.append_columns(pack_records(
+            [{"uuid": "v", "lat": float(i), "lon": 0.0} for i in range(4)]))
+        q.truncate([7])          # batch 0 dropped; batch 1 straddles
+        assert q.poll_batch(0, 6, 10)[0][0] == 6    # early rows pollable
+        with pytest.raises(LookupError):
+            q.poll_batch(0, 5, 10)
+        assert q.end_offset(0) == 10
+
+
+def _mk_cols(rows):
+    """RecordColumns from (trace, seg, t0, t1, length, queue, internal)."""
+    from reporter_tpu.matcher.native_walk import RecordColumns
+
+    a = np.asarray
+    tr, seg, t0, t1, ln, qu, it = (list(x) for x in zip(*rows))
+    n = len(tr)
+    return RecordColumns(
+        a(tr, np.int32), a(seg, np.int64), a(t0, np.float64),
+        a(t1, np.float64), a(ln, np.float64), a(qu, np.float64),
+        a(it, bool), np.arange(n + 1, dtype=np.int64),
+        np.zeros(n, np.int64))
+
+
+class TestBuildReportColumns:
+    """The vectorized report builder must agree with the scalar state
+    machine (service/reports.build_reports) on every chaining shape."""
+
+    CASES = [
+        # simple chain: A→B adjacent
+        [(0, 10, 0.0, 1.0, 50.0, 0.0, False),
+         (0, 11, 1.0, 2.0, 60.0, 5.0, False)],
+        # internal connector extends the run: A→(conn)→B
+        [(0, 10, 0.0, 1.0, 50.0, 0.0, False),
+         (0, -1, 1.0, 1.2, 8.0, 0.0, True),
+         (0, 11, 1.2, 2.0, 60.0, 0.0, False)],
+        # gap breaks the chain
+        [(0, 10, 0.0, 1.0, 50.0, 0.0, False),
+         (0, 11, 3.0, 4.0, 60.0, 0.0, False)],
+        # partial record breaks it
+        [(0, 10, 0.0, 1.0, 50.0, 0.0, False),
+         (0, 12, -1.0, 2.0, 20.0, 0.0, False),
+         (0, 11, 2.0, 3.0, 60.0, 0.0, False)],
+        # non-adjacent internal breaks it
+        [(0, 10, 0.0, 1.0, 50.0, 0.0, False),
+         (0, -1, 1.5, 1.7, 8.0, 0.0, True),
+         (0, 11, 1.7, 2.0, 60.0, 0.0, False)],
+        # chain must not cross traces
+        [(0, 10, 0.0, 1.0, 50.0, 0.0, False),
+         (1, 11, 1.0, 2.0, 60.0, 0.0, False)],
+        # below-min-length record: unreported AND breaks the pair
+        [(0, 10, 0.0, 1.0, 50.0, 0.0, False),
+         (0, 13, 1.0, 1.1, 2.0, 0.0, False),
+         (0, 11, 1.1, 2.0, 60.0, 0.0, False)],
+        # two connectors in a row still chain
+        [(0, 10, 0.0, 1.0, 50.0, 0.0, False),
+         (0, -1, 1.0, 1.1, 4.0, 0.0, True),
+         (0, -1, 1.1, 1.3, 4.0, 0.0, True),
+         (0, 11, 1.3, 2.0, 60.0, 1.0, False)],
+    ]
+
+    @pytest.mark.parametrize("case", range(len(CASES)))
+    def test_matches_scalar_builder(self, case):
+        from reporter_tpu.matcher.native_walk import (materialize_records,
+                                                      record_bounds)
+        from reporter_tpu.service.reports import build_reports
+
+        rows = self.CASES[case]
+        cols = _mk_cols(rows)
+        n_traces = int(cols.trace.max()) + 1
+        bounds = record_bounds(cols, n_traces)
+        min_len = 10.0
+        seg, nxt, t0, t1, ln, qu, per_trace = build_report_columns(
+            cols, n_traces, min_len)
+
+        want = []
+        for b in range(n_traces):
+            recs = materialize_records(cols, int(bounds[b]),
+                                       int(bounds[b + 1]))
+            want.extend(build_reports(recs, min_len))
+        assert len(want) == len(seg)
+        for i, w in enumerate(want):
+            assert seg[i] == w.segment_id
+            want_next = -1 if w.next_segment_id is None else w.next_segment_id
+            assert nxt[i] == want_next, (case, i)
+            assert t0[i] == w.start_time and t1[i] == w.end_time
+        assert per_trace.sum() == len(want)
+
+    def test_random_fuzz_against_scalar(self):
+        from reporter_tpu.matcher.native_walk import (materialize_records,
+                                                      record_bounds)
+        from reporter_tpu.service.reports import build_reports
+
+        rng = np.random.default_rng(7)
+        for trial in range(50):
+            rows = []
+            for tr in range(3):
+                t = 0.0
+                for _ in range(int(rng.integers(0, 12))):
+                    seg = int(rng.integers(10, 16))
+                    internal = bool(rng.random() < 0.25)
+                    partial = bool(rng.random() < 0.2)
+                    dt = float(rng.choice([0.5, 1.0]))
+                    gap = float(rng.choice([0.0, 0.0, 0.0, 2.0]))
+                    t0 = t + gap
+                    t1 = t0 + dt
+                    ln = float(rng.choice([5.0, 30.0]))
+                    rows.append((tr, -1 if internal else seg,
+                                 -1.0 if partial else t0, t1, ln,
+                                 0.0, internal))
+                    t = t1
+            if not rows:
+                continue
+            cols = _mk_cols(rows)
+            n_traces = int(cols.trace.max()) + 1
+            bounds = record_bounds(cols, n_traces)
+            seg, nxt, t0a, t1a, _, _, _ = build_report_columns(
+                cols, None, 10.0)
+            want = []
+            for b in range(n_traces):
+                recs = materialize_records(cols, int(bounds[b]),
+                                           int(bounds[b + 1]))
+                want.extend(build_reports(recs, 10.0))
+            got = list(zip(seg.tolist(), nxt.tolist(), t0a.tolist(),
+                           t1a.tolist()))
+            exp = [(w.segment_id,
+                    -1 if w.next_segment_id is None else w.next_segment_id,
+                    w.start_time, w.end_time) for w in want]
+            assert got == exp, trial
