@@ -151,15 +151,34 @@ def build_network(
     node_pos: osm node id → (lon, lat); raw_ways: (way id, node refs,
     tags); raw_relations: (tags, [(role, member type, ref)...]).
     """
+    # Corrupt extracts can carry coordinates outside the WGS84 domain;
+    # projecting them would silently warp the local metric (cos-lat goes
+    # negative past the pole). Treat such nodes as absent — ways route
+    # around them exactly like dangling refs — and say so.
+    bad = [nid for nid, (lon, lat) in node_pos.items()
+           if not (-180.0 <= lon <= 180.0 and -90.0 <= lat <= 90.0)]
+    if bad:
+        import warnings
+
+        warnings.warn(
+            f"extract {name!r}: dropped {len(bad)} node(s) with "
+            f"out-of-range coordinates (e.g. id {bad[0]})", stacklevel=3)
+        for nid in bad:
+            del node_pos[nid]
+
     drivable: list[tuple[int, list[int], dict[str, str], int]] = []
     for way_id, refs, tags in raw_ways:
         mask = _access_mask(tags)
         if not mask:
             continue
         refs = [r for r in refs if r in node_pos]
-        # Real extracts contain duplicate consecutive refs; they would become
-        # zero-length edges, which the compiler forbids (edge_len > 0).
-        refs = [r for i, r in enumerate(refs) if i == 0 or r != refs[i - 1]]
+        # Real extracts contain duplicate consecutive refs — and distinct
+        # ids digitized at the SAME position; either way the hop would
+        # become a zero-length edge, which the compiler forbids
+        # (edge_len > 0), so drop the repeated ref.
+        refs = [r for i, r in enumerate(refs)
+                if i == 0 or (r != refs[i - 1]
+                              and node_pos[r] != node_pos[refs[i - 1]])]
         if len(refs) >= 2:
             drivable.append((way_id, refs, tags, mask))
     raw_ways = drivable
