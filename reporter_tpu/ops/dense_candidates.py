@@ -68,6 +68,11 @@ _P = 256          # points per chunk: halves the (chunks x blocks) launch
 _SBLK = 512       # segment columns per block (small: culling granularity)
 _NSUB = 8         # chunk sub-bboxes — 32 points per sub-bbox, the same
 #                   culling tightness as the old 128/4 (results identical)
+_NJ_CAP = 128     # narrow-grid width: max culled blocks per chunk before
+#                   the sweep falls back to the full-width launch grid
+#                   (Morton-sorted fleet chunks typically hit ~6-11 blocks;
+#                   the cap kills the per-slot launch overhead that cost
+#                   bayarea-xl ~45% of its dispatch at 1184 blocks)
 SPLIT_LEN = 256.0  # long-segment pre-split span (shared with tiles/capacity)
 
 
@@ -306,7 +311,8 @@ def _sweep_kernel(ids_ref, pts_ref, seg_ref, edge_out, off_out, dist_out,
 
 
 def _chunk_block_ids(pts, valid, bbox, radius: float, nchunks: int):
-    """Culling pre-pass: [nchunks, nblocks] i32 block ids to visit.
+    """Culling pre-pass: ([nchunks, nblocks] i32 block ids to visit,
+    [nchunks] i32 hit counts).
 
     pts f32 [nchunks*P, 2] (already padded), valid bool [nchunks*P].
     Each chunk is split into _NSUB consecutive sub-ranges; a block is a hit
@@ -336,9 +342,12 @@ def _chunk_block_ids(pts, valid, bbox, radius: float, nchunks: int):
     hit_id = jnp.where(is_hit, order, 0)
     # pad slots ← running last hit (cummax works since ids ascend); the
     # list keeps FULL width nblocks, so no hit is ever dropped — sparsity
-    # is recovered in-kernel by the `fresh` skip, not by truncation
+    # is recovered by the narrow-grid truncation in _dense_pallas (exact
+    # whenever hits fit _NJ_CAP — the counts returned here prove it) and
+    # in-kernel by the `fresh` skip
     padded = jax.lax.cummax(jnp.where(is_hit, hit_id, -1), axis=1)
-    return jnp.maximum(padded, 0).astype(jnp.int32)
+    return (jnp.maximum(padded, 0).astype(jnp.int32),
+            jnp.sum(hit, axis=1).astype(jnp.int32))
 
 
 def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
@@ -358,12 +367,9 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
     mean = jnp.sum(jnp.where(vc, chunks, 0.0), axis=1) / cnt
     pts = jnp.where(vc, chunks, mean[:, None, :]).reshape(npad, 2)
 
-    ids = _chunk_block_ids(pts, val, bbox, radius, nchunks)
-    nj = ids.shape[1]        # = nblocks (full width, no truncation): the
-                             # grid dim must equal the id-list width or the
-                             # kernel reads the scalar ref out of bounds
+    ids, nhits = _chunk_block_ids(pts, val, bbox, radius, nchunks)
 
-    def call(ids_g, pts_g):
+    def call(ids_g, pts_g, nj):
         nc = ids_g.shape[0]
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -396,20 +402,41 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
             interpret=_INTERPRET,
         )(ids_g, pts_g, pack)
 
-    # The prefetched id list lives in SMEM (~1 MB), lane-padded to 128
-    # columns — cap chunks per pallas_call and sequence groups (XLA
-    # pipelines consecutive custom calls).
-    padded_cols = ((nj + 127) // 128) * 128
-    maxc = max(1, (512 * 1024) // (padded_cols * 4))
-    if nchunks <= maxc:
-        edge, off, dist = call(ids, pts)
-    else:
+    def sweep(ids_w):
+        """Full sweep at one static id-list width. The grid dim must
+        equal the id-list width or the kernel reads the scalar ref out of
+        bounds. The prefetched id list lives in SMEM (~1 MB), lane-padded
+        to 128 columns — cap chunks per pallas_call and sequence groups
+        (XLA pipelines consecutive custom calls)."""
+        nj = ids_w.shape[1]
+        padded_cols = ((nj + 127) // 128) * 128
+        maxc = max(1, (512 * 1024) // (padded_cols * 4))
+        if nchunks <= maxc:
+            # tuple(): the narrow/full cond branches can take different
+            # chunking paths here, and lax.cond requires identical output
+            # containers — don't rely on pallas_call's own return type
+            return tuple(call(ids_w, pts, nj))
         parts = []
         for lo in range(0, nchunks, maxc):
             hi = min(nchunks, lo + maxc)
-            parts.append(call(ids[lo:hi], pts[lo * _P:hi * _P]))
-        edge, off, dist = (jnp.concatenate(xs, axis=0)
-                           for xs in zip(*parts))
+            parts.append(call(ids_w[lo:hi], pts[lo * _P:hi * _P], nj))
+        return tuple(jnp.concatenate(xs, axis=0) for xs in zip(*parts))
+
+    # Narrow-grid launch (round-5 xl attribution): the full-width grid
+    # runs nblocks steps per chunk and big metros pay megasteps of empty
+    # launches — bayarea-xl's 1184-block table spent ~45% of its dispatch
+    # on culled slots (~85 ns each). Hits sort first, so truncating the
+    # id list to _NJ_CAP columns is EXACT whenever every chunk hits at
+    # most _NJ_CAP blocks (typical max is tens; the culling stats prove
+    # it per dispatch) — one traced cond falls back to the full-width
+    # sweep for the rare spread-out batch.
+    if ids.shape[1] > _NJ_CAP:
+        edge, off, dist = jax.lax.cond(
+            jnp.max(nhits) <= _NJ_CAP,
+            lambda: sweep(ids[:, :_NJ_CAP]),
+            lambda: sweep(ids))
+    else:
+        edge, off, dist = sweep(ids)
     return edge[:n], off[:n], dist[:n]
 
 

@@ -284,3 +284,57 @@ print("INTERPRET_PARITY_OK")
                           cwd=os.path.dirname(os.path.dirname(
                               os.path.abspath(__file__))))
     assert "INTERPRET_PARITY_OK" in proc.stdout, proc.stderr[-2000:]
+
+
+def test_pallas_narrow_grid_cap_both_branches():
+    """The narrow-grid launch (_NJ_CAP truncation) and its full-width
+    fallback must both reproduce the jnp sweep exactly. Interpret-mode
+    subprocess with the cap forced tiny so BOTH cond branches execute:
+    a spatially tight batch fits the cap (narrow sweep), a spread-out
+    batch exceeds it (fallback)."""
+    import os
+    import subprocess
+    import sys
+
+    script = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, jax.numpy as jnp
+from reporter_tpu.config import CompilerParams
+from reporter_tpu.netgen.synthetic import generate_city
+import reporter_tpu.ops.dense_candidates as dc
+from reporter_tpu.tiles.compiler import compile_network
+
+dc._NJ_CAP = 4      # force the cond on a 13-block tile
+ts = compile_network(generate_city("sf"), CompilerParams())
+t = ts.device_tables()
+assert t["seg_bbox"].shape[0] > dc._NJ_CAP
+rng = np.random.default_rng(3)
+lo = ts.node_xy.min(axis=0)
+hi = ts.node_xy.max(axis=0)
+
+# tight batch: one street corner's worth of points -> hits <= cap
+tight = (lo + 0.4 * (hi - lo)
+         + rng.uniform(0, 60.0, (300, 2))).astype(np.float32)
+# spread batch: points over the whole metro -> some chunk exceeds the cap
+spread = rng.uniform(lo, hi, (300, 2)).astype(np.float32)
+
+for name, pts in (("tight", tight), ("spread", spread)):
+    pall = dc.find_candidates_dense(
+        jnp.asarray(pts), (t["seg_pack"], t["seg_bbox"]), 50.0, 8)
+    e, o, d = dc._dense_jnp(jnp.asarray(pts), (t["seg_pack"], None), 50.0, 8)
+    assert (np.asarray(pall.edge) == np.asarray(e)).all(), name
+    assert np.allclose(np.asarray(pall.dist), np.asarray(d),
+                       rtol=1e-5, atol=1e-2), name
+print("NARROW_GRID_OK")
+"""
+    env = dict(os.environ)
+    env["RTPU_PALLAS_INTERPRET"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert "NARROW_GRID_OK" in proc.stdout, proc.stderr[-2000:]
