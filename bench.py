@@ -192,13 +192,17 @@ def _timed_pair(m, traces, repeats: int) -> tuple[float, float]:
     return dt, dt_dec
 
 
-def _oracle_audit(ts, jax_matcher, traces, n: int, config=None):
+def _oracle_audit(ts, jax_matcher, traces, n: int, config=None,
+                  force_fresh: bool = False):
     """Fidelity vs the exact-Dijkstra CPU oracle on n traces. Returns
     (disagreement, cpu_pps, n, source) — source is "cache" when the
     oracle records were replayed from disk, "fresh" when recomputed
     (VERDICT r3 weak #3: fidelity provenance must be visible in the
-    capture). ``config`` carries mode presets (bicycle audit); the
-    matcher params are part of the cache key either way.
+    capture). ``force_fresh`` skips the cache read (the per-run fresh
+    rotation leg, VERDICT r4 weak #2 — every capture must contain at
+    least one freshly computed oracle comparison). ``config`` carries
+    mode presets (bicycle audit); the matcher params are part of the
+    cache key either way.
 
     The oracle's output is a PURE function of (tile, traces, params), so
     its (segment_id, length) pairs — all the fidelity metric reads — are
@@ -240,7 +244,7 @@ def _oracle_audit(ts, jax_matcher, traces, n: int, config=None):
     cpu = SegmentMatcher(ts, dataclasses.replace(
         cfg, matcher_backend="reference_cpu"))
     rc = None
-    if os.path.exists(path):
+    if not force_fresh and os.path.exists(path):
         try:
             with np.load(path) as z:
                 seg, length, bounds = z["seg"], z["length"], z["bounds"]
@@ -448,14 +452,21 @@ def _streaming_columnar_bench(ts, traces, n_stream: int) -> dict:
 
 
 def _streaming_soak(ts, traces, n_stream: int, seconds: float = 32.0,
-                    offered_pps: int = 250_000) -> dict:
+                    offered_pps: int = 150_000) -> dict:
     """Steady-arrival soak (VERDICT r4 next #2): a paced producer offers
     ``offered_pps`` into the columnar broker while the worker polls,
     flushes, and truncates retention, for ≥30 s of wall clock. Reports
     sustained consume rate, end/max lag (bounded lag == keeping up), and
     the p50/p99 consume→report latency over every flushed probe (buffer
     wait + device match; arrival-to-consume is ≤ one step in this
-    single-threaded drive)."""
+    single-threaded drive).
+
+    Operating point: 150k pps offered with 120-point flush waves. The
+    phase-locked firehose ripens every vehicle at once, so each wave is a
+    ~240k-probe flush (~0.9 s: the drain leg's measured rate); smaller
+    waves pay the per-flush link RTT more often — run 1 measured ~124k
+    pps capacity at 40-point waves vs ~275k at 120 — and an offered rate
+    above capacity just grows the backlog without bound."""
     import numpy as np
 
     from reporter_tpu.config import Config, StreamingConfig
@@ -468,7 +479,7 @@ def _streaming_soak(ts, traces, n_stream: int, seconds: float = 32.0,
     #                                 vehicle's stream keeps moving forward
     queue = ColumnarIngestQueue(4)
     cfg = Config(matcher_backend="jax",
-                 streaming=StreamingConfig(flush_min_points=40,
+                 streaming=StreamingConfig(flush_min_points=120,
                                            poll_max_records=300_000,
                                            hist_flush_interval=0.0))
     pipe = ColumnarStreamPipeline(ts, cfg, queue=queue)
@@ -527,22 +538,88 @@ def _streaming_soak(ts, traces, n_stream: int, seconds: float = 32.0,
     }
 
 
-def _device_compute_probe(m, traces, link_rtt: float) -> dict:
-    """Device-only decode rate (VERDICT r3 #6): stage one full uniform
-    slice's quantized inputs on the device, dispatch the match kernel K
-    times back-to-back, sync ONCE via a host readback (the only real sync
-    on the remote-attached link — see CLAUDE.md). The window then holds
-    K dispatch+computes plus one readback, so
+_V5E_HBM_BYTES_PER_S = 819e9    # v5e public peak HBM bandwidth
+_V5E_VPU_F32_PER_S = 3.9e12     # ≈ (8, 128) lanes × 4 ALUs × 940 MHz — the
+#                                 sweep is elementwise VPU work, not MXU
+_SWEEP_PAIR_FLOPS = 25          # f32 ops per point-segment pair in
+#                                 _block_geometry (clamped projection + d2 +
+#                                 offset); _select_topk adds ~2x more on the
+#                                 blocks that pass the radius test, so the
+#                                 reported utilization is a floor
+
+
+def _sweep_roofline(m, pts: "np.ndarray", per_dispatch_s: float) -> dict:
+    """Calibrate one dispatch against the chip (VERDICT r4 next #4): the
+    culling pre-pass (ops.dense_candidates._chunk_block_ids) is
+    reproducible on host from the slice's points + the staged block
+    bboxes, so swept HBM bytes and pair FLOPs per dispatch are exactly
+    knowable — achieved vs peak says what fraction of a v5e the sweep
+    actually uses, instead of 'fast relative to round N-1'."""
+    import numpy as np
+
+    from reporter_tpu.ops import dense_candidates as dc
+
+    if "seg_bbox" not in m._tables:
+        return {"note": "grid backend staged — no dense sweep to calibrate"}
+    bbox = np.asarray(m._tables["seg_bbox"])           # [nblocks, 4]
+    radius = float(m.params.search_radius)
+    P, NSUB = dc._P, dc._NSUB
+    flat = pts.reshape(-1, 2).astype(np.float64)
+    n = len(flat)
+    nchunks = (n + P - 1) // P
+    pad = nchunks * P - n
+    if pad:                       # bench slices are uniform/full — pad with
+        flat = np.concatenate([flat, flat[-1:].repeat(pad, 0)])   # last pt
+    sub = flat.reshape(nchunks * NSUB, P // NSUB, 2)
+    lo = sub.min(axis=1) - radius                      # [nc*NSUB, 2]
+    hi = sub.max(axis=1) + radius
+    hit = ((bbox[None, :, 0] <= hi[:, 0:1])
+           & (bbox[None, :, 2] >= lo[:, 0:1])
+           & (bbox[None, :, 1] <= hi[:, 1:2])
+           & (bbox[None, :, 3] >= lo[:, 1:2]))         # NaN pad rows: False
+    hits_per_chunk = hit.reshape(nchunks, NSUB, -1).any(axis=1).sum(axis=1)
+    nvisits = int(hits_per_chunk.sum())
+    block_bytes = dc.SP_NCOMP * dc._SBLK * 4
+    bytes_swept = nvisits * block_bytes
+    flops = nvisits * P * dc._SBLK * _SWEEP_PAIR_FLOPS
+    bw = bytes_swept / per_dispatch_s
+    fl = flops / per_dispatch_s
+    return {
+        "blocks_total": int(bbox.shape[0]),
+        "block_visits_per_dispatch": nvisits,
+        "mean_blocks_per_chunk": round(float(hits_per_chunk.mean()), 1),
+        "culled_fraction": round(
+            1.0 - nvisits / (nchunks * bbox.shape[0]), 4),
+        "hbm_bytes_swept": int(bytes_swept),
+        "pair_flops": int(flops),
+        "achieved_GBps": round(bw / 1e9, 1),
+        "achieved_Gflops": round(fl / 1e9, 1),
+        "pct_of_v5e_hbm_peak": round(100 * bw / _V5E_HBM_BYTES_PER_S, 1),
+        "pct_of_v5e_vpu_f32_peak": round(100 * fl / _V5E_VPU_F32_PER_S, 1),
+        "note": ("pair-geometry FLOPs only (floor); top-K selection adds "
+                 "~2x on radius-passing blocks"),
+    }
+
+
+def _device_compute_probe(m, traces, link_rtt: float,
+                          roofline: bool = True) -> dict:
+    """Per-leg decode attribution (VERDICT r3 #6 / r4 next #3, #4): stage
+    one full uniform slice's quantized inputs on the device, dispatch the
+    match kernel K times back-to-back, sync ONCE via a host readback (the
+    only real sync on the remote-attached link — see CLAUDE.md):
         device_s_per_dispatch ≈ (elapsed - link_rtt) / K.
-    Also times host-side submit of the full batch (async dispatches, no
-    harvest): co-located throughput is bounded by the slower of the two
-    pipeline legs — that bound is the published projection."""
+    Then decompose the rest of the pipeline for THIS tile: wire readback
+    (transfer-only: harvest a wire whose compute was already forced by a
+    2-byte sync), host C++ walk of the slice, and host-side submit of the
+    full batch. The slowest leg names the optimization target; the
+    roofline block calibrates the sweep against v5e peaks."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from reporter_tpu.matcher.api import _bucket_len
-    from reporter_tpu.ops.match import OFFSET_QUANTUM, match_batch_wire_q
+    from reporter_tpu.ops.match import (OFFSET_QUANTUM, match_batch_wire_q,
+                                        unpack_wire)
 
     K = 24
     B = max(1, m.params.max_device_batch)
@@ -569,6 +646,30 @@ def _device_compute_probe(m, traces, link_rtt: float) -> dict:
     np.asarray(wire)
     per_dispatch = max((time.perf_counter() - t0 - link_rtt) / K, 1e-6)
 
+    # wire readback, transfer-only: force the dispatch's compute with a
+    # 2-byte sync first, so the timed full harvest measures link transfer
+    # (+1 RTT), not compute (jax caches the host copy after a harvest, so
+    # this needs a FRESH dispatch, not a re-asarray of `wire`)
+    w2 = match_batch_wire_q(*args, m._tables, m.ts.meta, m.params, None,
+                            spec=spec)
+    np.asarray(w2[0, 0, :1])
+    t0 = time.perf_counter()
+    host_wire = np.asarray(w2)
+    dt_readback = time.perf_counter() - t0
+
+    # host walk of the slice (the post-harvest leg of the e2e path)
+    edges, offs, starts = unpack_wire(host_wire, spec)
+    times = np.zeros(edges.shape, np.float64)
+    times[:] = np.arange(edges.shape[1])[None, :]
+    dt_walk = None
+    if m._native_walker is not None:
+        m._native_walker.walk_columns(edges, offs, starts, times,
+                                      m.params.backward_slack)   # warm
+        t0 = time.perf_counter()
+        m._native_walker.walk_columns(edges, offs, starts, times,
+                                      m.params.backward_slack)
+        dt_walk = time.perf_counter() - t0
+
     t0 = time.perf_counter()
     work, inflight = m._submit_many(traces)
     dt_submit = time.perf_counter() - t0        # host leg, dispatches async
@@ -577,17 +678,159 @@ def _device_compute_probe(m, traces, link_rtt: float) -> dict:
 
     probes_slice = len(sub) * T
     probes_all = sum(len(t.xy) for t in traces)
-    device_s_batch = per_dispatch * (probes_all / probes_slice)
-    return {
+    scale = probes_all / probes_slice
+    device_s_batch = per_dispatch * scale
+    walk_s_batch = None if dt_walk is None else dt_walk * scale
+    legs = {"device_sweep_s": round(device_s_batch, 3),
+            "host_submit_s": round(dt_submit, 3),
+            "host_walk_s": (None if walk_s_batch is None
+                            else round(walk_s_batch, 3)),
+            "readback_s": round(dt_readback * scale, 3)}
+    # readback overlaps device compute at batch size (measured r4: i8-vs-
+    # i16 interleave showed zero wall difference); submit and walk share
+    # the one host core — the e2e bound is the slower of (host legs,
+    # device leg)
+    host_s = dt_submit + (walk_s_batch or 0.0)
+    binding = ("host_submit+walk" if host_s >= device_s_batch
+               else "device_sweep")
+    out = {
         "device_ms_per_dispatch": round(per_dispatch * 1e3, 2),
         "dispatch_shape": f"{len(sub)}x{T}pts",
         "device_probes_per_sec": round(probes_slice / per_dispatch, 1),
+        "readback_ms_per_slice": round(dt_readback * 1e3, 2),
+        "wire_bytes_per_slice": int(host_wire.nbytes),
+        "readback_MBps": round(
+            host_wire.nbytes / max(dt_readback - link_rtt, 1e-6) / 1e6, 1),
+        "host_walk_ms_per_slice": (None if dt_walk is None
+                                   else round(dt_walk * 1e3, 2)),
         "host_submit_s_per_batch": round(dt_submit, 3),
         "device_s_per_batch": round(device_s_batch, 3),
+        "legs_s_per_batch": legs,
+        "binding_leg": binding,
         # co-located = no link in the loop: the slower pipeline leg rules
         "colocated_probes_per_sec": round(
             probes_all / max(dt_submit, device_s_batch), 1),
+        "colocated_e2e_probes_per_sec": round(
+            probes_all / max(host_s, device_s_batch), 1),
     }
+    if roofline:
+        out["roofline"] = _sweep_roofline(m, pts, per_dispatch)
+    return out
+
+
+def _matcher_only_latency(m, trace, link_rtt: float,
+                          K: int = 16) -> "float | None":
+    """Co-located B=1 decode latency (VERDICT r4 next #8): K chained B=1
+    wire dispatches, ONE sync, so (window - RTT)/K is the device's own
+    per-trace time with the link amortized out. Median of 3 windows."""
+    import jax
+    import numpy as np
+
+    from reporter_tpu.matcher.api import _bucket_len
+    from reporter_tpu.ops.match import OFFSET_QUANTUM, match_batch_wire_q
+
+    T = len(trace.xy)
+    b = _bucket_len(T)
+    pts = np.zeros((1, b, 2), np.float32)
+    pts[0, :T] = trace.xy
+    pts[0, T:] = pts[0, :1]
+    lens = np.full(1, T, np.int32)
+    origins = pts[:, 0, :].copy()
+    dq = np.round((pts - origins[:, None, :]) * np.float32(1 / OFFSET_QUANTUM))
+    args = (jax.device_put(dq.astype(np.int16)), jax.device_put(origins),
+            jax.device_put(lens))
+    np.asarray(args[0][0, 0])
+    spec = getattr(m, "_wire_spec", None)
+    wire = match_batch_wire_q(*args, m._tables, m.ts.meta, m.params, None,
+                              spec=spec)
+    np.asarray(wire)                            # warm the B=1 executable
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(K):
+            wire = match_batch_wire_q(*args, m._tables, m.ts.meta,
+                                      m.params, None, spec=spec)
+        np.asarray(wire)
+        windows.append(max((time.perf_counter() - t0 - link_rtt) / K, 1e-6))
+    return sorted(windows)[1]
+
+
+def _service_saturation_curve(app, ts, traces, levels=(16, 64, 256),
+                              rounds: int = 2) -> list:
+    """Leader-combining under increasing concurrency (VERDICT r4 next #9):
+    for each level, N threads POST single-trace requests through the real
+    request path simultaneously; per level records req/s, p50/p99 request
+    latency, combining evidence (batches per round), and error behavior —
+    the overload story past the single measured point r4 had."""
+    import threading
+
+    import numpy as np
+
+    from reporter_tpu.geometry import xy_to_lonlat
+
+    n_max = min(max(levels), len(traces))
+    origin = np.asarray(ts.meta.origin_lonlat)
+    payloads = []
+    for i, t in enumerate(traces[:n_max]):
+        lonlat = xy_to_lonlat(np.asarray(t.xy, np.float64), origin)
+        payloads.append({"uuid": f"conc-{i}", "trace": [
+            {"lat": float(la), "lon": float(lo), "time": float(tt)}
+            for (lo, la), tt in zip(lonlat, t.times)]})
+
+    curve = []
+    for level in levels:
+        n = min(level, len(payloads))
+        errors: list = []
+
+        def _round(record: "list | None", n=n, errors=errors):
+            barrier = threading.Barrier(n)
+
+            def worker(p):
+                barrier.wait()
+                t0 = time.perf_counter()
+                try:
+                    app.report_one(p)
+                except Exception as exc:   # a dead thread must not
+                    errors.append(repr(exc))   # silently skew the p50
+                    return
+                if record is not None:
+                    record.append(time.perf_counter() - t0)
+
+            threads = [threading.Thread(target=worker, args=(p,))
+                       for p in payloads[:n]]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+
+        batches_before = app.stats["batches"]
+        _round(None)                 # warm (pays combined-shape jit)
+        lats: list = []
+        wall = 0.0
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            _round(lats)
+            wall += time.perf_counter() - t0
+        lats.sort()
+        entry = {
+            "clients": n,
+            "rounds": rounds,
+            "req_per_sec": (round(len(lats) / wall, 1)
+                            if lats and wall > 0 else None),
+            "p50_ms": (round(lats[len(lats) // 2] * 1e3, 1)
+                       if lats else None),
+            "p99_ms": (round(lats[min(len(lats) - 1,
+                                      int(len(lats) * 0.99))] * 1e3, 1)
+                       if lats else None),
+            "errors": len(errors),
+            # overload behavior = queue-and-combine, never shed: batches
+            # per round shows how many device dispatches N requests cost
+            "device_batches": app.stats["batches"] - batches_before,
+        }
+        if errors:
+            entry["error_samples"] = errors[:3]
+        curve.append(entry)
+    return curve
 
 
 def _cached_mode_tileset():
@@ -695,60 +938,30 @@ def main() -> None:
     import numpy as np
     link_rtt = _link_rtt()
 
+    # Matcher-only B=1 latency (VERDICT r4 next #8): the per-trace number
+    # a CO-LOCATED deployment would quote. K chained B=1 dispatches with
+    # ONE sync amortize the link RTT away, leaving the device's own
+    # single-trace decode time; median of 3 windows.
+    p50_matcher_only = _matcher_only_latency(jax_matcher, traces[0],
+                                             link_rtt)
+
     # Mitigation: the service's leader-combining (service/app.py) coalesces
     # concurrent single-trace requests into ONE device batch, so N clients
-    # share one link round-trip instead of paying N. Measure per-request
-    # p50 under 16 concurrent requests through the real request path.
-    import threading
-
-    from reporter_tpu.geometry import xy_to_lonlat
+    # share one link round-trip instead of paying N. Saturation curve
+    # (VERDICT r4 next #9): sweep 16/64/256 concurrent clients through the
+    # real request path — req/s, p50/p99, and error behavior per level.
     from reporter_tpu.service.app import ReporterApp
 
     app = ReporterApp(ts, Config(matcher_backend="jax"))
-    n_conc = min(16, len(traces))
-    payloads = []
-    for i, t in enumerate(traces[:n_conc]):
-        lonlat = xy_to_lonlat(np.asarray(t.xy, np.float64),
-                              np.asarray(ts.meta.origin_lonlat))
-        payloads.append({"uuid": f"conc-{i}", "trace": [
-            {"lat": float(la), "lon": float(lo), "time": float(tt)}
-            for (lo, la), tt in zip(lonlat, t.times)]})
-
-    conc_errors: list = []
-
-    def _concurrent_round(record: "list | None"):
-        barrier = threading.Barrier(n_conc)
-
-        def worker(p):
-            barrier.wait()
-            t0 = time.perf_counter()
-            try:
-                app.report_one(p)
-            except Exception as exc:   # a dead thread must not silently
-                conc_errors.append(repr(exc))  # skew (or empty) the p50
-                return
-            if record is not None:
-                record.append(time.perf_counter() - t0)
-
-        threads = [threading.Thread(target=worker, args=(p,))
-                   for p in payloads]
-        for th in threads:
-            th.start()
-        for th in threads:
-            th.join()
-
-    _concurrent_round(None)                    # warm (pays combined-shape jit)
-    conc_lat: list = []
-    conc_wall_total = 0.0
-    for _ in range(3):
-        t0 = time.perf_counter()
-        _concurrent_round(conc_lat)
-        conc_wall_total += time.perf_counter() - t0
-    conc_lat.sort()
-    conc_p50 = conc_lat[len(conc_lat) // 2] if conc_lat else None
-    # successes / total wall: errored requests must not inflate the rate
-    conc_rps = (len(conc_lat) / conc_wall_total
-                if conc_lat and conc_wall_total > 0 else None)
+    service_curve = _service_saturation_curve(app, ts, traces,
+                                              levels=(16, 64, 256))
+    lvl16 = service_curve[0]
+    n_conc = lvl16["clients"]
+    conc_p50 = (lvl16["p50_ms"] / 1e3 if lvl16["p50_ms"] is not None
+                else None)
+    conc_rps = lvl16["req_per_sec"]
+    conc_errors = [e for lvl in service_curve
+                   for e in lvl.get("error_samples", [])]
 
     # Fidelity audit leg 1 (BASELINE north star: <5% segment-ID
     # disagreement, length-weighted — matcher/fidelity.py, the same metric
@@ -759,6 +972,33 @@ def main() -> None:
     split["oracle_primary_s"] = round(time.perf_counter() - t0, 1)
     audit = {ts.name: {"traces": n_cpu, "disagreement": round(disagreement, 4),
                        "fidelity_source": fsrc}}
+
+    # Guaranteed-fresh rotation leg (VERDICT r4 weak #2/next #7): 25
+    # traces from a window that rotates every run, oracle recomputed from
+    # scratch regardless of cache state — every capture contains at least
+    # one freshly computed oracle comparison, on trace content the disk
+    # cache has (usually) never seen.
+    t0 = time.perf_counter()
+    rotf = _repo_path(".bench_fresh_rotation")
+    try:
+        with open(rotf) as f:
+            rot_k = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        rot_k = 0
+    with open(rotf, "w") as f:
+        f.write(str(rot_k + 1))
+    n_fresh = min(25, max(0, len(traces) - n_cpu))
+    if n_fresh:     # tiny fallback fleets: the audited set covers it all
+        span = max(1, len(traces) - n_cpu - n_fresh + 1)
+        lo = n_cpu + (rot_k * n_fresh) % span
+        fr_dis, _, fr_n, fr_src = _oracle_audit(
+            ts, jax_matcher, traces[lo:lo + n_fresh], n_fresh,
+            force_fresh=True)
+        audit[f"{ts.name}-fresh-rot"] = {
+            "traces": fr_n, "disagreement": round(fr_dis, 4),
+            "fidelity_source": fr_src, "rotation_index": rot_k,
+            "trace_window": [lo, lo + n_fresh]}
+    split["fresh_rotation_s"] = round(time.perf_counter() - t0, 1)
     truth = _truth_rates(ts, jax_matcher, traces, true_edges,
                          n=min(2000, n_traces))
 
@@ -772,6 +1012,8 @@ def main() -> None:
         "decode_only_probes_per_sec": round(decode_pps, 1),
         "e2e_over_decode": round(jax_pps / decode_pps, 3),
         "p50_single_trace_latency_ms": round(p50_latency * 1e3, 2),
+        "p50_matcher_only_ms": (round(p50_matcher_only * 1e3, 3)
+                                if p50_matcher_only is not None else None),
         "link_rtt_ms": round(link_rtt * 1e3, 2),
         "latency_note": (
             "CPU fallback — no device link in play" if not tpu_ok
@@ -783,6 +1025,7 @@ def main() -> None:
             round(conc_p50 * 1e3, 2) if conc_p50 is not None else None),
         f"concurrent{n_conc}_requests_per_sec": (
             round(conc_rps, 1) if conc_rps is not None else None),
+        "service_curve": service_curve,
         **({"concurrent_errors": conc_errors[:4]} if conc_errors else {}),
         "cpu_reference_probes_per_sec": round(cpu_pps, 1),
         "oracle_sample_traces": n_cpu,
@@ -875,6 +1118,9 @@ def main() -> None:
             "reach_audit": _reach_audit_cached(
                 xts, [np.asarray(t.xy, np.float64) for t in xtraces[:15]],
                 label=xts.name),
+            # VERDICT r4 next #3: attribute the xl slowdown — device sweep
+            # vs readback vs host walk vs submit, plus the sweep roofline
+            "device_compute": _device_compute_probe(xm, xtraces, link_rtt),
             "tile_source": xtile_info["source"],
             "tile_stats": xts.stats,
         }
@@ -926,6 +1172,8 @@ def main() -> None:
             "reach_audit": _reach_audit_cached(
                 oxts, [np.asarray(t.xy, np.float64)
                        for t in oxtraces[:8]], label=oxts.name),
+            "device_compute": _device_compute_probe(oxm, oxtraces,
+                                                    link_rtt),
             "tile_source": oxtile_info["source"],
             "tile_stats": oxts.stats,
         }
@@ -1107,7 +1355,7 @@ def _summary_line(doc: dict) -> dict:
                       ("organic_xl", "organic-xl")):
         v = _g(key, "probes_per_sec_e2e")
         if v is not None:
-            tiles[name] = v
+            tiles[name] = int(v)        # whole probes/s: the line budget
     per_tile = _g("audit", "per_tile", default={})
     summary = {
         "metric": doc["metric"],
@@ -1118,6 +1366,8 @@ def _summary_line(doc: dict) -> dict:
         "tiles_pps_e2e": tiles,
         "e2e_over_decode": d.get("e2e_over_decode"),
         "p50_single_trace_ms": d.get("p50_single_trace_latency_ms"),
+        "p50_matcher_only_ms": d.get("p50_matcher_only_ms"),
+        "xl_binding_leg": _g("xl", "device_compute", "binding_leg"),
         "link_rtt_ms_by_window": [
             d.get("link_rtt_ms"),
             _g("second_window", "link_rtt_ms")],
@@ -1143,13 +1393,13 @@ def _summary_line(doc: dict) -> dict:
         "streaming_pps": _g("streaming", "probes_per_sec"),
         # dict-pipeline pps + soak p99/offered/duration live in the detail
         # file only: the FINAL line must stay under the driver's ~1 KB tail
-        "soak": {k: _g("streaming_soak", k) for k in
-                 ("sustained_pps", "end_lag", "p50_probe_to_report_ms")},
+        "soak": {"pps": _g("streaming_soak", "sustained_pps"),
+                 "end_lag": _g("streaming_soak", "end_lag"),
+                 "p50_ms": _g("streaming_soak", "p50_probe_to_report_ms")},
         "colocated_pps": _g("device_compute", "colocated_probes_per_sec"),
         "device_ms_per_dispatch": _g("device_compute",
                                      "device_ms_per_dispatch"),
         "total_seconds": d.get("total_seconds"),
-        "detail_file": "BENCH_DETAIL.json",
     }
     return summary
 

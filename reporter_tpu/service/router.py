@@ -37,17 +37,29 @@ _MARGIN_M = 2000.0    # bbox dilation: probes just outside the grid still route
 
 
 class MetroRouter:
-    """WSGI app dispatching to per-metro ReporterApps."""
+    """WSGI app dispatching to per-metro ReporterApps.
+
+    ``meshes``: optional {metro name: jax.sharding.Mesh} deploying each
+    metro's matcher across its own device (sub)mesh — BASELINE config 4's
+    product shape: sharded-state (EP) via host probe→metro routing, data
+    parallelism within each metro's mesh (parallel/dp_e2e). Metros
+    without an entry stay single-device."""
 
     def __init__(self, tilesets: Sequence[TileSet],
                  config: Config | None = None,
-                 transport: Transport | None = None):
+                 transport: Transport | None = None,
+                 meshes: "dict | None" = None):
         if not tilesets:
             raise ValueError("need at least one tileset")
         names = [ts.name for ts in tilesets]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate metro names: {names}")
-        self.apps = {ts.name: ReporterApp(ts, config, transport=transport)
+        meshes = meshes or {}
+        unknown = set(meshes) - set(names)
+        if unknown:
+            raise ValueError(f"meshes for unknown metros: {sorted(unknown)}")
+        self.apps = {ts.name: ReporterApp(ts, config, transport=transport,
+                                          mesh=meshes.get(ts.name))
                      for ts in tilesets}
         self._bounds = {ts.name: self._lonlat_bounds(ts) for ts in tilesets}
         # overlapping/nested metros: route to the SMALLEST containing bbox
@@ -153,5 +165,6 @@ class MetroRouter:
 
 
 def make_router(tilesets: Sequence[TileSet], config: Config | None = None,
-                transport: Transport | None = None) -> MetroRouter:
-    return MetroRouter(tilesets, config, transport)
+                transport: Transport | None = None,
+                meshes: "dict | None" = None) -> MetroRouter:
+    return MetroRouter(tilesets, config, transport, meshes=meshes)

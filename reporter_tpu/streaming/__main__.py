@@ -51,6 +51,12 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--stdin-format", default=None,
                     help="also read raw payloads from stdin, normalized "
                          "via ProbeFormatter ('auto'|'json'|'csv')")
+    ap.add_argument("--columnar", action="store_true",
+                    help="use the columnar worker (streaming/columnar.py): "
+                         "vectorized consume/flush/report build; full "
+                         "columnar throughput additionally needs a batch "
+                         "broker — over the durable dict log, polls pay a "
+                         "per-record packing shim")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
@@ -65,8 +71,14 @@ def main(argv: "list[str] | None" = None) -> int:
     ts = TileSet.load(args.tiles)
     queue = DurableIngestQueue(args.broker_dir,
                                config.streaming.num_partitions)
-    pipe = StreamPipeline(ts, config, queue=queue,
-                          partitions=args.partitions)
+    if args.columnar:
+        from reporter_tpu.streaming.columnar import ColumnarStreamPipeline
+
+        pipe = ColumnarStreamPipeline(ts, config, queue=queue,
+                                      partitions=args.partitions)
+    else:
+        pipe = StreamPipeline(ts, config, queue=queue,
+                              partitions=args.partitions)
     if args.checkpoint and os.path.exists(
             args.checkpoint if args.checkpoint.endswith(".npz")
             else args.checkpoint + ".npz"):

@@ -126,13 +126,21 @@ class StreamPipeline:
         return self.step(force_flush=True)
 
     def _consume(self, p: int, off: int, rec: dict) -> None:
+        import math
+
         uuid = str(rec.get("uuid", ""))
         try:
             # Full conversion before any state change: a poison record must
-            # be droppable, never allowed to wedge its partition.
+            # be droppable, never allowed to wedge its partition. Finiteness
+            # included: float('nan') converts fine here but would fail the
+            # service validator at FLUSH time, where the points are already
+            # buffered and a raising flush retries forever.
             lat = float(rec["lat"])
             lon = float(rec["lon"])
             t = float(rec["time"]) if "time" in rec else None
+            if not (math.isfinite(lat) and math.isfinite(lon)
+                    and (t is None or math.isfinite(t))):
+                raise ValueError("non-finite coordinate")
         except (KeyError, TypeError, ValueError):
             self.malformed += 1
             return                                   # malformed: skip

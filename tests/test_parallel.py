@@ -477,3 +477,53 @@ class TestDpE2EProductPath:
         r8 = a8.report_many(payloads)
         assert r1 == r8
         assert pub1 == pub8
+
+
+class TestMeshedMetroRouter:
+    """BASELINE config 4's product shape: metros routed host-side (EP),
+    each metro's matcher dp-sharded over its OWN device submesh, behind
+    one MetroRouter endpoint — reports identical to single-device."""
+
+    def test_per_metro_submeshes(self, tiny_tiles):
+        import json
+
+        from reporter_tpu.config import CompilerParams, Config, ServiceConfig
+        from reporter_tpu.netgen.synthetic import generate_city
+        from reporter_tpu.netgen.traces import synthesize_probe
+        from reporter_tpu.parallel.mesh import make_mesh
+        from reporter_tpu.service.router import make_router
+
+        metro_b = compile_network(
+            generate_city("nyc", nx=8, ny=8),
+            CompilerParams(reach_radius=500.0, osmlr_max_length=200.0))
+        devices = jax.devices()
+        meshes = {tiny_tiles.name: make_mesh(tile=1, dp=4,
+                                             devices=devices[:4]),
+                  metro_b.name: make_mesh(tile=1, dp=4,
+                                          devices=devices[4:8])}
+        cfg = Config(service=ServiceConfig(
+            datastore_url="http://datastore.test/"))
+        pub_m, pub_1 = [], []
+        r_mesh = make_router([tiny_tiles, metro_b], cfg,
+                             transport=lambda u, b: pub_m.append(b) or 200,
+                             meshes=meshes)
+        r_one = make_router([tiny_tiles, metro_b], cfg,
+                            transport=lambda u, b: pub_1.append(b) or 200)
+        payloads = [synthesize_probe(ts, seed=s, num_points=60,
+                                     gps_sigma=3.0).to_report_json()
+                    for ts in (tiny_tiles, metro_b) for s in range(3)]
+        out_m = r_mesh.report_many(payloads)
+        out_1 = r_one.report_many(payloads)
+        assert out_m == out_1
+        assert pub_m == pub_1
+        assert {o["metro"] for o in out_m} == {tiny_tiles.name,
+                                               metro_b.name}
+
+    def test_unknown_metro_mesh_rejected(self, tiny_tiles):
+        from reporter_tpu.parallel.mesh import make_mesh
+        from reporter_tpu.service.router import make_router
+
+        with pytest.raises(ValueError, match="unknown metros"):
+            make_router([tiny_tiles],
+                        meshes={"nope": make_mesh(tile=1, dp=2,
+                                                  devices=jax.devices()[:2])})

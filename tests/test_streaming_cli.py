@@ -86,3 +86,30 @@ def test_worker_partition_subset(worker_env, capsys):
         total += out["reports"]
     assert total > 0
     assert sum(ends) == sum(len(p.times) for p in worker_env["fleet"])
+
+
+def test_worker_columnar_flag(worker_env, capsys):
+    """--columnar runs the columnar worker over the durable dict broker
+    (per-record packing shim on poll) and cross-restores the dict
+    worker's checkpoint schema."""
+    d = worker_env["dir"]
+    broker = str(d / "broker4")
+    ckpt = str(d / "col.ckpt")
+    q = DurableIngestQueue(broker, Config().streaming.num_partitions)
+    for p in worker_env["fleet"]:
+        for (lo, la), t in zip(p.lonlat, p.times):
+            q.append({"uuid": p.uuid, "lat": float(la), "lon": float(lo),
+                      "time": float(t)})
+    q.close()
+
+    assert main(["--tiles", worker_env["tiles"], "--broker-dir", broker,
+                 "--checkpoint", ckpt, "--max-steps", "3",
+                 "--columnar"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["lag"] == 0 and out["reports"] > 0
+
+    # restart the DICT worker on the columnar checkpoint: shared schema
+    assert main(["--tiles", worker_env["tiles"], "--broker-dir", broker,
+                 "--checkpoint", ckpt, "--max-steps", "1"]) == 0
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out2["lag"] == 0 and out2["reports"] == 0

@@ -390,3 +390,33 @@ class TestBuildReportColumns:
                     -1 if w.next_segment_id is None else w.next_segment_id,
                     w.start_time, w.end_time) for w in want]
             assert got == exp, trial
+
+
+class TestPoisonAcrossQueues:
+    def test_dict_pipeline_over_columnar_queue_drops_poison(
+            self, stream_tiles):
+        """A poison record packed into a ColumnarIngestQueue materializes
+        through the dict-poll shim as NaN coordinates; the dict pipeline
+        must count it malformed at CONSUME time — if it buffered the
+        point, the flush-time validator would raise on every retry and
+        wedge the partition forever."""
+        probes = [synthesize_probe(stream_tiles, seed=3, num_points=70,
+                                   gps_sigma=3.0)]
+        recs = _records(probes)
+        recs.insert(4, {"uuid": "poison", "lat": "garbage", "lon": 1.0})
+        recs.insert(9, {"uuid": probes[0].uuid, "lat": 37.75,
+                        "lon": -122.41, "time": "not-a-time"})
+        cfg = Config(service=ServiceConfig(datastore_url="http://ds.test/"),
+                     streaming=StreamingConfig(flush_min_points=8,
+                                               flush_max_age=1e9,
+                                               poll_max_records=1000,
+                                               hist_flush_interval=0.0))
+        q = ColumnarIngestQueue(cfg.streaming.num_partitions)
+        q.append_many(recs)
+        pipe = StreamPipeline(stream_tiles, cfg, queue=q,
+                              transport=lambda u, b: 200)
+        n = pipe.step()
+        n += pipe.drain()          # must not raise, must not wedge
+        assert pipe.malformed == 2
+        assert n > 0
+        assert pipe.stats()["lag"] == 0
