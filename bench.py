@@ -269,12 +269,15 @@ def _oracle_audit(ts, jax_matcher, traces, n: int, config=None,
         rc = cpu.match_many(traces[:n])
         cpu_pps = (sum(len(t.xy) for t in traces[:n])
                    / (time.perf_counter() - t0))
-        bounds = np.cumsum([0] + [len(r) for r in rc])
-        np.savez(path,
-                 seg=np.asarray([x.segment_id for r in rc for x in r],
-                                np.int64),
-                 length=np.asarray([x.length for r in rc for x in r]),
-                 bounds=bounds.astype(np.int64))
+        if not force_fresh:
+            # rotation legs never read their cache back (the window moves
+            # every run) — don't litter the repo with orphan npz files
+            bounds = np.cumsum([0] + [len(r) for r in rc])
+            np.savez(path,
+                     seg=np.asarray([x.segment_id for r in rc for x in r],
+                                    np.int64),
+                     length=np.asarray([x.length for r in rc for x in r]),
+                     bounds=bounds.astype(np.int64))
     rj = jax_matcher.match_many(traces[:n])
     return mean_disagreement(rj, rc), cpu_pps, n, source
 
@@ -685,7 +688,10 @@ def _device_compute_probe(m, traces, link_rtt: float,
             "host_submit_s": round(dt_submit, 3),
             "host_walk_s": (None if walk_s_batch is None
                             else round(walk_s_batch, 3)),
-            "readback_s": round(dt_readback * scale, 3)}
+            # transfers scale per-slice; the link RTT is paid once per
+            # batched harvest, not per slice
+            "readback_s": round(
+                max(dt_readback - link_rtt, 0.0) * scale + link_rtt, 3)}
     # readback overlaps device compute at batch size (measured r4: i8-vs-
     # i16 interleave showed zero wall difference); submit and walk share
     # the one host core — the e2e bound is the slower of (host legs,
@@ -716,6 +722,43 @@ def _device_compute_probe(m, traces, link_rtt: float,
     if roofline:
         out["roofline"] = _sweep_roofline(m, pts, per_dispatch)
     return out
+
+
+def _near_tie_stats(m, traces, n: int = 400) -> dict:
+    """Cross-road candidate near-tie density (VERDICT r4 weak #6): the
+    fraction of points whose nearest two candidates on DIFFERENT roads
+    (fwd/rev twins of one street always tie exactly — excluded via
+    edge_opp; where the top pair is a twin, the gap is to candidate 3)
+    sit within f32-flippable distance of each other. The organic residual
+    disagreement is attributed to near-tie resolution + path ambiguity in
+    prose; this makes the tie density a measured field the residual can
+    be compared against (organic vs sf)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from reporter_tpu.ops.match import batch_candidates
+
+    T0 = len(traces[0].xy)
+    sub = [t for t in traces[:n] if len(t.xy) == T0]
+    pts = np.stack([t.xy for t in sub]).astype(np.float32)
+    valid = np.ones(pts.shape[:2], bool)
+    c = batch_candidates(jnp.asarray(pts), jnp.asarray(valid), m._tables,
+                         m.ts.meta, m.params)
+    d = np.asarray(c.dist)
+    v = np.asarray(c.valid)
+    e = np.asarray(c.edge)
+    opp = m.ts.edge_opp
+    twin = v[..., 1] & (e[..., 1] == opp[np.maximum(e[..., 0], 0)])
+    alt = np.where(twin, 2, 1)                  # first non-twin rival
+    has = np.take_along_axis(v, alt[..., None], -1)[..., 0] & v[..., 0]
+    gap = (np.take_along_axis(d, alt[..., None], -1)[..., 0]
+           - d[..., 0])[has]
+    return {
+        "points": int(has.sum()),
+        "exact_tie_fraction": round(float((gap == 0.0).mean()), 5),
+        "lt_1cm_fraction": round(float((gap < 0.01).mean()), 5),
+        "lt_1m_fraction": round(float((gap < 1.0).mean()), 5),
+    }
 
 
 def _matcher_only_latency(m, trace, link_rtt: float,
@@ -803,8 +846,12 @@ def _service_saturation_curve(app, ts, traces, levels=(16, 64, 256),
             for th in threads:
                 th.join()
 
-        batches_before = app.stats["batches"]
         _round(None)                 # warm (pays combined-shape jit)
+        # snapshot AFTER the warm round: device_batches must count the
+        # measured rounds only, and a transient warm-round error must not
+        # contradict the measured req/s (errors also reset here)
+        batches_before = app.stats["batches"]
+        errors.clear()
         lats: list = []
         wall = 0.0
         for _ in range(rounds):
@@ -1030,6 +1077,7 @@ def main() -> None:
         "cpu_reference_probes_per_sec": round(cpu_pps, 1),
         "oracle_sample_traces": n_cpu,
         "segment_id_disagreement_vs_cpu_ref": round(disagreement, 4),
+        "near_tie": _near_tie_stats(jax_matcher, traces),
         "ground_truth": truth,
         "batch_seconds": round(dt_jax, 3),
         "tile_source": tile_info["source"],
@@ -1139,6 +1187,32 @@ def main() -> None:
         o_dis, _, o_n, o_src = _oracle_audit(ots, om, otraces, 80)
         audit[ots.name] = {"traces": o_n, "disagreement": round(o_dis, 4),
                            "fidelity_source": o_src}
+        # VERDICT r4 weak #6: put the residual's attribution in the
+        # ARTIFACT. (a) near-tie density: the population of points whose
+        # distinct-road candidate gap is f32-flippable, organic vs sf;
+        # (b) K-escalation: if the residual were tied-candidate overflow
+        # (the r4 root cause, since fixed), widening K would shrink it.
+        import dataclasses as _dc
+
+        from reporter_tpu.config import Config as _Config2
+        from reporter_tpu.config import MatcherParams as _MP
+
+        cfg12 = _Config2(matcher_backend="jax",
+                         matcher=_dc.replace(_MP(), max_candidates=12))
+        om12 = SegmentMatcher(ots, cfg12)
+        o12_dis, _, _, o12_src = _oracle_audit(ots, om12, otraces, 80,
+                                               config=cfg12)
+        detail["organic_residual_attribution"] = {
+            "near_tie": _near_tie_stats(om, otraces),
+            "near_tie_sf": detail["near_tie"],
+            "disagreement_k8": round(o_dis, 4),
+            "disagreement_k12": round(o12_dis, 4),
+            "k12_fidelity_source": o12_src,
+            "note": ("K-escalation probes tied-candidate overflow; the "
+                     "near-tie fractions bound the f32-flippable "
+                     "population the prose attributes the residual to"),
+        }
+        del om12
         detail["organic"] = {
             "config": f"{len(otraces)}x{n_points}pt traces, tile={ots.name}",
             "probes_per_sec_e2e": round(o_pps, 1),

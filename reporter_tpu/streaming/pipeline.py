@@ -16,6 +16,7 @@ checkpointing buffers and histograms too).
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Any, Sequence
 
@@ -126,8 +127,6 @@ class StreamPipeline:
         return self.step(force_flush=True)
 
     def _consume(self, p: int, off: int, rec: dict) -> None:
-        import math
-
         uuid = str(rec.get("uuid", ""))
         try:
             # Full conversion before any state change: a poison record must

@@ -35,3 +35,9 @@ def test_quickstart_runs():
 def test_streaming_demo_runs():
     out = _run("streaming_demo.py")
     assert "replay" in out.lower() or "restore" in out.lower(), out
+
+
+def test_multichip_demo_runs():
+    out = _run("multichip.py")
+    assert "bit-identical to single-device: True" in out
+    assert "MetroRouter over submeshes" in out
