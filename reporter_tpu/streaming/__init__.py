@@ -26,12 +26,14 @@ from reporter_tpu.streaming.columnar import (
 from reporter_tpu.streaming.formatter import ProbeFormatter
 from reporter_tpu.streaming.queue import IngestQueue
 from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+from reporter_tpu.streaming.durable_columnar import DurableColumnarIngestQueue
 from reporter_tpu.streaming.histogram import SpeedHistogram
 from reporter_tpu.streaming.pipeline import StreamPipeline
 from reporter_tpu.streaming.worker import StreamWorker
 
 __all__ = ["ColumnarIngestQueue", "ColumnarStreamPipeline",
-           "ColumnarTraceCache", "DurableIngestQueue", "IngestQueue",
+           "ColumnarTraceCache", "DurableColumnarIngestQueue",
+           "DurableIngestQueue", "IngestQueue",
            "ProbeColumns", "ProbeConsumer", "ProbeFormatter",
            "SpeedHistogram", "StreamPipeline", "StreamWorker",
            "pack_records"]

@@ -69,8 +69,24 @@ def main(argv: "list[str] | None" = None) -> int:
 
     config = Config.load(args.config)   # JSON file + env overrides
     ts = TileSet.load(args.tiles)
-    queue = DurableIngestQueue(args.broker_dir,
-                               config.streaming.num_partitions)
+    # Broker directories are format-specific (meta.json pins it): reopen
+    # with the class that wrote them; a NEW directory takes the columnar
+    # format iff this worker is columnar.
+    from reporter_tpu.streaming.durable_queue import read_broker_format
+
+    existing_fmt = read_broker_format(args.broker_dir)
+    use_columnar_broker = (existing_fmt == "columnar"
+                           or (existing_fmt is None and args.columnar))
+    if use_columnar_broker:
+        from reporter_tpu.streaming.durable_columnar import (
+            DurableColumnarIngestQueue,
+        )
+
+        queue = DurableColumnarIngestQueue(args.broker_dir,
+                                           config.streaming.num_partitions)
+    else:
+        queue = DurableIngestQueue(args.broker_dir,
+                                   config.streaming.num_partitions)
     if args.columnar:
         from reporter_tpu.streaming.columnar import ColumnarStreamPipeline
 
@@ -90,7 +106,13 @@ def main(argv: "list[str] | None" = None) -> int:
         from reporter_tpu.streaming.formatter import ProbeFormatter
 
         fmt = ProbeFormatter(args.stdin_format)
-        n = fmt.format_stream((line for line in sys.stdin), queue)
+        if use_columnar_broker:
+            # columnar broker: normalize stdin in batches so the log
+            # stores column frames, not one frame per record
+            n = fmt.format_stream_columns((line for line in sys.stdin),
+                                          queue)
+        else:
+            n = fmt.format_stream((line for line in sys.stdin), queue)
         log.info("stdin feed: %d records normalized, %d dropped",
                  n, fmt.stats()["dropped"])
 

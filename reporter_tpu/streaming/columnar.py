@@ -143,9 +143,17 @@ class ColumnarIngestQueue:
                 idx = np.nonzero(prow == p)[0]
                 if not len(idx):
                     continue
+                sub = cols.rows(idx)
+                # durability hook BEFORE the in-memory append, so on-disk
+                # batch order always matches offset order (same discipline
+                # as IngestQueue._persist)
+                self._persist_batch(p, sub)
                 self._bases[p].append(self._end[p])
-                self._batches[p].append(cols.rows(idx))
+                self._batches[p].append(sub)
                 self._end[p] += len(idx)
+
+    def _persist_batch(self, p: int, cols: ProbeColumns) -> None:
+        """Durability hook (DurableColumnarIngestQueue). No-op in-proc."""
 
     def append(self, record: dict) -> None:
         self.append_columns(pack_records([record]))
@@ -221,7 +229,14 @@ class ColumnarIngestQueue:
                     self._batches[p] = batches[k:]
                 new_floor = (self._bases[p][0] if self._bases[p]
                              else min(off, self._end[p]))
-                self._floor[p] = max(self._floor[p], new_floor)
+                if new_floor > self._floor[p]:
+                    self._floor[p] = new_floor
+                    self._persist_truncate(p)
+
+    def _persist_truncate(self, p: int) -> None:
+        """Durability hook: rewrite partition p's backing store to match
+        the truncated in-memory state. Runs under the lock. No-op
+        in-proc."""
 
 
 # ---------------------------------------------------------------------------

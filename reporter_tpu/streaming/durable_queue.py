@@ -43,6 +43,55 @@ def _encode(record: dict) -> bytes:
     return json.dumps(record, separators=(",", ":")).encode() + b"\n"
 
 
+def read_broker_format(dir: str) -> "str | None":
+    """The format a broker directory was created with ('records' |
+    'columnar'), or None for a fresh/absent directory. The one meta.json
+    defaulting rule — shared by both durable queue classes and the
+    worker CLI's broker sniff."""
+    meta_path = os.path.join(dir, "meta.json")
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        return json.load(f).get("format", "records")
+
+
+def open_or_create_meta(dir: str, fmt: str, num_partitions: int,
+                        other_class: str) -> None:
+    """Pin (or validate) a broker directory's identity: partition count
+    and log format. The pin is written once, fsync'd (file AND
+    directory) — losing it to a power cut while fsync'd records survive
+    would let a mis-configured reopen recreate it wrong; a mismatched
+    reopen is refused, never reinterpreted."""
+    os.makedirs(dir, exist_ok=True)
+    meta_path = os.path.join(dir, "meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        stored_fmt = meta.get("format", "records")
+        if stored_fmt != fmt:
+            raise ValueError(
+                f"{dir}: broker log format is {stored_fmt!r}, not "
+                f"{fmt!r} — directories are format-specific; use "
+                f"{other_class} or a fresh directory")
+        stored = int(meta["num_partitions"])
+        if stored != num_partitions:
+            raise ValueError(
+                f"{dir}: log was created with num_partitions={stored}, "
+                f"reopened with {num_partitions} — records would "
+                "be orphaned/mis-routed; migrate explicitly instead")
+        return
+    with open(meta_path + ".tmp", "w") as f:
+        json.dump({"num_partitions": num_partitions, "format": fmt}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(meta_path + ".tmp", meta_path)
+    dfd = os.open(dir, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 class DurableIngestQueue(IngestQueue):
     """IngestQueue whose log survives the process."""
 
@@ -51,35 +100,11 @@ class DurableIngestQueue(IngestQueue):
         super().__init__(num_partitions)
         self.dir = dir
         self._fsync = bool(fsync)
-        os.makedirs(dir, exist_ok=True)
-        # The partition count is part of the log's identity: reopening
-        # with a different count would orphan partitions and re-route
-        # uuids under the consumer's committed offsets. Pin it on first
-        # creation; refuse a mismatched reopen.
-        meta_path = os.path.join(dir, "meta.json")
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                stored = int(json.load(f)["num_partitions"])
-            if stored != self.num_partitions:
-                raise ValueError(
-                    f"{dir}: log was created with num_partitions={stored}, "
-                    f"reopened with {self.num_partitions} — records would "
-                    "be orphaned/mis-routed; migrate explicitly instead")
-        else:
-            # Always fsync the pin (file AND directory): it is written once,
-            # and losing it to a power cut while fsync'd records survive
-            # would let a mis-configured reopen recreate it with the wrong
-            # count — the exact corruption the guard refuses.
-            with open(meta_path + ".tmp", "w") as f:
-                json.dump({"num_partitions": self.num_partitions}, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(meta_path + ".tmp", meta_path)
-            dfd = os.open(dir, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+        # The partition count and format are the log's identity: a
+        # mismatched reopen is refused (open_or_create_meta), never
+        # reinterpreted.
+        open_or_create_meta(dir, "records", self.num_partitions,
+                            other_class="DurableColumnarIngestQueue")
         self._files = []
         for p in range(self.num_partitions):
             base, records, good_bytes = self._load_partition(p)

@@ -208,5 +208,30 @@ class ProbeFormatter:
                 n += 1
         return n
 
+    def format_stream_columns(self, payloads, queue,
+                              fmt: "str | None" = None,
+                              chunk: int = 4096) -> int:
+        """format_stream's batch sibling for columnar brokers: normalize
+        ``chunk`` payloads at a time and append each chunk as ONE column
+        batch (queue.append_columns), so the durable log stores column
+        frames instead of one frame per record. Returns records appended."""
+        n = 0
+        pending: list = []
+
+        def flush():
+            nonlocal n
+            cols = self.normalize_columns(pending, fmt)
+            queue.append_columns(cols)
+            n += cols.n
+            pending.clear()
+
+        for p in payloads:
+            pending.append(p)
+            if len(pending) >= chunk:
+                flush()
+        if pending:
+            flush()
+        return n
+
     def stats(self) -> dict:
         return {"normalized": self.normalized, "dropped": self.dropped}

@@ -113,3 +113,32 @@ def test_worker_columnar_flag(worker_env, capsys):
                  "--checkpoint", ckpt, "--max-steps", "1"]) == 0
     out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out2["lag"] == 0 and out2["reports"] == 0
+
+
+def test_worker_columnar_broker_autodetect(worker_env, capsys, monkeypatch):
+    """A fresh broker dir under --columnar is created in the COLUMNAR log
+    format; a later dict-worker invocation auto-detects the format and
+    consumes the same log through the shim."""
+    import io
+
+    d = worker_env["dir"]
+    broker = str(d / "broker5")
+    lines = "".join(
+        f"{p.uuid},{la},{lo},{t}\n"
+        for p in worker_env["fleet"]
+        for (lo, la), t in zip(p.lonlat, p.times))
+    monkeypatch.setattr("sys.stdin", io.StringIO(lines))
+    assert main(["--tiles", worker_env["tiles"], "--broker-dir", broker,
+                 "--max-steps", "2", "--stdin-format", "csv",
+                 "--columnar"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["lag"] == 0 and out["reports"] > 0
+    import os
+
+    assert os.path.exists(os.path.join(broker, "p0.colog"))
+
+    # dict worker over the columnar broker: auto-detected, replays fine
+    assert main(["--tiles", worker_env["tiles"], "--broker-dir", broker,
+                 "--max-steps", "1"]) == 0
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out2["lag"] == 0
