@@ -408,12 +408,18 @@ def _stage_round_batches(ts, traces, n_stream: int, steps_per_batch: int):
     return batches, V, n_pts
 
 
-def _streaming_columnar_bench(ts, traces, n_stream: int) -> dict:
-    """config 5, columnar worker (streaming/columnar.py — VERDICT r4 #2):
-    the same firehose as _streaming_bench through ColumnarStreamPipeline.
-    Producer pre-staged untimed; the measured system is batch poll →
-    columnar consume → flush (device match → vectorized report build →
-    histograms)."""
+def _drive_columnar_workers(ts, traces, n_stream: int,
+                            subsets: "tuple[tuple[int, ...], ...]",
+                            ) -> "tuple[float, int, list]":
+    """Shared columnar-firehose pump: pre-stage the batches (untimed),
+    then drain the broker with one ColumnarStreamPipeline per partition
+    subset — concurrently when there are several (threads: each worker's
+    device dispatches overlap the others' host legs). ONE config and one
+    drive loop, so the 1-vs-2-worker comparison can never drift apart.
+    Returns (seconds, reports, pipes); a worker exception fails the leg
+    (re-raised after join), never a silently-short pump."""
+    import threading
+
     from reporter_tpu.config import Config, StreamingConfig
     from reporter_tpu.streaming.columnar import (ColumnarIngestQueue,
                                                  ColumnarStreamPipeline)
@@ -427,22 +433,59 @@ def _streaming_columnar_bench(ts, traces, n_stream: int) -> dict:
                  streaming=StreamingConfig(flush_min_points=40,
                                            poll_max_records=300_000,
                                            hist_flush_interval=0.0))
-    pipe = ColumnarStreamPipeline(ts, cfg, queue=queue)
+    pipes = [ColumnarStreamPipeline(ts, cfg, queue=queue, partitions=sub)
+             for sub in subsets]
+    reports = [0] * len(pipes)
+    failures: list = []
+
+    def drive(i):
+        try:
+            pipe = pipes[i]
+            while queue.lag(pipe.committed) > 0:
+                before = queue.lag(pipe.committed)
+                reports[i] += pipe.step()
+                if queue.lag(pipe.committed) >= before:
+                    break
+            reports[i] += pipe.drain()
+        except BaseException as exc:     # re-raised below: a dead worker
+            failures.append(exc)         # must fail the leg, not shorten it
+
     t0 = time.perf_counter()
-    reports = 0
-    while queue.lag(pipe.committed) > 0:
-        before = queue.lag(pipe.committed)
-        reports += pipe.step()
-        if queue.lag(pipe.committed) >= before:
-            break
-    reports += pipe.drain()
-    flushed = pipe.flush_histograms()
+    if len(pipes) == 1:
+        drive(0)
+    else:
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(len(pipes))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
     dt = time.perf_counter() - t0
+    if failures:
+        raise failures[0]
+    return dt, int(sum(reports)), pipes
+
+
+def _streaming_columnar_bench(ts, traces, n_stream: int) -> dict:
+    """config 5, columnar worker (streaming/columnar.py — VERDICT r4 #2):
+    the same firehose as _streaming_bench through ColumnarStreamPipeline.
+    Producer pre-staged untimed; the measured system is batch poll →
+    columnar consume → flush (device match → vectorized report build →
+    histograms)."""
+    dt, reports, pipes = _drive_columnar_workers(
+        ts, traces, n_stream, subsets=((0, 1, 2, 3),))
+    pipe = pipes[0]
+    t0 = time.perf_counter()
+    flushed = pipe.flush_histograms()
+    dt += time.perf_counter() - t0       # the flush stays in the window,
+    #                                      as the r4 dict leg counted it
+    V = min(n_stream, len(traces))
+    n_pts = len(traces[0].xy)
     probes = V * n_pts
     st = pipe.stats()
     return {
-        "config": (f"{V} vehicles x {n_pts}pt columnar firehose, "
-                   f"tile={ts.name}"),
+        "config": (f"{V} vehicles x {n_pts}pt "
+                   f"columnar firehose, tile={ts.name}"),
         "probes_per_sec": round(probes / dt, 1),
         "reports": int(reports),
         "steps": pipe.steps,
@@ -451,6 +494,28 @@ def _streaming_columnar_bench(ts, traces, n_stream: int) -> dict:
         "hist_segments_flushed": int(flushed),
         "hist_rows_nonzero": st["hist_rows"],
         "seconds": round(dt, 3),
+    }
+
+
+def _streaming_two_workers(ts, traces, n_stream: int) -> dict:
+    """Consumer-group scale-out, columnar flavor: TWO workers over
+    disjoint partition subsets of one broker drain the same firehose
+    (shared pump — _drive_columnar_workers — so config and drive loop
+    are identical to the single-worker leg). The measured question: does
+    a second worker on the same chip add throughput over one (it shares
+    the device but not the host-side consume/flush/walk)?"""
+    dt, reports, pipes = _drive_columnar_workers(
+        ts, traces, n_stream, subsets=((0, 1), (2, 3)))
+    V = min(n_stream, len(traces))
+    n_pts = len(traces[0].xy)
+    return {
+        "config": (f"2 workers x 2 partitions, {V} vehicles x {n_pts}pt, "
+                   f"tile={ts.name}"),
+        "probes_per_sec": round(V * n_pts / dt, 1),
+        "reports": int(reports),
+        "seconds": round(dt, 3),
+        "per_worker_match_seconds": [round(p.stats()["match_seconds"], 3)
+                                     for p in pipes],
     }
 
 
@@ -1299,6 +1364,12 @@ def main() -> None:
                                        key=lambda r: r["probes_per_sec"])
         detail["streaming_dict"]["runs_pps"] = [r["probes_per_sec"]
                                                 for r in sd_runs]
+        w2_runs = [_streaming_two_workers(ts, traces, n_stream=2000)
+                   for _ in range(2)]
+        detail["streaming_2workers"] = max(
+            w2_runs, key=lambda r: r["probes_per_sec"])
+        detail["streaming_2workers"]["runs_pps"] = [
+            r["probes_per_sec"] for r in w2_runs]
         split["streaming_s"] = round(time.perf_counter() - t0, 1)
 
         # -- streaming soak (VERDICT r4 next #2): ≥30 s steady arrival,
