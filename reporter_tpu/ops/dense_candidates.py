@@ -65,7 +65,11 @@ _INTERPRET = os.environ.get("RTPU_PALLAS_INTERPRET", "") == "1"
 _P = 256          # points per chunk: halves the (chunks x blocks) launch
 #                   grid vs 128 — measured ~2/5/9% faster on sf/organic/xl
 #                   (interleaved A/B, round 4); 512 loses (looser bboxes)
-_SBLK = 512       # segment columns per block (small: culling granularity)
+_SBLK = int(os.environ.get("RTPU_SBLK", "512"))
+#                   segment columns per block (small: culling granularity;
+#                   512 re-validated post-narrow-grid — the env override
+#                   exists for interleaved A/B tuning, results are exact
+#                   at any block size since the merge is order-independent)
 _NSUB = 8         # chunk sub-bboxes — 32 points per sub-bbox, the same
 #                   culling tightness as the old 128/4 (results identical)
 _NJ_CAP = 128     # narrow-grid width: max culled blocks per chunk before
