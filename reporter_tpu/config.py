@@ -150,6 +150,22 @@ class StreamingConfig:
     hist_flush_interval: float = 60.0  # seconds between per-segment speed
                                        # histogram flushes to the datastore
                                        # (0 = manual flush only)
+    # Pipelined flush (columnar worker): how many flush waves may be in
+    # flight on the device while the main loop keeps consuming and the
+    # publisher thread POSTs completed waves. 0 = the sequential
+    # consume→match→publish loop (the dict worker's only shape); 1 =
+    # double buffering, the firehose deployment default — per-wave link
+    # RTT and datastore RTT amortize across waves instead of serializing.
+    pipeline_depth: int = 1
+    # Adaptive wave sizing (columnar worker, opt-in): the controller
+    # raises the effective flush_min_points while broker lag is rising
+    # (bigger waves amortize per-flush overheads) and decays it toward
+    # wave_target_latency once caught up (smaller waves bound the
+    # probe→report buffer wait). flush_min_points is the starting point.
+    wave_autotune: bool = False
+    wave_min_points: int = 16          # controller floor (points/vehicle)
+    wave_max_points: int = 960         # controller ceiling
+    wave_target_latency: float = 2.0   # p50 probe→report target (s)
 
 
 @dataclass(frozen=True)
@@ -204,6 +220,14 @@ class Config:
                 "flush_min_points must all be >= 1")
         if s.flush_max_age <= 0:
             raise ValueError("streaming.flush_max_age must be > 0")
+        if s.pipeline_depth < 0:
+            raise ValueError("streaming.pipeline_depth must be >= 0")
+        if not (1 <= s.wave_min_points <= s.wave_max_points):
+            raise ValueError(
+                "streaming wave bounds need 1 <= wave_min_points "
+                "<= wave_max_points")
+        if s.wave_target_latency <= 0:
+            raise ValueError("streaming.wave_target_latency must be > 0")
         for bins in ("speed_bins", "queue_bins"):
             edges = getattr(s, bins)
             if len(edges) < 1 or list(edges) != sorted(set(edges)):

@@ -163,6 +163,9 @@ def build_network(
         warnings.warn(
             f"extract {name!r}: dropped {len(bad)} node(s) with "
             f"out-of-range coordinates (e.g. id {bad[0]})", stacklevel=3)
+        # drop into a local copy — the caller's dict must survive intact
+        # (callers reuse parsed elements across build_network calls)
+        node_pos = dict(node_pos)
         for nid in bad:
             del node_pos[nid]
 

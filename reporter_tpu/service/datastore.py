@@ -10,6 +10,9 @@ from __future__ import annotations
 
 import json
 import logging
+import queue as _queue
+import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Callable
@@ -20,6 +23,18 @@ log = logging.getLogger("reporter_tpu.datastore")
 
 # transport(url, payload_bytes) → HTTP status code
 Transport = Callable[[str, bytes], int]
+
+
+def _report_rows(seg, nxt, t0, t1, length, queue) -> list[dict]:
+    """Report columns → wire rows. THE columnar row shape — shared by the
+    sync and async publishers so the payload format cannot fork. ``nxt``
+    uses -1 for "exit to unknown" (serialized as null, like
+    Report.to_json)."""
+    return [{"id": s, "next_id": (None if x < 0 else x),
+             "t0": a, "t1": b, "length": ln, "queue_length": q}
+            for s, x, a, b, ln, q in zip(
+                seg.tolist(), nxt.tolist(), t0.tolist(), t1.tolist(),
+                length.tolist(), queue.tolist())]
 
 
 def _urllib_transport(url: str, body: bytes) -> int:
@@ -43,13 +58,26 @@ class DatastorePublisher:
         self.url = url
         self.mode = mode
         self._transport = transport or _urllib_transport
+        # counter guard: the async subclass POSTs from a worker thread
+        # while histogram flushes POST from the pipeline thread
+        self._count_lock = threading.Lock()
         self.published = 0          # reports successfully POSTed
         self.dropped = 0            # reports lost to transport errors
         self.requests = 0           # POST attempts
         self.json_failures = 0      # failed publish_json POSTs (flushes)
 
-    def publish(self, reports: list[Report]) -> bool:
-        """POST one batch. True on success (or no-op); False on failure."""
+    def publish(self, reports: list[Report], on_done=None) -> bool:
+        """POST one batch. True on success (or no-op); False on failure.
+        ``on_done(ok)``, if given, runs after the attempt completes —
+        synchronously here, on the worker thread in the async subclass —
+        so callers can sequence commit-floor releases identically against
+        either publisher."""
+        ok = self._publish_sync(reports)
+        if on_done is not None:
+            on_done(ok)
+        return ok
+
+    def _publish_sync(self, reports: list[Report]) -> bool:
         if not reports:
             return True
         if not self.url:
@@ -58,43 +86,49 @@ class DatastorePublisher:
             return True
         return self._post([r.to_json() for r in reports])
 
-    def publish_columns(self, seg, nxt, t0, t1, length, queue) -> bool:
+    def publish_columns(self, seg, nxt, t0, t1, length, queue,
+                        on_done=None) -> bool:
         """Columnar publish: the same ``{"mode", "reports": [...]}``
         payload as publish(), built straight from report columns
-        (streaming/columnar.py) — no per-Report objects. ``nxt`` uses -1
-        for "exit to unknown" (serialized as null, like Report.to_json)."""
+        (streaming/columnar.py) — no per-Report objects; row shape =
+        _report_rows."""
+        ok = self._publish_columns_sync(seg, nxt, t0, t1, length, queue)
+        if on_done is not None:
+            on_done(ok)
+        return ok
+
+    def _publish_columns_sync(self, seg, nxt, t0, t1, length, queue) -> bool:
         if not len(seg):
             return True
         if not self.url:
             log.debug("datastore disabled; dropping %d reports on the floor",
                       len(seg))
             return True
-        rows = [{"id": s, "next_id": (None if x < 0 else x),
-                 "t0": a, "t1": b, "length": ln, "queue_length": q}
-                for s, x, a, b, ln, q in zip(
-                    seg.tolist(), nxt.tolist(), t0.tolist(), t1.tolist(),
-                    length.tolist(), queue.tolist())]
-        return self._post(rows)
+        return self._post(_report_rows(seg, nxt, t0, t1, length, queue))
 
     def _post(self, report_rows: list[dict]) -> bool:
         payload = json.dumps({
             "mode": self.mode,
             "reports": report_rows,
         }).encode()
-        self.requests += 1
+        with self._count_lock:
+            self.requests += 1
         try:
             status = self._transport(self.url, payload)
         except (urllib.error.URLError, OSError, TimeoutError) as exc:
             log.warning("datastore POST failed: %s (%d reports dropped)",
                         exc, len(report_rows))
-            self.dropped += len(report_rows)
+            with self._count_lock:
+                self.dropped += len(report_rows)
             return False
         if 200 <= status < 300:
-            self.published += len(report_rows)
+            with self._count_lock:
+                self.published += len(report_rows)
             return True
         log.warning("datastore POST returned %d (%d reports dropped)",
                     status, len(report_rows))
-        self.dropped += len(report_rows)
+        with self._count_lock:
+            self.dropped += len(report_rows)
         return False
 
     def publish_json(self, payload: dict) -> bool:
@@ -102,15 +136,159 @@ class DatastorePublisher:
         True on success or when publishing is disabled."""
         if not self.url:
             return True
-        self.requests += 1
+        with self._count_lock:
+            self.requests += 1
         try:
             status = self._transport(self.url, json.dumps(payload).encode())
         except (urllib.error.URLError, OSError, TimeoutError) as exc:
             log.warning("datastore POST failed: %s", exc)
-            self.json_failures += 1
+            with self._count_lock:
+                self.json_failures += 1
             return False
         if 200 <= status < 300:
             return True
         log.warning("datastore POST returned %d", status)
-        self.json_failures += 1
+        with self._count_lock:
+            self.json_failures += 1
         return False
+
+    # Async surface (no-ops here so callers can treat either publisher
+    # uniformly; AsyncDatastorePublisher overrides the publish side).
+
+    @property
+    def pending(self) -> int:
+        """Publishes accepted but not yet POSTed (0: sync publisher)."""
+        return 0
+
+    def drain(self, timeout: "float | None" = None) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+
+class AsyncDatastorePublisher(DatastorePublisher):
+    """DatastorePublisher whose report POSTs run on a background thread.
+
+    The streaming pipeline's flush loop must not serialize with datastore
+    round-trips (the POST leg of the per-wave RTT chain): ``publish`` /
+    ``publish_columns`` enqueue onto a BOUNDED queue served by one worker
+    and return immediately; the worker's socket wait releases the GIL, so
+    the POST of wave N−1 overlaps the match of wave N and the consume of
+    wave N+1. A full queue blocks the caller (bounded memory,
+    backpressure — never a silent drop; drops stay what they were: counted
+    transport failures). ``on_done(ok)`` callbacks — used by the pipeline
+    to release commit floors — run on the worker thread after the POST
+    attempt completes, success or not (at-least-once: the floor must not
+    release before the attempt, and a counted failure is an attempt).
+
+    Histogram flushes (``publish_json``) stay synchronous on the caller:
+    they are rare, and the delta-flush retry contract needs the result.
+    """
+
+    def __init__(self, url: str = "", mode: str = "auto",
+                 transport: Transport | None = None,
+                 max_pending: int = 64):
+        super().__init__(url, mode, transport)
+        self._jobs: "_queue.Queue" = _queue.Queue(maxsize=int(max_pending))
+        self._thread: "threading.Thread | None" = None
+        self._closed = False
+
+    @property
+    def pending(self) -> int:
+        return self._jobs.qsize()
+
+    def _ensure_worker(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="datastore-publisher")
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._jobs.get()
+            try:
+                if job is None:
+                    return
+                fn, on_done, n_rows = job
+                ok = False
+                try:
+                    ok = fn()
+                except Exception:
+                    # _post only catches transport-shaped errors; anything
+                    # else (bad URL scheme → ValueError, garbled response →
+                    # HTTPException, a transport-callable bug) must count
+                    # as a failed ATTEMPT, not kill the worker: a dead
+                    # worker never fires on_done, which would wedge every
+                    # pending wave's commit floor and hang drain()/close().
+                    log.exception("datastore publish job raised "
+                                  "(%d reports dropped)", n_rows)
+                    with self._count_lock:
+                        self.dropped += n_rows
+                finally:
+                    if on_done is not None:
+                        try:
+                            on_done(ok)
+                        except Exception:   # a callback bug must not kill
+                            log.exception("publish on_done callback failed")
+            finally:
+                self._jobs.task_done()
+
+    def _submit(self, fn, on_done, n_rows: int) -> bool:
+        if self._closed:
+            raise RuntimeError("publisher is closed")
+        self._ensure_worker()
+        self._jobs.put((fn, on_done, n_rows))
+        return True
+
+    def publish(self, reports: list[Report], on_done=None) -> bool:
+        """Enqueue one report-batch POST; True = accepted (the outcome is
+        counted on the worker and delivered to ``on_done``)."""
+        if not reports or not self.url:
+            if reports:
+                log.debug("datastore disabled; dropping %d reports on the "
+                          "floor", len(reports))
+            if on_done is not None:
+                on_done(True)
+            return True
+        rows = [r.to_json() for r in reports]
+        return self._submit(lambda: self._post(rows), on_done, len(rows))
+
+    def publish_columns(self, seg, nxt, t0, t1, length, queue,
+                        on_done=None) -> bool:
+        """Columnar twin of publish(): rows are materialized HERE (caller
+        thread) so the numpy columns can be reused/freed immediately."""
+        if not len(seg) or not self.url:
+            if len(seg):
+                log.debug("datastore disabled; dropping %d reports on the "
+                          "floor", len(seg))
+            if on_done is not None:
+                on_done(True)
+            return True
+        rows = _report_rows(seg, nxt, t0, t1, length, queue)
+        return self._submit(lambda: self._post(rows), on_done, len(rows))
+
+    def drain(self, timeout: "float | None" = None) -> bool:
+        """Block until every accepted publish has completed its POST
+        attempt. ``timeout`` bounds the wait; True = fully drained."""
+        if self._thread is None:
+            return True
+        if timeout is None:
+            self._jobs.join()
+            return True
+        deadline = time.monotonic() + timeout
+        while self._jobs.unfinished_tasks:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def close(self) -> None:
+        """Drain, then stop the worker (idempotent)."""
+        if self._closed:
+            return
+        self.drain()
+        self._closed = True
+        if self._thread is not None and self._thread.is_alive():
+            self._jobs.put(None)
+            self._thread.join(timeout=5.0)

@@ -143,6 +143,9 @@ def main(argv: "list[str] | None" = None) -> int:
         pipe.flush_histograms()
         if args.checkpoint:
             pipe.checkpoint(args.checkpoint)
+        close = getattr(pipe, "close", None)
+        if close is not None:       # pipelined worker: stop the executor
+            close()                 # + publisher threads
         queue.close()
     print(json.dumps({"steps": steps, "reports": reports,
                       **{k: v for k, v in pipe.stats().items()

@@ -27,8 +27,19 @@ tests/test_broker_contract.py, which external adapters should reuse):
 - ``end_offset`` is one past the last record (== the next offset to be
   assigned), so ``end_offset - committed`` is the lag.
 - Polling below the retention floor raises ``LookupError`` (Kafka's
-  OffsetOutOfRange) — the pipeline treats that as unrecoverable data loss
-  rather than silently skipping.
+  OffsetOutOfRange). If the broker also offers ``retention_floor(p)``,
+  the pipelines treat the raise as an overload shed (drop-oldest
+  policy): they skip to the floor and COUNT the gap in their ``overrun``
+  stat — the auto.offset.reset=earliest analog, explicit instead of
+  silent. Without that accessor the raise stays unrecoverable data loss.
+
+Bounded brokers (optional, for overload safety): the in-proc queues
+accept ``max_records_per_partition`` + ``overload_policy`` ("reject" =
+producer-side refusal, counted in ``rejected``; "drop_oldest" = floor
+advances past aged records, counted in ``dropped_oldest``) and expose
+``overload_stats()``, which the pipelines merge into their /stats
+surface. An external adapter may implement the same members; the
+pipelines only require the three-member core above.
 
 Commit state intentionally lives in StreamPipeline (its commit floor is
 the oldest *unflushed* record, a property of the matcher's buffers, not of

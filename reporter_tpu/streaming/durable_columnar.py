@@ -63,8 +63,11 @@ class DurableColumnarIngestQueue(ColumnarIngestQueue):
     """ColumnarIngestQueue whose batch log survives the process."""
 
     def __init__(self, dir: str, num_partitions: int = 4,
-                 fsync: bool = False):
-        super().__init__(num_partitions)
+                 fsync: bool = False,
+                 max_records_per_partition: "int | None" = None,
+                 overload_policy: str = "reject"):
+        super().__init__(num_partitions, max_records_per_partition,
+                         overload_policy)
         self.dir = dir
         self._fsync = bool(fsync)
         open_or_create_meta(dir, "columnar", self.num_partitions,

@@ -96,8 +96,11 @@ class DurableIngestQueue(IngestQueue):
     """IngestQueue whose log survives the process."""
 
     def __init__(self, dir: str, num_partitions: int = 4,
-                 fsync: bool = False):
-        super().__init__(num_partitions)
+                 fsync: bool = False,
+                 max_records_per_partition: "int | None" = None,
+                 overload_policy: str = "reject"):
+        super().__init__(num_partitions, max_records_per_partition,
+                         overload_policy)
         self.dir = dir
         self._fsync = bool(fsync)
         # The partition count and format are the log's identity: a

@@ -88,6 +88,7 @@ class StreamPipeline:
         self.hist_flushes = 0
         self.steps = 0
         self.malformed = 0
+        self.overrun = 0    # records lost to broker drop-oldest shed
 
     @property
     def publisher(self):
@@ -102,10 +103,14 @@ class StreamPipeline:
 
         Returns the number of reports produced this step.
         """
+        from reporter_tpu.streaming.state import poll_with_overrun_skip
+
         sc = self.config.streaming
         for p in self.partitions:
-            for off, rec in self.queue.poll(p, self._consumed[p],
-                                            sc.poll_max_records):
+            pairs = poll_with_overrun_skip(
+                self, lambda pp, off, n: self.queue.poll(pp, off, n),
+                p, sc.poll_max_records)
+            for off, rec in pairs:
                 self._consume(p, off, rec)
                 self._consumed[p] = off + 1
 
@@ -160,10 +165,14 @@ class StreamPipeline:
         if "accuracy" in rec:   # same optional field the HTTP path keeps
             try:
                 acc = float(rec["accuracy"])
-                if acc >= 0:    # negative would 400 the whole flush at
-                    point["accuracy"] = acc   # _validate_payload — drop
-            except (TypeError, ValueError):   # the field, keep the point
-                pass            # (it is advisory weighting)
+                if acc >= 0 and math.isfinite(acc):
+                    point["accuracy"] = acc
+                # negative OR non-finite would 400 the whole flush at
+                # _validate_payload, and match-before-drop would retry
+                # that 400 forever — drop the FIELD, keep the point
+                # (it is advisory weighting)
+            except (TypeError, ValueError):
+                pass
         buf.points.append(point)
 
     def _flush(self, uuids: list[str]) -> int:
@@ -195,13 +204,13 @@ class StreamPipeline:
         return n
 
     def _commit(self) -> None:
-        """Advance committed offsets to the oldest still-buffered record."""
-        floor = list(self._consumed)
-        for buf in self._buffers.values():
-            if buf.first_offset is not None:
-                p, off = buf.first_offset
-                floor[p] = min(floor[p], off)
-        self.committed = floor
+        """Advance committed offsets to the oldest still-buffered record
+        (shared floor rule — streaming/state.commit_floor)."""
+        from reporter_tpu.streaming.state import commit_floor
+        self.committed = commit_floor(
+            self._consumed,
+            (b.first_offset for b in self._buffers.values()
+             if b.first_offset is not None))
 
     def flush_histograms(self) -> int:
         """Publish the per-segment speed-histogram DELTA since the last
@@ -215,7 +224,7 @@ class StreamPipeline:
     # ---- observability ---------------------------------------------------
 
     def stats(self) -> dict[str, Any]:
-        return {
+        out = {
             "steps": self.steps,
             "malformed": self.malformed,
             "lag": sum(self.queue.end_offset(p) - self.committed[p]
@@ -226,8 +235,13 @@ class StreamPipeline:
             "published": self.app.publisher.published,
             "hist_rows": int(len(self.hist.nonzero_rows())),
             "qhist_rows": int(len(self.qhist.nonzero_rows())),
+            "overrun": int(self.overrun),
             **self.app.stats,
         }
+        overload = getattr(self.queue, "overload_stats", None)
+        if overload is not None:
+            out.update(overload())
+        return out
 
     # ---- checkpoint / resume (SURVEY.md §5) ------------------------------
 

@@ -11,8 +11,54 @@ from __future__ import annotations
 
 import json
 import time
+from typing import Iterable, Sequence
 
 import numpy as np
+
+
+def commit_floor(consumed: Sequence[int],
+                 holds: "Iterable[tuple[int, int]]") -> list:
+    """Committed offsets = the consumer's read position clamped below
+    every HOLD — ONE implementation for both pipeline flavors so the
+    at-least-once floor rule cannot drift between them.
+
+    A hold (partition, offset) is anything whose loss a crash must be
+    able to replay: the oldest record in a per-uuid buffer (dict
+    pipeline), the oldest unflushed log row (columnar), and — pipelined —
+    the oldest record of any wave whose publish attempt hasn't completed.
+    A checkpoint stores exactly this floor, so restoring replays every
+    record that had not made it out the far side of the publisher."""
+    floor = list(consumed)
+    for p, off in holds:
+        if off < floor[p]:
+            floor[p] = off
+    return floor
+
+
+def poll_with_overrun_skip(pl, poll, p: int, max_records: int):
+    """Poll partition ``p`` from pl._consumed[p], absorbing a drop-oldest
+    overrun — ONE implementation of the broker-shed protocol for both
+    pipeline flavors (the twin of commit_floor, and for the same reason).
+
+    A LookupError from below the retention floor normally means
+    unrecoverable data loss and re-raises; but when the broker exposes
+    ``retention_floor`` and the floor has genuinely advanced past our
+    read position, the records were SHED by an overload policy: skip to
+    the floor, count the gap in ``pl.overrun`` (explicit, never silent),
+    and poll again. ``poll(p, offset, max_records)`` is the pipeline's
+    poll callable; returns its result."""
+    while True:
+        try:
+            return poll(p, pl._consumed[p], max_records)
+        except LookupError:
+            floor_fn = getattr(pl.queue, "retention_floor", None)
+            if floor_fn is None:
+                raise
+            floor = int(floor_fn(p))
+            if floor <= pl._consumed[p]:
+                raise              # not an overrun: a real offset bug
+            pl.overrun += floor - pl._consumed[p]
+            pl._consumed[p] = floor
 
 
 def flush_histogram_delta(pl) -> int:

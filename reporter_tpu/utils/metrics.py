@@ -74,6 +74,7 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
         self._series: dict[str, _Reservoir] = {}
         self._born = time.time()
 
@@ -82,6 +83,14 @@ class MetricsRegistry:
     def count(self, name: str, delta: float = 1.0) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + delta
+
+    def gauge(self, name: str, value: float) -> None:
+        """Set a point-in-time level (lag, in-flight waves, queue depth) —
+        last write wins, snapshot reports it verbatim. Counters accumulate
+        events; gauges answer "how deep is the backlog RIGHT NOW", which
+        is what streaming overload monitoring alerts on."""
+        with self._lock:
+            self._gauges[name] = float(value)
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
@@ -104,10 +113,12 @@ class MetricsRegistry:
             return self._counters.get(name, 0.0)
 
     def snapshot(self) -> dict[str, Any]:
-        """Plain-dict view: counters verbatim + p50/p95 per series + derived
-        rates for the north-star metrics when their inputs exist."""
+        """Plain-dict view: counters + gauges verbatim + p50/p95 per series
+        + derived rates for the north-star metrics when their inputs
+        exist."""
         with self._lock:
             out: dict[str, Any] = dict(self._counters)
+            out.update(self._gauges)
             for name, r in self._series.items():
                 out[name + "_p50"] = r.quantile(0.50)
                 out[name + "_p95"] = r.quantile(0.95)

@@ -46,6 +46,18 @@ class TestParse:
         assert np.all((lat >= -90) & (lat <= 90))
         assert np.all((lon >= -180) & (lon <= 180))
 
+    def test_out_of_range_drop_leaves_caller_node_pos_intact(self):
+        """build_network must filter bad nodes into a LOCAL copy — callers
+        reuse the parsed elements (e.g. to build per-mode networks), and a
+        mutated node_pos would silently change the second build."""
+        from reporter_tpu.netgen.osm_xml import build_network, xml_elements
+
+        node_pos, ways, rels = xml_elements(adversarial_osm.as_xml())
+        before = dict(node_pos)
+        with pytest.warns(UserWarning, match="out-of-range"):
+            build_network(node_pos, ways, rels, name="adv")
+        assert node_pos == before
+
     def test_self_loop_way_compiles_single_node_loop_drops(self, net):
         assert _way(net, 300), "geometric loop way must survive"
         w = _way(net, 300)[0]

@@ -1,0 +1,595 @@
+"""Pipelined streaming flush: the overlap machinery exercised in tier-1
+WITHOUT a device — a gated fake matcher and gated transport stand in for
+the link and datastore RTTs, so the tests can hold a wave "in flight" at
+will and assert the correctness invariants directly:
+
+  - step() returns while a wave's match is in flight; consume continues;
+  - a uuid in an unharvested wave is not flushed again;
+  - the commit floor never passes a wave whose publish attempt has not
+    completed (match-stalled AND publish-stalled variants);
+  - crash + restore with a wave in flight replays the wave
+    (at-least-once, never lost);
+  - checkpoint() is a consistent cut (joins the in-flight wave);
+  - the adaptive wave-size controller grows under rising lag and
+    converges below the latency target when caught up;
+  - brokers enforce their per-partition bound with COUNTED overload
+    policies, and the consumer skips a drop-oldest overrun, counting it.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import (CompilerParams, Config, ServiceConfig,
+                                 StreamingConfig)
+from reporter_tpu.matcher.segments import SegmentRecord
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.streaming import (ColumnarIngestQueue,
+                                    ColumnarStreamPipeline, IngestQueue,
+                                    pack_records)
+from reporter_tpu.streaming.columnar import ProbeColumns, _WaveController
+from reporter_tpu.tiles.compiler import compile_network
+
+
+@pytest.fixture(scope="module")
+def tiles():
+    return compile_network(
+        generate_city("tiny"),
+        CompilerParams(reach_radius=500.0, osmlr_max_length=200.0))
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+class GateMatcher:
+    """match_many stand-in: blocks on ``gate`` (the link RTT, held open
+    by default), then emits one complete SegmentRecord per trace."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.calls = 0
+        self.entered = threading.Event()
+
+    def __call__(self, traces):
+        self.calls += 1
+        self.entered.set()
+        assert self.gate.wait(10), "test gate never released"
+        out = []
+        for t in traces:
+            t0 = float(t.times[0]) if len(t.times) else 0.0
+            t1 = float(t.times[-1]) if len(t.times) else 1.0
+            out.append([SegmentRecord(segment_id=7001, way_ids=[1],
+                                      start_time=t0,
+                                      end_time=max(t1, t0 + 0.5),
+                                      length=50.0, internal=False)])
+        return out
+
+
+class GateTransport:
+    """Datastore stand-in: blocks on ``gate`` (the POST RTT), captures
+    payloads, returns 200."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.bodies: list = []
+        self._lock = threading.Lock()
+
+    def __call__(self, url, body):
+        assert self.gate.wait(10), "test gate never released"
+        with self._lock:
+            self.bodies.append(json.loads(body))
+        return 200
+
+    def reports(self):
+        with self._lock:
+            return [r for p in self.bodies for r in p.get("reports", [])]
+
+
+def _mk_pipe(tiles, transport, **stream_kw):
+    cfg = Config(service=ServiceConfig(datastore_url="http://ds.test/"),
+                 streaming=StreamingConfig(**stream_kw))
+    clock = FakeClock()
+    pipe = ColumnarStreamPipeline(tiles, cfg, transport=transport,
+                                  clock=clock)
+    matcher = GateMatcher()
+    pipe.matcher.match_many = matcher
+    return pipe, clock, matcher
+
+
+def _records(uuid, times):
+    return [{"uuid": uuid, "lat": 37.7749 + 1e-5 * t, "lon": -122.4194,
+             "time": float(t)} for t in times]
+
+
+def _spin(pipe, predicate, seconds=5.0):
+    """Step until predicate(stats) or timeout (real clock)."""
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        pipe.step()
+        st = pipe.stats()
+        if predicate(st):
+            return st
+        time.sleep(0.005)
+    raise AssertionError(f"condition never reached; stats={pipe.stats()}")
+
+
+class TestOverlap:
+    def test_step_returns_while_match_in_flight(self, tiles):
+        tr = GateTransport()
+        pipe, clock, matcher = _mk_pipe(
+            tiles, tr, flush_min_points=4, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        pipe.queue.append_many(_records("veh-a", range(6)))
+        matcher.gate.clear()                      # hold the wave on "device"
+        n = pipe.step()
+        assert n == 0
+        st = pipe.stats()
+        assert st["inflight_waves"] == 1 and matcher.calls == 1
+        # the commit floor must sit at the wave's first offset while the
+        # match is in flight, even though consumption has moved past it
+        assert pipe.committed != pipe._consumed
+        assert min(pipe.committed) == 0
+
+        # consume continues while the wave is in flight; the busy uuid is
+        # NOT flushed again even though it is ripe
+        pipe.queue.append_many(_records("veh-a", range(6, 12)))
+        pipe.step()
+        st = pipe.stats()
+        assert st["buffered_points"] == 6          # consumed, not flushed
+        assert matcher.calls == 1                  # no second wave for veh-a
+
+        matcher.gate.set()
+        # wave 1 harvests, then the freed uuid's second wave flushes too
+        _spin(pipe, lambda s: s["inflight_waves"] == 0
+              and s["reports"] >= 2)
+        pipe.drain()
+        assert pipe.stats()["buffered_points"] == 0
+        assert pipe.committed == pipe._consumed
+        assert len(tr.reports()) == pipe.stats()["reports"] == 2
+        pipe.close()
+
+    def test_depth_one_never_two_waves_in_flight(self, tiles):
+        tr = GateTransport()
+        pipe, clock, matcher = _mk_pipe(
+            tiles, tr, flush_min_points=2, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        matcher.gate.clear()
+        pipe.queue.append_many(_records("veh-a", range(3)))
+        pipe.step()                                # wave 1: veh-a in flight
+        pipe.queue.append_many(_records("veh-b", range(3)))
+        pipe.step()                                # veh-b ripe but depth=1
+        assert pipe.stats()["inflight_waves"] == 1
+        assert matcher.calls == 1
+        matcher.gate.set()
+        _spin(pipe, lambda s: s["reports"] >= 2    # veh-b's wave follows
+              and s["inflight_waves"] == 0)
+        pipe.drain()
+        pipe.close()
+
+    def test_publish_pending_holds_commit_floor(self, tiles):
+        tr = GateTransport()
+        pipe, clock, matcher = _mk_pipe(
+            tiles, tr, flush_min_points=3, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        pipe.queue.append_many(_records("veh-a", range(4)))
+        tr.gate.clear()                            # stall the datastore POST
+        st = _spin(pipe, lambda s: s["publish_pending"] == 1)
+        # rows left the log (wave harvested) but the publish attempt has
+        # not completed: the floor must still cover the wave
+        assert st["inflight_waves"] == 0
+        assert min(pipe.committed) == 0
+        assert pipe.committed != pipe._consumed
+        tr.gate.set()
+        assert pipe.publisher.drain(timeout=5.0)
+        pipe.step()
+        assert pipe.committed == pipe._consumed
+        assert pipe.stats()["publish_pending"] == 0
+        assert len(tr.reports()) == 1
+        pipe.close()
+
+    def test_crash_with_wave_in_flight_replays(self, tiles):
+        """The at-least-once story end to end: kill a worker whose wave
+        never completed its publish attempt; a replacement built from the
+        committed offsets republishes the wave's reports."""
+        tr = GateTransport()
+        pipe, clock, matcher = _mk_pipe(
+            tiles, tr, flush_min_points=3, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        queue = pipe.queue
+        queue.append_many(_records("veh-a", range(4)))
+        tr.gate.clear()
+        _spin(pipe, lambda s: s["publish_pending"] == 1)
+        committed = list(pipe.committed)
+        assert min(committed) == 0                 # floor held below wave
+
+        # "crash": abandon the stalled worker; a replacement resumes from
+        # its committed offsets over the same broker
+        tr2 = GateTransport()
+        pipe2, _, _ = _mk_pipe(
+            tiles, tr2, flush_min_points=3, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        pipe2.queue = queue
+        pipe2._consumed = list(committed)
+        pipe2.committed = list(committed)
+        _spin(pipe2, lambda s: s["reports"] >= 1)
+        pipe2.drain()
+        assert len(tr2.reports()) == 1             # the wave, replayed
+        # release the zombie so its threads exit
+        tr.gate.set()
+        pipe.publisher.drain(timeout=5.0)
+        pipe.close()
+        pipe2.close()
+
+    def test_checkpoint_is_a_consistent_cut(self, tiles, tmp_path):
+        tr = GateTransport()
+        pipe, clock, matcher = _mk_pipe(
+            tiles, tr, flush_min_points=3, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        pipe.queue.append_many(_records("veh-a", range(4)))
+        matcher.gate.clear()
+        pipe.step()                                # wave in flight
+        assert pipe.stats()["inflight_waves"] == 1
+        # checkpoint must join the wave: release the gate from a timer so
+        # the blocking checkpoint can complete
+        threading.Timer(0.05, matcher.gate.set).start()
+        pipe.checkpoint(str(tmp_path / "cut.npz"))
+        # the snapshot is a wave boundary: floor == read position, the
+        # wave's reports were published before the state was saved
+        assert pipe.committed == pipe._consumed
+        assert len(tr.reports()) == 1
+        pipe.close()
+
+    def test_completion_failure_releases_wave_for_retry(self, tiles):
+        """An exception AFTER the match (report building / publishing)
+        must also put the wave's rows back in play — a leaked held wave
+        would pin the commit floor and broker retention forever."""
+        tr = GateTransport()
+        pipe, clock, matcher = _mk_pipe(
+            tiles, tr, flush_min_points=3, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        real = pipe._reports_from_records
+        boom = {"armed": True}
+
+        def flaky(per_trace, wave):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise IndexError("unexpected result shape")
+            return real(per_trace, wave)
+
+        pipe._reports_from_records = flaky
+        pipe.queue.append_many(_records("veh-a", range(4)))
+        pipe.step()                                # submits the wave
+        with pytest.raises(IndexError):
+            _spin(pipe, lambda s: False, seconds=2.0)
+        assert min(pipe.committed) == 0            # floor still held
+        _spin(pipe, lambda s: s["reports"] >= 1)   # retry flushes it
+        pipe.drain()
+        assert pipe.committed == pipe._consumed
+        assert len(tr.reports()) == 1
+        pipe.close()
+
+    def test_matcher_failure_releases_wave_for_retry(self, tiles):
+        tr = GateTransport()
+        pipe, clock, matcher = _mk_pipe(
+            tiles, tr, flush_min_points=3, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        boom = {"armed": True}
+        real = matcher.__call__
+
+        def flaky(traces):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("transient device failure")
+            return real(traces)
+
+        pipe.matcher.match_many = flaky
+        pipe.queue.append_many(_records("veh-a", range(4)))
+        pipe.step()                                # submits the doomed wave
+        with pytest.raises(RuntimeError):
+            _spin(pipe, lambda s: False, seconds=2.0)
+        # floor still covers the points; the retry flushes them
+        assert min(pipe.committed) == 0
+        _spin(pipe, lambda s: s["reports"] >= 1)
+        pipe.drain()
+        assert len(tr.reports()) == 1
+        assert pipe.committed == pipe._consumed
+        pipe.close()
+
+
+class TestTimelessRetry:
+    def test_timeless_stamps_rebased_on_failed_wave(self, tiles):
+        """Timeless probes consumed while a wave is in flight are stamped
+        from the submit-time-zeroed count (success-path dict parity); if
+        the wave FAILS, those stamps must be re-based past the restored
+        rows so the retry sees one monotonic index-second run — the dict
+        worker's failed-flush behavior."""
+        tr = GateTransport()
+        pipe, clock, matcher = _mk_pipe(
+            tiles, tr, flush_min_points=4, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        seen_times = []
+        real = matcher.__call__
+        boom = {"armed": True}
+
+        def flaky(traces):
+            out = real(traces)              # waits on matcher.gate
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("transient failure")
+            seen_times.append([t.times.copy() for t in traces])
+            return out
+
+        pipe.matcher.match_many = flaky
+
+        def timeless(n):
+            return [{"uuid": "veh-a", "lat": 37.7749, "lon": -122.4194}
+                    for _ in range(n)]
+
+        pipe.queue.append_many(timeless(4))
+        matcher.gate.clear()
+        pipe.step()                         # wave in flight (stamps 0..3)
+        pipe.queue.append_many(timeless(3))
+        pipe.step()                         # flight arrivals stamped 0..2
+        matcher.gate.set()
+        with pytest.raises(RuntimeError):
+            _spin(pipe, lambda s: False, seconds=2.0)
+        # after release: one monotonic run, no duplicate stamps
+        L = pipe._log
+        times = sorted(L.time[:L.n].tolist())
+        assert times == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+        _spin(pipe, lambda s: s["reports"] >= 1)
+        pipe.drain()
+        assert [t.tolist() for t in seen_times[0]] == [list(range(7))]
+        pipe.close()
+
+
+class TestWaveController:
+    def test_grows_under_rising_lag_to_ceiling(self):
+        ctl = _WaveController(start=120, lo=40, hi=960, target_s=2.0)
+        lag, prev, pts = 5_000, 0, 120
+        for _ in range(40):
+            pts = ctl.update(lag, prev, 0.5)
+            prev, lag = lag, int(lag * 1.2) + 10_000
+        assert pts == 960
+
+    def test_converges_below_latency_target_when_caught_up(self):
+        ctl = _WaveController(start=960, lo=40, hi=960, target_s=2.0)
+        pts = 960
+        # latency model: p50 scales with wave size (buffer-fill wait);
+        # lag steady at a level that dwarfs the wave size — the
+        # trend-based policy must still recognize "caught up"
+        for _ in range(200):
+            p50 = pts / 120.0
+            new = ctl.update(5_000, 5_000, p50)
+            if p50 <= 2.0:
+                assert new == pts          # inside the budget: stable
+                break
+            pts = new
+        else:
+            raise AssertionError("never converged")
+        assert pts <= 240                  # 240 pts == the 2 s target
+
+    def test_floor_clamp(self):
+        ctl = _WaveController(start=100, lo=40, hi=960, target_s=0.001)
+        pts = 100
+        for _ in range(100):
+            pts = ctl.update(0, 0, 10.0)
+        assert pts == 40
+
+    def test_lag_jitter_does_not_ratchet(self):
+        """±1-record bounce around a big steady backlog is NOT a rising
+        trend; with p50 inside the target the wave must not move at all."""
+        ctl = _WaveController(start=120, lo=40, hi=960, target_s=2.0)
+        lag = 1_000_000
+        for k in range(50):
+            pts = ctl.update(lag + (k % 2), lag - (k % 2), 1.0)
+        assert pts == 120
+
+
+class TestBrokerBounds:
+    def test_reject_policy_counts_and_caps(self):
+        q = ColumnarIngestQueue(1, max_records_per_partition=10,
+                                overload_policy="reject")
+        cols = pack_records([{"uuid": "v", "lat": 1.0, "lon": 2.0,
+                              "time": float(i)} for i in range(8)])
+        assert q.append_columns(cols) == 8
+        cols2 = pack_records([{"uuid": "v", "lat": 1.0, "lon": 2.0,
+                               "time": float(8 + i)} for i in range(5)])
+        assert q.append_columns(cols2) == 2        # partial accept to bound
+        st = q.overload_stats()
+        assert st["broker_rejected"] == 3
+        assert q.end_offset(0) == 10
+        # consuming + truncating opens room again
+        q.truncate([10])
+        assert q.append_columns(cols2) == 5
+        assert q.end_offset(0) == 15
+
+    def test_drop_oldest_policy_advances_floor_and_counts(self):
+        q = ColumnarIngestQueue(1, max_records_per_partition=10,
+                                overload_policy="drop_oldest")
+        for k in range(4):
+            q.append_columns(pack_records(
+                [{"uuid": "v", "lat": 1.0, "lon": 2.0,
+                  "time": float(4 * k + i)} for i in range(4)]))
+        st = q.overload_stats()
+        assert st["broker_dropped_oldest"] == 8    # two whole batches shed
+        assert q.retention_floor(0) == 8
+        assert q.end_offset(0) == 16
+        with pytest.raises(LookupError):
+            q.poll_batch(0, 0, 100)
+        got = q.poll_batch(0, 8, 100)
+        assert sum(c.n for _, c in got) == 8
+
+    def test_dict_queue_reject_returns_minus_one(self):
+        q = IngestQueue(1, max_records_per_partition=2,
+                        overload_policy="reject")
+        assert q.append({"uuid": "v", "lat": 1.0, "lon": 2.0})[1] == 0
+        assert q.append({"uuid": "v", "lat": 1.0, "lon": 2.0})[1] == 1
+        assert q.append({"uuid": "v", "lat": 1.0, "lon": 2.0})[1] == -1
+        assert q.overload_stats()["broker_rejected"] == 1
+
+    def test_pipeline_skips_and_counts_overrun(self, tiles):
+        tr = GateTransport()
+        cfg = Config(service=ServiceConfig(datastore_url="http://ds.test/"),
+                     streaming=StreamingConfig(flush_min_points=4,
+                                               flush_max_age=1e9,
+                                               poll_max_records=1000,
+                                               hist_flush_interval=0.0,
+                                               pipeline_depth=1))
+        queue = ColumnarIngestQueue(cfg.streaming.num_partitions,
+                                    max_records_per_partition=8,
+                                    overload_policy="drop_oldest")
+        pipe = ColumnarStreamPipeline(tiles, cfg, queue=queue, transport=tr)
+        pipe.matcher.match_many = GateMatcher()
+        # overfill one vehicle's partition before the consumer ever polls
+        for k in range(6):
+            queue.append_columns(pack_records(_records("veh-a",
+                                                       range(4 * k,
+                                                             4 * k + 4))))
+        assert queue.overload_stats()["broker_dropped_oldest"] > 0
+        _spin(pipe, lambda s: s["reports"] >= 1)
+        pipe.drain()
+        st = pipe.stats()
+        assert st["overrun"] > 0                   # counted, not silent
+        assert st["overrun"] == queue.overload_stats()["broker_dropped_oldest"]
+        assert st["lag"] == 0                      # fully caught up after
+        pipe.close()
+
+
+class TestPublisherResilience:
+    def test_poison_transport_does_not_wedge_worker(self, tiles):
+        """A transport raising something OUTSIDE _post's caught set (e.g.
+        ValueError from a bad URL scheme) must count a failed attempt and
+        keep the worker alive — a dead worker would hold every later
+        wave's commit floor forever and hang drain()."""
+        calls = {"n": 0}
+
+        def bad_then_good(url, body):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("unknown url type")
+            return 200
+
+        pipe, clock, matcher = _mk_pipe(
+            tiles, bad_then_good, flush_min_points=3, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        pipe.queue.append_many(_records("veh-a", range(4)))
+        _spin(pipe, lambda s: s["publish_pending"] == 0
+              and s["publish_dropped"] == 1)      # attempt counted failed
+        assert pipe.committed == pipe._consumed   # floor released
+        # the worker survived: a second wave publishes through it
+        pipe.queue.append_many(_records("veh-a", range(4, 8)))
+        _spin(pipe, lambda s: s["reports"] >= 2)
+        pipe.drain()
+        assert pipe.publisher.published > 0
+        pipe.close()
+
+
+class TestColumnarNonFinite:
+    def test_direct_columnar_inf_time_counts_malformed(self, tiles):
+        tr = GateTransport()
+        pipe, clock, matcher = _mk_pipe(
+            tiles, tr, flush_min_points=100, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0,
+            pipeline_depth=1)
+        cols = ProbeColumns(
+            np.array(["a", "a", "a", "a"]),
+            np.array([37.0, 37.0, 37.0, 37.0]),
+            np.array([-122.0, -122.0, -122.0, -122.0]),
+            np.array([0.0, np.inf, -np.inf, np.nan]),   # nan = absent, OK
+            np.full(4, np.nan, np.float32))
+        pipe.queue.append_columns(cols)
+        pipe.step()
+        st = pipe.stats()
+        assert st["malformed"] == 2                # the two infs only
+        assert st["buffered_points"] == 2          # t=0 and the timeless row
+        pipe.close()
+
+    def test_dict_poll_shim_materializes_inf_not_absent(self):
+        """The per-record shim must emit a ±inf time/accuracy AS inf —
+        mapping it to an absent key would launder a poison value into a
+        valid timeless record for a dict consumer of the same broker,
+        forking the malformed counts the columnar consumer reports."""
+        q = ColumnarIngestQueue(1)
+        q.append_columns(ProbeColumns(
+            np.array(["a", "a"]), np.array([37.0, 37.0]),
+            np.array([-122.0, -122.0]), np.array([np.inf, np.nan]),
+            np.full(2, np.nan, np.float32)))
+        recs = [r for _, r in q.poll(0, 0, 10)]
+        assert recs[0]["time"] == float("inf")    # present, not laundered
+        assert "time" not in recs[1]              # NaN alone means absent
+
+    def test_pack_records_poisons_explicit_nonfinite_time(self):
+        cols = pack_records([
+            {"uuid": "a", "lat": 1.0, "lon": 2.0, "time": 3.0},
+            {"uuid": "a", "lat": 1.0, "lon": 2.0, "time": float("nan")},
+            {"uuid": "a", "lat": 1.0, "lon": 2.0, "time": float("inf")},
+            {"uuid": "a", "lat": 1.0, "lon": 2.0},          # truly absent
+        ])
+        assert np.isfinite(cols.lat[0]) and cols.time[0] == 3.0
+        assert np.isnan(cols.lat[1]) and np.isnan(cols.lat[2])  # poisoned
+        assert np.isfinite(cols.lat[3]) and np.isnan(cols.time[3])
+
+    def test_nonfinite_accuracy_is_dropped_not_poison(self, tiles):
+        """Accuracy is ADVISORY: a non-finite value drops the FIELD and
+        keeps the point, in pack_records, in columnar consume (a direct
+        columnar producer bypasses pack_records), and in the dict
+        consumer fed through the poll shim — an inf that survived to the
+        flush would 400 the dict validator and, with match-before-drop,
+        wedge the partition forever."""
+        cols = pack_records([
+            {"uuid": "a", "lat": 1.0, "lon": 2.0, "time": 0.0,
+             "accuracy": float("inf")}])
+        assert np.isfinite(cols.lat[0]) and np.isnan(cols.accuracy[0])
+
+        # direct columnar producer: inf accuracy lands in the broker raw
+        q = ColumnarIngestQueue(1)
+        q.append_columns(ProbeColumns(
+            np.array(["a"]), np.array([37.0]), np.array([-122.0]),
+            np.array([0.0]), np.array([np.inf], np.float32)))
+        # columnar consume drops the field, keeps the point
+        tr = GateTransport()
+        pipe, _, _ = _mk_pipe(tiles, tr, flush_min_points=100,
+                              flush_max_age=1e9, poll_max_records=100,
+                              hist_flush_interval=0.0, pipeline_depth=1)
+        pipe.queue = q
+        pipe.partitions = [0]
+        pipe.step()
+        st = pipe.stats()
+        assert st["malformed"] == 0 and st["buffered_points"] == 1
+        assert np.isnan(pipe._log.acc[:1]).all()
+        pipe.close()
+        # dict consumer through the shim: field dropped, point kept
+        from reporter_tpu.streaming import StreamPipeline
+        from reporter_tpu.config import Config, StreamingConfig
+
+        cfg = Config(streaming=StreamingConfig(num_partitions=1,
+                                               flush_min_points=100,
+                                               flush_max_age=1e9,
+                                               hist_flush_interval=0.0))
+        dpipe = StreamPipeline(tiles, cfg, queue=q,
+                               transport=lambda u, b: 200)
+        dpipe.step()
+        assert dpipe.malformed == 0
+        bufs = list(dpipe._buffers.values())
+        assert len(bufs) == 1 and "accuracy" not in bufs[0].points[0]

@@ -149,6 +149,32 @@ class TestPipelineParity:
         assert cpipe.malformed == dpipe.malformed == 3
         _assert_parity(dpipe, cpipe, caps)
 
+    def test_nonfinite_time_parity(self, stream_tiles):
+        """Explicit NaN/±inf times must be MALFORMED in both pipelines —
+        NaN in the column means "key absent", never "bad value" (advisor
+        r5: the columnar path used to launder inf through and NaN into
+        index seconds, drifting the malformed-count contract)."""
+        probes = [synthesize_probe(stream_tiles, seed=120 + s, num_points=24,
+                                   gps_sigma=3.0) for s in range(3)]
+        recs = _records(probes)
+        recs.insert(3, {"uuid": "vz", "lat": 37.75, "lon": -122.41,
+                        "time": float("inf")})
+        recs.insert(7, {"uuid": "vw", "lat": 37.75, "lon": -122.41,
+                        "time": float("nan")})
+        recs.insert(11, {"uuid": probes[0].uuid, "lat": 37.75,
+                         "lon": -122.41, "time": float("-inf")})
+        dpipe, cpipe, caps, _ = _dual(
+            stream_tiles, flush_min_points=8, flush_max_age=1e9,
+            poll_max_records=1000, hist_flush_interval=0.0)
+        dpipe.queue.append_many(recs)
+        cpipe.queue.append_many(recs)
+        dpipe.step()
+        cpipe.step()
+        dpipe.drain()
+        cpipe.drain()
+        assert cpipe.malformed == dpipe.malformed == 3
+        _assert_parity(dpipe, cpipe, caps)
+
     def test_multi_flush_tail_retention_parity(self, stream_tiles):
         """Points split across two flushes: the straddling-tail cache
         must complete in-progress segments identically in both."""
