@@ -997,86 +997,228 @@ def _matcher_only_latency(m, trace, link_rtt: float,
     return sorted(windows)[1]
 
 
-def _service_saturation_curve(app, ts, traces, levels=(16, 64, 256),
-                              rounds: int = 2) -> list:
-    """Leader-combining under increasing concurrency (VERDICT r4 next #9):
-    for each level, N threads POST single-trace requests through the real
-    request path simultaneously; per level records req/s, p50/p99 request
-    latency, combining evidence (batches per round), and error behavior —
-    the overload story past the single measured point r4 had."""
-    import threading
-
+def _service_payloads(ts, traces, n_max, tag="conc"):
     import numpy as np
 
     from reporter_tpu.geometry import xy_to_lonlat
 
-    n_max = min(max(levels), len(traces))
     origin = np.asarray(ts.meta.origin_lonlat)
     payloads = []
     for i, t in enumerate(traces[:n_max]):
         lonlat = xy_to_lonlat(np.asarray(t.xy, np.float64), origin)
-        payloads.append({"uuid": f"conc-{i}", "trace": [
+        payloads.append({"uuid": f"{tag}-{i}", "trace": [
             {"lat": float(la), "lon": float(lo), "time": float(tt)}
             for (lo, la), tt in zip(lonlat, t.times)]})
+    return payloads
+
+
+def _sched_delta(before: "dict | None", after: "dict | None") -> dict:
+    """Scheduler-snapshot delta for one measured window: counters
+    subtract, histogram dicts subtract key-wise (dropping zeros)."""
+    if not after:
+        return {}
+    before = before or {}
+
+    def _dhist(key):
+        b = before.get(key, {})
+        d = {k: v - b.get(k, 0) for k, v in after.get(key, {}).items()}
+        return {k: v for k, v in d.items() if v}
+
+    # no "device_batches" here: both arms report it uniformly from
+    # app.stats at the call sites (the scheduler's own batch counter
+    # would shadow that shared-key computation)
+    return {
+        "padded_traces": (after["padded_traces"]
+                          - before.get("padded_traces", 0)),
+        "deferred": after["deferred"] - before.get("deferred", 0),
+        "rejected": after["rejected"] - before.get("rejected", 0),
+        "inflight_hist": _dhist("inflight_hist"),
+        "padding_by_bucket": _dhist("padding_by_bucket"),
+    }
+
+
+def _service_saturation_curve(apps: dict, ts, traces, levels=(16, 64, 256),
+                              rounds: int = 2) -> list:
+    """Serving face under increasing concurrency, interleaved A/B
+    (round-7 tentpole): ``apps`` maps arm name → ReporterApp (e.g.
+    "scheduler" = continuous in-flight batching, "legacy" =
+    queue-and-combine). For each client level the arms alternate
+    round-by-round so both see the SAME link mood; per arm per level:
+    req/s, p50/p99 request latency, device batches, and — scheduler arm —
+    the in-flight-batch dispatch histogram and padding waste per bucket
+    (snapshot deltas over the measured rounds only)."""
+    import threading
+
+    n_max = min(max(levels), len(traces))
+    payloads = _service_payloads(ts, traces, n_max)
+
+    def _round(app, record: "list | None", errors: list, n: int):
+        barrier = threading.Barrier(n)
+
+        def worker(p):
+            barrier.wait()
+            t0 = time.perf_counter()
+            try:
+                app.report_one(p)
+            except Exception as exc:   # a dead thread must not
+                errors.append(repr(exc))   # silently skew the p50
+                return
+            if record is not None:
+                record.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in payloads[:n]]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
 
     curve = []
     for level in levels:
         n = min(level, len(payloads))
-        errors: list = []
-
-        def _round(record: "list | None", n=n, errors=errors):
-            barrier = threading.Barrier(n)
-
-            def worker(p):
-                barrier.wait()
+        entry: dict = {"clients": n, "rounds": rounds}
+        # warm BOTH arms first (pays combined/padded-shape jit), then
+        # interleave measured rounds arm-by-arm: per-round alternation
+        # keeps the two arms inside the same link mood window, so the
+        # A/B ratio is same-mood by construction
+        for app in apps.values():
+            _round(app, None, [], n)
+        lats: dict = {a: [] for a in apps}
+        walls: dict = {a: 0.0 for a in apps}
+        errors: dict = {a: [] for a in apps}
+        before = {a: (app.stats["batches"],
+                      app.scheduler.snapshot() if app.scheduler else None)
+                  for a, app in apps.items()}
+        for _ in range(rounds):
+            for arm, app in apps.items():
                 t0 = time.perf_counter()
-                try:
-                    app.report_one(p)
-                except Exception as exc:   # a dead thread must not
-                    errors.append(repr(exc))   # silently skew the p50
-                    return
-                if record is not None:
-                    record.append(time.perf_counter() - t0)
+                _round(app, lats[arm], errors[arm], n)
+                walls[arm] += time.perf_counter() - t0
+        for arm, app in apps.items():
+            ls = sorted(lats[arm])
+            batches0, snap0 = before[arm]
+            sub = {
+                "req_per_sec": (round(len(ls) / walls[arm], 1)
+                                if ls and walls[arm] > 0 else None),
+                "p50_ms": (round(ls[len(ls) // 2] * 1e3, 1) if ls else None),
+                "p99_ms": (round(ls[min(len(ls) - 1,
+                                        int(len(ls) * 0.99))] * 1e3, 1)
+                           if ls else None),
+                "errors": len(errors[arm]),
+                "device_batches": app.stats["batches"] - batches0,
+            }
+            if app.scheduler is not None:
+                sub.update(_sched_delta(snap0, app.scheduler.snapshot()))
+            if errors[arm]:
+                sub["error_samples"] = errors[arm][:3]
+            entry[arm] = sub
+        curve.append(entry)
+    return curve
 
-            threads = [threading.Thread(target=worker, args=(p,))
-                       for p in payloads[:n]]
+
+def _service_open_loop(apps: dict, ts, traces,
+                       rates=(100, 250, 500, 1000),
+                       seconds: float = 2.5) -> list:
+    """Open-loop offered-rate sweep (round-7 satellite): submitters pace
+    requests at a FIXED offered rate regardless of completions — unlike
+    the closed-loop curve, latency inflation cannot throttle the offer,
+    so saturation shows up as achieved < offered and p99 growth instead
+    of a flattering self-limited req/s. Arms interleave per rate (same
+    link mood). Scheduler-arm 503s from the bounded admission queue are
+    counted as ``shed`` (explicit overload degradation), not errors."""
+    import itertools
+    import threading
+
+    from reporter_tpu.service.scheduler import ServiceOverloaded
+
+    base = _service_payloads(ts, traces, min(256, len(traces)), tag="ol")
+
+    def _warm(arm, app):
+        # pays the batch-shape jit OUTSIDE the paced window, so the first
+        # rate point measures the link, not XLA: one report_many per
+        # trace-count rung up through max_batch_traces covers the
+        # scheduler's whole reachable padded-shape set (at 1000 rps ×
+        # ~110 ms RTT a close can hold 100+ traces, so the big rungs DO
+        # get hit; that the set is warmable at all is the point of the
+        # rungs — the legacy arm still compiles odd Bs mid-measure when
+        # combining, an honest cost of unpadded shapes)
+        rungs = [1, 2, 4, 8, 16, 32, 64, 128, 256]
+        cap = max(a.config.service.max_batch_traces
+                  for a in apps.values())
+        for w, k in enumerate([r for r in rungs if r <= max(cap, 1)]):
+            k = min(k, len(base))
+            batch = []
+            for i in range(k):
+                p = dict(base[i])
+                p["uuid"] = f"olwarm-{arm}-{w}-{i}"
+                batch.append(p)
+            app.report_many(batch)
+
+    for arm, app in apps.items():
+        _warm(arm, app)
+    out = []
+    for rate in rates:
+        entry: dict = {"offered_rps": rate}
+        for arm, app in apps.items():
+            n = max(1, int(rate * seconds))
+            lats: list = []
+            errors: list = []
+            shed: list = []      # list.append is atomic; int += is not
+            idx = itertools.count()
+            n_workers = min(128, max(8, int(rate * 0.5)))
+            start = time.perf_counter() + 0.05   # common epoch, post-spawn
+            before = (app.stats["batches"],
+                      app.scheduler.snapshot() if app.scheduler else None)
+
+            def worker(arm=arm, app=app, n=n, rate=rate, start=start,
+                       lats=lats, errors=errors, shed=shed, idx=idx):
+                while True:
+                    i = next(idx)
+                    if i >= n:
+                        return
+                    target = start + i / rate
+                    now = time.perf_counter()
+                    if target > now:
+                        time.sleep(target - now)
+                    p = dict(base[i % len(base)])
+                    p["uuid"] = f"ol-{arm}-{rate}-{i}"
+                    t0 = time.perf_counter()
+                    try:
+                        app.report_one(p)
+                    except ServiceOverloaded:
+                        shed.append(1)
+                        continue
+                    except Exception as exc:
+                        errors.append(repr(exc))
+                        continue
+                    lats.append(time.perf_counter() - t0)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(n_workers)]
             for th in threads:
                 th.start()
             for th in threads:
                 th.join()
-
-        _round(None)                 # warm (pays combined-shape jit)
-        # snapshot AFTER the warm round: device_batches must count the
-        # measured rounds only, and a transient warm-round error must not
-        # contradict the measured req/s (errors also reset here)
-        batches_before = app.stats["batches"]
-        errors.clear()
-        lats: list = []
-        wall = 0.0
-        for _ in range(rounds):
-            t0 = time.perf_counter()
-            _round(lats)
-            wall += time.perf_counter() - t0
-        lats.sort()
-        entry = {
-            "clients": n,
-            "rounds": rounds,
-            "req_per_sec": (round(len(lats) / wall, 1)
-                            if lats and wall > 0 else None),
-            "p50_ms": (round(lats[len(lats) // 2] * 1e3, 1)
-                       if lats else None),
-            "p99_ms": (round(lats[min(len(lats) - 1,
-                                      int(len(lats) * 0.99))] * 1e3, 1)
-                       if lats else None),
-            "errors": len(errors),
-            # overload behavior = queue-and-combine, never shed: batches
-            # per round shows how many device dispatches N requests cost
-            "device_batches": app.stats["batches"] - batches_before,
-        }
-        if errors:
-            entry["error_samples"] = errors[:3]
-        curve.append(entry)
-    return curve
+            wall = time.perf_counter() - start
+            ls = sorted(lats)
+            sub = {
+                "achieved_rps": (round(len(ls) / wall, 1)
+                                 if ls and wall > 0 else 0.0),
+                "p50_ms": (round(ls[len(ls) // 2] * 1e3, 1) if ls else None),
+                "p99_ms": (round(ls[min(len(ls) - 1,
+                                        int(len(ls) * 0.99))] * 1e3, 1)
+                           if ls else None),
+                "shed": len(shed),
+                "errors": len(errors),
+                "device_batches": app.stats["batches"] - before[0],
+            }
+            if app.scheduler is not None:
+                sub.update(_sched_delta(before[1], app.scheduler.snapshot()))
+            if errors:
+                sub["error_samples"] = errors[:3]
+            entry[arm] = sub
+        out.append(entry)
+    return out
 
 
 def _cached_mode_tileset():
@@ -1191,23 +1333,57 @@ def main() -> None:
     p50_matcher_only = _matcher_only_latency(jax_matcher, traces[0],
                                              link_rtt)
 
-    # Mitigation: the service's leader-combining (service/app.py) coalesces
-    # concurrent single-trace requests into ONE device batch, so N clients
-    # share one link round-trip instead of paying N. Saturation curve
-    # (VERDICT r4 next #9): sweep 16/64/256 concurrent clients through the
-    # real request path — req/s, p50/p99, and error behavior per level.
+    # Mitigation: the serving face batches concurrent single-trace
+    # requests into shared device dispatches. Round 7 A/Bs the TWO
+    # batching schedulers in the same run (same link mood): "scheduler" =
+    # continuous in-flight batching (SLO-deadline close, shape-bucketed
+    # padding, max_inflight_batches overlapped dispatches —
+    # service/scheduler.py), "legacy" = the round-4 queue-and-combine
+    # leader (one batch in flight). Closed-loop saturation curve at
+    # 16/64/256 clients + an open-loop offered-rate sweep.
+    from reporter_tpu.config import ServiceConfig as _SvcCfg
     from reporter_tpu.service.app import ReporterApp
 
-    app = ReporterApp(ts, Config(matcher_backend="jax"))
-    service_curve = _service_saturation_curve(app, ts, traces,
+    svc_apps = {
+        "scheduler": ReporterApp(ts, Config(matcher_backend="jax")),
+        "legacy": ReporterApp(ts, Config(
+            matcher_backend="jax",
+            service=_SvcCfg(batching="combine"))),
+    }
+    service_curve = _service_saturation_curve(svc_apps, ts, traces,
                                               levels=(16, 64, 256))
-    lvl16 = service_curve[0]
-    n_conc = lvl16["clients"]
+    # degraded (CPU) runs keep the paced sweep short: one core serves
+    # both the submitters and the matcher, so high offers only measure
+    # thread thrash
+    service_open_loop = _service_open_loop(
+        svc_apps, ts, traces,
+        rates=(100, 250, 500, 1000) if tpu_ok else (50, 100))
+    for _app in svc_apps.values():
+        _app.close()            # drain schedulers; frees the executor
+    lvl16 = service_curve[0]["scheduler"]
+    n_conc = service_curve[0]["clients"]
     conc_p50 = (lvl16["p50_ms"] / 1e3 if lvl16["p50_ms"] is not None
                 else None)
     conc_rps = lvl16["req_per_sec"]
-    conc_errors = [e for lvl in service_curve
-                   for e in lvl.get("error_samples", [])]
+    conc_errors = [e for lvl in service_curve for arm in ("scheduler",
+                                                          "legacy")
+                   for e in lvl[arm].get("error_samples", [])]
+    # acceptance headline: at the top client level, scheduler vs legacy
+    # req/s (same run, alternated rounds) + dispatches at depth >= 2
+    top = service_curve[-1]
+    ab = {
+        "clients": top["clients"],
+        "scheduler_rps": top["scheduler"]["req_per_sec"],
+        "legacy_rps": top["legacy"]["req_per_sec"],
+        "speedup": (round(top["scheduler"]["req_per_sec"]
+                          / top["legacy"]["req_per_sec"], 3)
+                    if top["scheduler"]["req_per_sec"]
+                    and top["legacy"]["req_per_sec"] else None),
+        "inflight_ge2_dispatches": sum(
+            v for k, v in top["scheduler"].get("inflight_hist", {}).items()
+            if int(k) >= 2),
+        "errors": top["scheduler"]["errors"] + top["legacy"]["errors"],
+    }
 
     # Fidelity audit leg 1 (BASELINE north star: <5% segment-ID
     # disagreement, length-weighted — matcher/fidelity.py, the same metric
@@ -1272,6 +1448,8 @@ def main() -> None:
         f"concurrent{n_conc}_requests_per_sec": (
             round(conc_rps, 1) if conc_rps is not None else None),
         "service_curve": service_curve,
+        "service_ab": ab,
+        "service_open_loop": service_open_loop,
         **({"concurrent_errors": conc_errors[:4]} if conc_errors else {}),
         "cpu_reference_probes_per_sec": round(cpu_pps, 1),
         "oracle_sample_traces": n_cpu,
@@ -1623,17 +1801,23 @@ def main() -> None:
     # driver records only the tail of stdout (round 3's single fat line
     # overran it → BENCH_r03 parsed:null), so the FINAL line below is a
     # compact summary that always fits the capture window; everything it
-    # drops is in BENCH_DETAIL.json.
-    with open(_repo_path("BENCH_DETAIL.json"), "w") as f:
+    # drops is in the detail file. ANY CPU composite — env-forced sanity
+    # runs AND unforced tunnel-outage fallbacks — goes to
+    # BENCH_DETAIL_CPU.json, so a degraded run can never clobber the
+    # chip-captured BENCH_DETAIL.json (the round-6 overwrite hazard).
+    detail_name = ("BENCH_DETAIL.json" if tpu_ok
+                   else "BENCH_DETAIL_CPU.json")
+    with open(_repo_path(detail_name), "w") as f:
         json.dump(doc, f, indent=1)
     print(json.dumps(doc))
     print(json.dumps(_summary_line(doc)))
 
 
 def _summary_line(doc: dict) -> dict:
-    """Compact (<1.5 KB) machine-readable round summary: headline value,
-    per-tile throughput, per-tile audit disagreement, fidelity
-    provenance, streaming/device-compute/reach key numbers."""
+    """Compact (<1 KB, CI-pinned by tests/test_bench_summary.py)
+    machine-readable round summary: headline value, per-tile throughput,
+    per-tile audit disagreement, fidelity provenance,
+    streaming/device-compute/reach/serving key numbers."""
     d = doc["detail"]
 
     def _g(*path, default=None):
@@ -1644,13 +1828,13 @@ def _summary_line(doc: dict) -> dict:
             cur = cur[p]
         return cur
 
-    tiles = {d.get("headline_tile", "sf"): doc["value"]}
+    tiles_pps = {d.get("headline_tile", "sf"): doc["value"]}
     for key, name in (("metro", "bayarea"), ("restricted", "sf+r"),
                       ("xl", "bayarea-xl"), ("organic", "organic"),
                       ("organic_xl", "organic-xl")):
         v = _g(key, "probes_per_sec_e2e")
         if v is not None:
-            tiles[name] = int(v)        # whole probes/s: the line budget
+            tiles_pps[name] = int(v)    # whole probes/s: the line budget
     per_tile = _g("audit", "per_tile", default={})
     summary = {
         "metric": doc["metric"],
@@ -1658,29 +1842,29 @@ def _summary_line(doc: dict) -> dict:
         "unit": doc["unit"],
         "vs_baseline": doc["vs_baseline"],
         "device": d.get("device"),
-        "tiles_pps_e2e": tiles,
+        "tiles_pps": tiles_pps,
         "e2e_over_decode": d.get("e2e_over_decode"),
-        "p50_single_trace_ms": d.get("p50_single_trace_latency_ms"),
-        "p50_matcher_only_ms": d.get("p50_matcher_only_ms"),
+        "p50_trace_ms": d.get("p50_single_trace_latency_ms"),
+        "p50_matcher_ms": d.get("p50_matcher_only_ms"),
         "xl_binding_leg": _g("xl", "device_compute", "binding_leg"),
-        "link_rtt_ms_by_window": [
+        "rtt_ms_by_window": [
             d.get("link_rtt_ms"),
             _g("second_window", "link_rtt_ms")],
         "audit": {
-            "total_traces": _g("audit", "total_traces"),
-            "disagreement": {k: v.get("disagreement")
-                             for k, v in per_tile.items()},
-            "fidelity_source": sorted({v.get("fidelity_source", "?")
-                                       for v in per_tile.values()}),
+            "traces": _g("audit", "total_traces"),
+            "dis": {k: v.get("disagreement")
+                    for k, v in per_tile.items()},
+            "src": sorted({v.get("fidelity_source", "?")
+                           for v in per_tile.values()}),
         },
-        "ground_truth_edge_rate": {
+        "gt_edge_rate": {
             k: _g(*path, "point_edge_rate") for k, path in
             ((d.get("headline_tile", "sf"), ("ground_truth",)),
              ("bayarea-xl", ("xl", "ground_truth")),
              ("organic", ("organic", "ground_truth")),
              ("organic-xl", ("organic_xl", "ground_truth")))
             if _g(*path, "point_edge_rate") is not None},
-        "reach_step_miss_rate": {
+        "reach_miss": {
             k: _g(k2, "reach_audit", "step_miss_rate") for k, k2 in
             (("bayarea-xl", "xl"), ("organic", "organic"),
              ("organic-xl", "organic_xl"))
@@ -1697,8 +1881,15 @@ def _summary_line(doc: dict) -> dict:
                  "cap": _g("streaming_capacity", "best_held_pps"),
                  "rej": _g("streaming_overload", "broker_rejected")},
         "colocated_pps": _g("device_compute", "colocated_probes_per_sec"),
-        "device_ms_per_dispatch": _g("device_compute",
-                                     "device_ms_per_dispatch"),
+        "device_ms": _g("device_compute", "device_ms_per_dispatch"),
+        # serving-face A/B headline (full curves + open loop in detail):
+        # [clients, scheduler req/s, queue-and-combine req/s, dispatches
+        # at in-flight depth >= 2, errors] — same run, alternated rounds
+        "svc": [_g("service_ab", "clients"),
+                _g("service_ab", "scheduler_rps"),
+                _g("service_ab", "legacy_rps"),
+                _g("service_ab", "inflight_ge2_dispatches"),
+                _g("service_ab", "errors")],
         "total_seconds": d.get("total_seconds"),
     }
     return summary

@@ -111,6 +111,24 @@ class ServiceConfig:
     cache_max_uuids: int = 100_000
     min_segment_length: float = 0.0
     mode: str = "auto"             # report transport mode tag
+    # Request batching (service/scheduler.py). "scheduler" = continuous
+    # in-flight batching: SLO-deadline batch close, shape-bucketed
+    # padding, multiple device batches overlapping the link RTT.
+    # "combine" = the round-4 queue-and-combine leader (one batch in
+    # flight) — kept for A/B benches and as the conservative fallback.
+    batching: str = "scheduler"
+    batch_close_ms: float = 5.0    # a partial batch closes this many ms
+    #                                after its oldest request was admitted
+    #                                (the SLO deadline: a lone request is
+    #                                never stuck waiting for peers)
+    max_batch_traces: int = 256    # close-by-size threshold (traces)
+    max_inflight_batches: int = 2  # device batches allowed in flight —
+    #                                the serving twin of streaming's
+    #                                pipeline_depth (submit wave N while
+    #                                wave N-1 rides the link RTT)
+    admission_queue_limit: int = 8192  # queued traces admitted before the
+    #                                    service sheds with 503 (bounded
+    #                                    memory; counted rejections)
 
     def with_env_overrides(self, env: dict[str, str] | None = None) -> "ServiceConfig":
         """Apply env vars on top of this config; only set variables override."""
@@ -126,6 +144,12 @@ class ServiceConfig:
             kw["cache_ttl"] = float(e["PARTIAL_TRACE_TTL"])
         if "REPORTER_MODE" in e:
             kw["mode"] = e["REPORTER_MODE"]
+        if "REPORTER_BATCHING" in e:
+            kw["batching"] = e["REPORTER_BATCHING"]
+        if "REPORTER_BATCH_CLOSE_MS" in e:
+            kw["batch_close_ms"] = float(e["REPORTER_BATCH_CLOSE_MS"])
+        if "REPORTER_MAX_INFLIGHT" in e:
+            kw["max_inflight_batches"] = int(e["REPORTER_MAX_INFLIGHT"])
         return dataclasses.replace(self, **kw) if kw else self
 
     @classmethod
@@ -213,6 +237,17 @@ class Config:
                 "the single-cell grid gather to cover the search radius")
         if self.matcher_backend not in ("jax", "reference_cpu"):
             raise ValueError(f"unknown matcher_backend {self.matcher_backend!r}")
+        svc = self.service
+        if svc.batching not in ("scheduler", "combine"):
+            raise ValueError(f"unknown service.batching {svc.batching!r}; "
+                             "use 'scheduler' or 'combine'")
+        if svc.batch_close_ms <= 0:
+            raise ValueError("service.batch_close_ms must be > 0")
+        if svc.max_batch_traces < 1 or svc.max_inflight_batches < 1:
+            raise ValueError("service.max_batch_traces and "
+                             "service.max_inflight_batches must be >= 1")
+        if svc.admission_queue_limit < 1:
+            raise ValueError("service.admission_queue_limit must be >= 1")
         s = self.streaming
         if s.num_partitions < 1 or s.poll_max_records < 1 or s.flush_min_points < 1:
             raise ValueError(

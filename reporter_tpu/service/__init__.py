@@ -10,12 +10,15 @@ from reporter_tpu.service.app import ReporterApp, make_app
 from reporter_tpu.service.cache import PartialTraceCache
 from reporter_tpu.service.datastore import DatastorePublisher
 from reporter_tpu.service.reports import build_reports, filter_segments
+from reporter_tpu.service.scheduler import BatchScheduler, ServiceOverloaded
 
 __all__ = [
     "ReporterApp",
     "make_app",
     "PartialTraceCache",
     "DatastorePublisher",
+    "BatchScheduler",
+    "ServiceOverloaded",
     "build_reports",
     "filter_segments",
 ]

@@ -30,6 +30,7 @@ from reporter_tpu.config import Config
 from reporter_tpu.matcher.api import SegmentMatcher, Trace
 from reporter_tpu.service.cache import PartialTraceCache
 from reporter_tpu.service.datastore import DatastorePublisher, Transport
+from reporter_tpu.service.scheduler import BatchScheduler, ServiceOverloaded
 from reporter_tpu.service.reports import (
     Report,
     build_reports,
@@ -112,7 +113,14 @@ class ReporterApp:
 
     ``mesh``: deploy this app's matcher across a device mesh (dp-sharded
     dispatches, parallel/dp_e2e); the request pipeline, cache, and report
-    build are unchanged and results are bit-identical to single-device."""
+    build are unchanged and results are bit-identical to single-device.
+
+    Concurrency (``service.batching``): the default ``"scheduler"`` runs
+    requests through the continuous in-flight batcher
+    (service/scheduler.py) — SLO-deadline batch close, shape-bucketed
+    padding, up to ``max_inflight_batches`` overlapped device batches.
+    ``"combine"`` keeps the round-4 queue-and-combine leader (one batch
+    in flight, lock held through the dispatch) for A/B comparison."""
 
     def __init__(self, tileset: TileSet, config: Config | None = None,
                  transport: Transport | None = None, mesh=None):
@@ -125,14 +133,31 @@ class ReporterApp:
                                             mode=svc.mode,
                                             transport=transport)
         self.min_segment_length = svc.min_segment_length
-        self._lock = threading.Lock()     # match_many is not re-entrant per app
+        self._lock = threading.Lock()     # combine mode: one batch in flight
         self._pending: list[_Submission] = []
         self._pending_lock = threading.Lock()
+        self._stats_lock = threading.Lock()   # scheduler batches run
+        #                                       _process_validated concurrently
         self.stats = {"requests": 0, "traces": 0, "points": 0,
                       "reports": 0, "errors": 0, "match_seconds": 0.0,
                       "batches": 0, "batched_submissions": 0}
+        # Scheduler mode needs concurrent match_many calls, which only
+        # the jax backend supports (the reference_cpu oracle's shared
+        # DijkstraCache is unlocked, and shape padding buys a
+        # non-compiled backend nothing) — the oracle backend silently
+        # keeps the serialized combine path.
+        use_sched = (svc.batching == "scheduler"
+                     and self.config.matcher_backend == "jax")
+        self.scheduler: "BatchScheduler | None" = (
+            BatchScheduler(self) if use_sched else None)
 
     # ---- core pipeline ---------------------------------------------------
+
+    def _bump(self, key: str, delta: int = 1) -> None:
+        # scheduler mode makes concurrent WSGI handler threads the norm:
+        # every stats mutation goes through the lock or loses increments
+        with self._stats_lock:
+            self.stats[key] += delta
 
     def report_one(self, payload: dict) -> dict:
         return self.report_many([payload])[0]
@@ -140,17 +165,23 @@ class ReporterApp:
     def report_many(self, payloads: Iterable[dict]) -> list[dict]:
         """Validate → merge cache → batched match → filter/publish/retain.
 
-        Adaptive request combining (TPU-first serving): requests that
-        arrive while a device batch is in flight enqueue themselves; the
-        lock holder drains the queue and matches everything as ONE batch —
-        concurrency raises batch size instead of queueing device dispatches
-        (each of which pays a full link round-trip). Single-threaded
-        callers take the leader path immediately, with zero added latency.
-        Validation errors stay request-scoped (raised here, before
-        enqueueing).
+        Scheduler mode (default): validated requests are admitted to the
+        in-flight batcher — batches close by size or SLO deadline, pad
+        into fixed executable shapes, and up to ``max_inflight_batches``
+        device dispatches overlap the link RTT (service/scheduler.py).
+
+        Combine mode: requests that arrive while a device batch is in
+        flight enqueue themselves; the lock holder drains the queue and
+        matches everything as ONE batch — concurrency raises batch size
+        instead of queueing device dispatches, but the leader holds the
+        lock through the full link round-trip, so there is never more
+        than one batch in flight. Validation errors stay request-scoped
+        either way (raised here, before enqueueing).
         """
         pairs = [_validate_payload(p, self.config.service.mode)
                  for p in payloads]
+        if self.scheduler is not None:
+            return self.scheduler.submit(pairs)
         sub = _Submission(pairs)
         with self._pending_lock:
             self._pending.append(sub)
@@ -190,8 +221,9 @@ class ReporterApp:
             except Exception as exc:   # matcher/publisher failure: fail the
                 for s in batch:        # co-batched requests, keep serving
                     s.error = exc
-            self.stats["batches"] += 1
-            self.stats["batched_submissions"] += len(batch)
+            with self._stats_lock:
+                self.stats["batches"] += 1
+                self.stats["batched_submissions"] += len(batch)
             for s in batch:
                 s.done.set()
             if until is not None and until.done.is_set():
@@ -218,35 +250,63 @@ class ReporterApp:
             Trace.from_json({"uuid": u, "trace": pts}, self.matcher.ts)
             for u, pts in items
         ]
+        n_real = len(traces)
+        if self.scheduler is not None:
+            # Shape-bucket padding: the padded tail rides the dispatch and
+            # is dropped below (zip stops at the real items) — results for
+            # real traces are unchanged (batch-composition independence,
+            # tests/test_determinism.py).
+            traces = self.scheduler.pad_traces(traces)
         t0 = time.perf_counter()
         per_trace = self.matcher.match_many(traces)
         dt = time.perf_counter() - t0
+        if len(traces) > n_real:
+            # match_many metered the padded list; the /stats north-star
+            # counters must credit REAL work only (padding cost is priced
+            # separately: sched_batch_occupancy / padding_by_bucket)
+            self.matcher.metrics.count("traces", n_real - len(traces))
+            self.matcher.metrics.count(
+                "probes", -sum(len(t.xy) for t in traces[n_real:]))
 
         out = []
         all_reports: list[Report] = []
+        retains: list[tuple[str, list[dict], float]] = []
+        n_traces = n_points = n_reports = 0
         for (uuid, merged), records in zip(items, per_trace):
             reports = build_reports(records, self.min_segment_length)
             all_reports.extend(reports)
             done = latest_complete_time(records)
-            if done is None:
-                # Nothing completed: whole merged trace may still be mid-segment.
-                self.cache.retain(uuid, merged, merged[0]["time"])
-            else:
-                self.cache.retain(uuid, merged, done)
+            # Cache retains are DEFERRED to the end: any exception out of
+            # this method must leave the cache unmutated, so the
+            # scheduler's per-submission isolation retry re-merges the
+            # same points the failed combined attempt saw (a mid-loop
+            # retain would silently drop completed segments from the
+            # retried responses). done=None: whole merged trace may
+            # still be mid-segment.
+            retains.append((uuid, merged,
+                            merged[0]["time"] if done is None else done))
             out.append({
                 "mode": self.config.service.mode,
                 "segments": [r.to_json() for r in records],
                 "reports": [r.to_json() for r in reports],
             })
-            self.stats["traces"] += 1
-            self.stats["points"] += len(merged)
-            self.stats["reports"] += len(reports)
-        self.stats["match_seconds"] += dt
+            n_traces += 1
+            n_points += len(merged)
+            n_reports += len(reports)
         self.publisher.publish(all_reports)
+        for uuid, merged, from_time in retains:   # arrival order: a later
+            self.cache.retain(uuid, merged, from_time)   # duplicate wins
+        with self._stats_lock:
+            self.stats["traces"] += n_traces
+            self.stats["points"] += n_points
+            self.stats["reports"] += n_reports
+            self.stats["match_seconds"] += dt
         return out
 
     def health(self) -> dict:
-        return {
+        with self._stats_lock:
+            stats = dict(self.stats)
+        out = {
             "status": "ok",
             "backend": self.matcher.backend,
             "tileset": self.matcher.ts.name,
@@ -255,8 +315,21 @@ class ReporterApp:
             "cached_uuids": len(self.cache),
             "published": self.publisher.published,
             "dropped": self.publisher.dropped,
-            **self.stats,
+            **stats,
         }
+        if self.scheduler is not None:
+            # operators see saturation (admission depth, in-flight
+            # batches, padding/deferral counters) without the metrics port
+            out["scheduler"] = self.scheduler.snapshot()
+        return out
+
+    def close(self) -> None:
+        """Graceful drain: flush and stop the scheduler (new requests get
+        503), then close the publisher. Idempotent; safe in combine mode
+        (no scheduler to drain)."""
+        if self.scheduler is not None:
+            self.scheduler.close()
+        self.publisher.close()
 
     # ---- WSGI ------------------------------------------------------------
 
@@ -284,14 +357,14 @@ class ReporterApp:
                                 self.matcher.metrics.snapshot())
             if path == "/report" and method == "POST":
                 body = _read_json(environ)
-                self.stats["requests"] += 1
+                self._bump("requests")
                 return _respond(start_response, 200, self.report_one(body))
             if path == "/report_many" and method == "POST":
                 body = _read_json(environ)
                 traces = body.get("traces") if isinstance(body, dict) else None
                 if not isinstance(traces, list):
                     raise BadRequest("payload must be {'traces': [...]}")
-                self.stats["requests"] += 1
+                self._bump("requests")
                 results = self.report_many(traces)
                 return _respond(start_response, 200, {"results": results})
             if path in ("/report", "/report_many"):
@@ -299,10 +372,15 @@ class ReporterApp:
                                 {"error": f"{method} not allowed"})
             return _respond(start_response, 404, {"error": "not found"})
         except BadRequest as exc:
-            self.stats["errors"] += 1
+            self._bump("errors")
             return _respond(start_response, 400, {"error": str(exc)})
+        except ServiceOverloaded as exc:
+            # bounded admission queue full (or draining): shed explicitly
+            # with a retryable status instead of queueing without bound
+            self._bump("errors")
+            return _respond(start_response, 503, {"error": str(exc)})
         except Exception:                                 # pragma: no cover
-            self.stats["errors"] += 1
+            self._bump("errors")
             log.exception("unhandled error serving %s %s", method, path)
             return _respond(start_response, 500, {"error": "internal error"})
 
@@ -324,7 +402,8 @@ def _read_json(environ: dict) -> Any:
 def _respond(start_response: Callable, status: int, payload: dict):
     body = json.dumps(payload).encode()
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-              405: "Method Not Allowed", 500: "Internal Server Error"}
+              405: "Method Not Allowed", 500: "Internal Server Error",
+              503: "Service Unavailable"}
     start_response(f"{status} {reason.get(status, '')}".strip(), [
         ("Content-Type", "application/json"),
         ("Content-Length", str(len(body))),

@@ -30,6 +30,7 @@ from reporter_tpu.service.app import (
     _read_json,
     _respond,
 )
+from reporter_tpu.service.scheduler import ServiceOverloaded
 from reporter_tpu.service.datastore import Transport
 from reporter_tpu.tiles.tileset import TileSet
 
@@ -132,6 +133,12 @@ class MetroRouter:
             "metros": {n: a.health() for n, a in self.apps.items()},
         }
 
+    def close(self) -> None:
+        """Graceful drain of every metro's scheduler + publisher (each
+        metro app owns its own in-flight batcher over its own submesh)."""
+        for a in self.apps.values():
+            a.close()
+
     def __call__(self, environ: dict, start_response: Callable):
         method = environ.get("REQUEST_METHOD", "GET")
         path = environ.get("PATH_INFO", "/")
@@ -158,6 +165,8 @@ class MetroRouter:
             return _respond(start_response, 404, {"error": "not found"})
         except BadRequest as exc:
             return _respond(start_response, 400, {"error": str(exc)})
+        except ServiceOverloaded as exc:
+            return _respond(start_response, 503, {"error": str(exc)})
         except Exception:                                 # pragma: no cover
             logging.getLogger("reporter_tpu.router").exception(
                 "unhandled error serving %s %s", method, path)

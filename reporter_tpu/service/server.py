@@ -98,7 +98,17 @@ def main(argv: list[str] | None = None) -> None:
         desc = "router[" + ", ".join(ts.name for ts in tilesets) + "]"
     server = serve(app, args.host, args.port)
     logging.info("serving %s on :%d", desc, server.server_address[1])
-    server.serve_forever()
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        # Graceful drain: in-flight + admitted batches finish (their
+        # clients get responses), new admissions get 503, then the
+        # publisher flushes. MetroRouter drains every metro's scheduler.
+        logging.info("shutting down: draining scheduler + publisher")
+        app.close()
+        server.server_close()
 
 
 if __name__ == "__main__":

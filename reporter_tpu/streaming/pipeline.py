@@ -69,7 +69,17 @@ class StreamPipeline:
             raise ValueError(
                 f"partitions {self.partitions} out of range "
                 f"0..{sc.num_partitions - 1}")
-        self.app = ReporterApp(tileset, self.config, transport=transport,
+        # The flush loop is a single-threaded internal caller: the serving
+        # scheduler's SLO close wait (batch_close_ms) and executor handoff
+        # would tax every flush for zero concurrency benefit — pin the
+        # embedded app to the direct combine path (the worker's OWN
+        # overlap machinery is the pipelined columnar flush).
+        import dataclasses as _dc
+
+        app_cfg = _dc.replace(
+            self.config,
+            service=_dc.replace(self.config.service, batching="combine"))
+        self.app = ReporterApp(tileset, app_cfg, transport=transport,
                                mesh=mesh)
         self.clock = clock
         self.committed = [0] * sc.num_partitions

@@ -109,8 +109,13 @@ class MetricsRegistry:
     # ---- read side -------------------------------------------------------
 
     def value(self, name: str) -> float:
+        """Counter value, falling back to the gauge of the same name
+        (scheduler tests/operators read point-in-time levels like
+        sched_inflight_batches through the same accessor)."""
         with self._lock:
-            return self._counters.get(name, 0.0)
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, 0.0)
 
     def snapshot(self) -> dict[str, Any]:
         """Plain-dict view: counters + gauges verbatim + p50/p95 per series

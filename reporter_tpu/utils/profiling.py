@@ -18,7 +18,17 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 from typing import Iterator
+
+# jax.profiler.trace is a PROCESS-GLOBAL singleton (start_trace raises if
+# one is already active). The serving scheduler overlaps match_many calls
+# from several worker threads, so concurrent device_trace entries are
+# normal — only the first concurrent entrant starts a capture; the rest
+# run untraced (their device work still lands in the active capture,
+# which is what an XPlane trace of overlapped batches should show).
+_trace_lock = threading.Lock()
+_trace_active = False
 
 
 @contextlib.contextmanager
@@ -27,15 +37,30 @@ def device_trace(trace_dir: "str | None" = None) -> Iterator[None]:
 
     Falsy ``trace_dir`` falls back to $REPORTER_TPU_TRACE_DIR; if that is
     unset too, the context is a no-op (zero overhead in production).
+    Re-entrant across threads: nested/concurrent entries while a capture
+    is active are no-ops instead of profiler errors.
     """
+    global _trace_active
     target = trace_dir or os.environ.get("REPORTER_TPU_TRACE_DIR", "")
     if not target:
         yield
         return
+    with _trace_lock:
+        if _trace_active:
+            owner = False
+        else:
+            _trace_active = owner = True
+    if not owner:
+        yield
+        return
     import jax
 
-    with jax.profiler.trace(target):
-        yield
+    try:
+        with jax.profiler.trace(target):
+            yield
+    finally:
+        with _trace_lock:
+            _trace_active = False
 
 
 def annotate(name: str):

@@ -1,0 +1,96 @@
+"""The bench's FINAL stdout line must stay under the driver's ~1 KB tail
+capture (round 3's fat line overran it and recorded ``parsed: null``).
+This pins the budget in CI: build a synthetic FULL composite — every
+field path ``_summary_line`` reads populated with realistic-magnitude
+values — and assert the serialized summary fits. bench.py's top-level
+imports are stdlib-only, so importing it here never touches jax."""
+
+import importlib.util
+import json
+import os
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench_module", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tile(pps=2280000.1, decode=2510000.9):
+    return {"probes_per_sec_e2e": pps, "decode_only_probes_per_sec": decode}
+
+
+def _synthetic_doc():
+    """A composite shaped like a full six-tile chip run: worst-plausible
+    value widths (seven-digit throughputs, four-decimal disagreement,
+    long device string) so the asserted budget holds for real runs."""
+    audit_tiles = {
+        "sf": 0.0123, "sf-fresh-rot": 0.0069, "bayarea": 0.0077,
+        "sf_r8": 0.0123, "organic": 0.0077, "sfm-bicycle": 0.0001,
+    }
+    detail = {
+        "headline_tile": "sf",
+        "device": "TPU v5 lite (remote axon tunnel, 1 device)",
+        "e2e_over_decode": 0.907,
+        "p50_single_trace_latency_ms": 128.77,
+        "p50_matcher_only_ms": 2.641,
+        "link_rtt_ms": 119.22,
+        "second_window": {"link_rtt_ms": 103.44},
+        "metro": _tile(2210000.2), "restricted": _tile(2220000.3),
+        "xl": {
+            **_tile(1190000.4),
+            "device_compute": {"binding_leg": "device_sweep"},
+            "ground_truth": {"point_edge_rate": 0.9444},
+            "reach_audit": {"step_miss_rate": 0.0},
+        },
+        "organic": {
+            **_tile(1730000.5),
+            "ground_truth": {"point_edge_rate": 0.9611},
+            "reach_audit": {"step_miss_rate": 0.0},
+        },
+        "organic_xl": {
+            **_tile(1150000.6),
+            "ground_truth": {"point_edge_rate": 0.9555},
+            "reach_audit": {"step_miss_rate": 0.0001},
+        },
+        "ground_truth": {"point_edge_rate": 0.9444},
+        "audit": {
+            "total_traces": 665,
+            "per_tile": {k: {"disagreement": v,
+                             "fidelity_source": "fresh"}
+                         for k, v in audit_tiles.items()},
+        },
+        "streaming": {"probes_per_sec": 435000.7},
+        "streaming_soak": {"sustained_pps": 104000.8, "end_lag": 0,
+                           "p50_probe_to_report_ms": 2480.9},
+        "streaming_capacity": {"best_held_pps": 150000.1},
+        "streaming_overload": {"broker_rejected": 1234567},
+        "device_compute": {"colocated_probes_per_sec": 3150000.2,
+                           "device_ms_per_dispatch": 155.31},
+        "service_ab": {"clients": 256, "scheduler_rps": 1544.3,
+                       "legacy_rps": 713.9, "speedup": 2.163,
+                       "inflight_ge2_dispatches": 37, "errors": 0},
+        "total_seconds": 801.5,
+    }
+    return {"metric": "probes_per_sec_e2e", "value": 2280000.1,
+            "unit": "probes/s", "vs_baseline": 1234.56, "detail": detail}
+
+
+def test_summary_line_under_1kb():
+    bench = _load_bench()
+    line = json.dumps(bench._summary_line(_synthetic_doc()))
+    assert len(line.encode()) < 1024, (len(line.encode()), line)
+
+
+def test_summary_line_survives_sparse_detail():
+    """CPU-fallback / manual single-tile runs produce a sparse detail;
+    the summary builder must not KeyError and must stay in budget."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 60000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {"device": "CPU (forced by REPORTER_BENCH_FORCE_CPU)"}}
+    line = json.dumps(bench._summary_line(doc))
+    assert len(line.encode()) < 1024

@@ -1,0 +1,427 @@
+"""Continuous in-flight batching (service/scheduler.py) exercised in
+tier-1 WITHOUT a device — a gated fake matcher stands in for the link
+RTT (the same discipline as tests/test_pipelined_flush.py gates the
+streaming overlap), so the tests can hold a batch "in flight" at will
+and assert the scheduler's contracts directly:
+
+  - a lone request closes by the SLO deadline, never stuck waiting;
+  - a full batch closes by size, well before the deadline;
+  - same-rung batches pad to the SAME trace-count (executable reuse);
+  - up to max_inflight_batches device batches overlap;
+  - a uuid in an in-flight batch defers later requests for it
+    (per-uuid cache ordering = the sequential path's);
+  - one bad request fails alone, co-batched requests are still served;
+  - close() drains: queued work flushes, new admissions get 503;
+  - the bounded admission queue sheds with 503, counted;
+  - scheduled reports are bit-identical to the sequential path's.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from reporter_tpu.config import CompilerParams, Config, ServiceConfig
+from reporter_tpu.matcher.segments import SegmentRecord
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_probe
+from reporter_tpu.service.app import make_app
+from reporter_tpu.service.scheduler import ServiceOverloaded, _rung
+from reporter_tpu.tiles.compiler import compile_network
+
+from tests.test_service import wsgi_call
+
+
+@pytest.fixture(scope="module")
+def tiles():
+    return compile_network(
+        generate_city("tiny"),
+        CompilerParams(reach_radius=500.0, osmlr_max_length=200.0))
+
+
+class GateMatcher:
+    """match_many stand-in: blocks on ``gate`` (the link RTT, held open
+    by default), records every call's trace count + uuids, then emits one
+    complete SegmentRecord per trace. ``poison`` uuids raise instead."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.entered = threading.Event()
+        self._lock = threading.Lock()
+        self.calls: list[list[str]] = []      # per call: uuids (incl. pads)
+        self.sizes: list[int] = []            # per call: padded trace count
+        self.poison: set = set()
+
+    def __call__(self, traces):
+        with self._lock:
+            self.calls.append([t.uuid for t in traces])
+            self.sizes.append(len(traces))
+        self.entered.set()
+        assert self.gate.wait(10), "test gate never released"
+        if self.poison & {t.uuid for t in traces}:
+            raise RuntimeError("device rejected the batch")
+        out = []
+        for t in traces:
+            t0 = float(t.times[0]) if len(t.times) else 0.0
+            t1 = float(t.times[-1]) if len(t.times) else 1.0
+            out.append([SegmentRecord(segment_id=7001, way_ids=[1],
+                                      start_time=t0,
+                                      end_time=max(t1, t0 + 0.5),
+                                      length=50.0, internal=False)])
+        return out
+
+
+def _mk_app(tiles, **svc_kw):
+    svc_kw.setdefault("batch_close_ms", 20.0)
+    cfg = Config(matcher_backend="jax", service=ServiceConfig(**svc_kw))
+    app = make_app(tiles, cfg, transport=lambda u, b: 200)
+    fake = GateMatcher()
+    app.matcher.match_many = fake
+    return app, fake
+
+
+def _payload(uuid, n=6, t0=0.0):
+    return {"uuid": uuid, "trace": [
+        {"lat": 37.7749 + 1e-5 * (t0 + i), "lon": -122.4194,
+         "time": t0 + float(i)} for i in range(n)]}
+
+
+def _bg(fn, *args):
+    out = {}
+
+    def run():
+        try:
+            out["result"] = fn(*args)
+        except Exception as exc:
+            out["error"] = exc
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    out["thread"] = th
+    return out
+
+
+def _spin(predicate, seconds=5.0, msg="condition never reached"):
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.002)
+    raise AssertionError(msg)
+
+
+class TestBatchClose:
+    def test_lone_request_closes_by_deadline(self, tiles):
+        app, fake = _mk_app(tiles, batch_close_ms=20.0,
+                            max_batch_traces=100)
+        t0 = time.perf_counter()
+        out = app.report_one(_payload("solo"))
+        dt = time.perf_counter() - t0
+        assert out["segments"]
+        # one dispatch, never stuck waiting for peers that never come
+        assert len(fake.sizes) == 1
+        assert dt < 5.0
+        assert app.scheduler.snapshot()["batches"] == 1
+        app.close()
+
+    def test_full_batch_closes_by_size(self, tiles):
+        # deadline far away (10 s): completion well before it proves the
+        # size close fired
+        app, fake = _mk_app(tiles, batch_close_ms=10_000.0,
+                            max_batch_traces=4)
+        jobs = [_bg(app.report_one, _payload(f"v{i}")) for i in range(4)]
+        for j in jobs:
+            j["thread"].join(5.0)
+            assert not j["thread"].is_alive(), "size close never fired"
+            assert "result" in j, j.get("error")
+        assert sum(fake.sizes) >= 4
+        snap = app.scheduler.snapshot()
+        assert snap["submissions"] == 4
+        app.close()
+
+    def test_timed_out_drain_fails_queued_not_hangs(self, tiles):
+        """A drain racing a wedged link must stay BOUNDED: close(timeout)
+        returns, submissions still queued behind the wedged batch resolve
+        with ServiceOverloaded (no WSGI thread blocked forever), and the
+        wedged batch's own client still gets its result if the wedge
+        clears."""
+        app, fake = _mk_app(tiles, batch_close_ms=1.0,
+                            max_inflight_batches=1)
+        fake.gate.clear()                      # wedge the link
+        j1 = _bg(app.report_one, _payload("w1"))
+        _spin(lambda: fake.sizes)
+        j2 = _bg(app.report_one, _payload("w2"))   # queued behind the wedge
+        _spin(lambda: app.scheduler.snapshot()["admission_depth"] == 1)
+        t0 = time.perf_counter()
+        app.scheduler.close(timeout=0.3)
+        assert time.perf_counter() - t0 < 5.0      # bounded, not hung
+        j2["thread"].join(5.0)
+        assert isinstance(j2.get("error"), ServiceOverloaded)
+        fake.gate.set()                        # wedge clears late
+        j1["thread"].join(5.0)
+        assert "result" in j1, j1.get("error")
+
+    def test_drain_waives_deadline(self, tiles):
+        app, fake = _mk_app(tiles, batch_close_ms=10_000.0,
+                            max_batch_traces=100)
+        jobs = [_bg(app.report_one, _payload(f"d{i}")) for i in range(2)]
+        _spin(lambda: app.scheduler.snapshot()["admission_depth"] == 2
+              or fake.sizes, seconds=2.0)
+        app.close()          # graceful drain: queued work flushes NOW
+        for j in jobs:
+            j["thread"].join(5.0)
+            assert "result" in j, j.get("error")
+        # post-drain admissions shed with 503 through the WSGI face
+        status, body = wsgi_call(app, "POST", "/report", _payload("late"))
+        assert status == 503 and "error" in body
+
+
+class TestShapeBuckets:
+    def test_same_rung_batches_reuse_executable_shape(self, tiles):
+        """3 and 4 concurrent single-trace requests both pad to the
+        4-rung: the device sees the SAME [B, T] shape twice, so the
+        second batch reuses the first's compiled executable instead of
+        tracing a new one (the no-recompile contract)."""
+        app, fake = _mk_app(tiles, batch_close_ms=40.0,
+                            max_batch_traces=100, max_inflight_batches=1)
+        for n in (3, 4):
+            jobs = [_bg(app.report_one, _payload(f"r{n}-{i}"))
+                    for i in range(n)]
+            for j in jobs:
+                j["thread"].join(5.0)
+                assert "result" in j, j.get("error")
+        # regardless of how admissions raced into batches, every dispatch
+        # landed on a rung — the fixed executable-shape set
+        assert all(s == _rung(s) for s in fake.sizes), fake.sizes
+        if fake.sizes == [4, 4]:     # the intended single-batch-per-burst
+            snap = app.scheduler.snapshot()
+            assert snap["padded_traces"] >= 1
+            assert sum(snap["padding_by_bucket"].values()) >= 1
+        app.close()
+
+    def test_rung_helper(self):
+        assert [_rung(n) for n in (1, 2, 3, 5, 9, 257)] == [
+            1, 2, 4, 8, 16, 512]
+        assert _rung(5000) == 5000      # beyond the table: as-is
+
+
+class TestOverlap:
+    def test_two_batches_in_flight(self, tiles):
+        app, fake = _mk_app(tiles, batch_close_ms=1.0,
+                            max_inflight_batches=2)
+        fake.gate.clear()
+        j1 = _bg(app.report_one, _payload("a"))
+        _spin(lambda: fake.sizes, msg="first batch never dispatched")
+        j2 = _bg(app.report_one, _payload("b"))
+        # second batch dispatches WHILE the first is still on the device
+        _spin(lambda: len(fake.sizes) >= 2,
+              msg="no overlap: second batch waited for the first")
+        assert app.scheduler.snapshot()["inflight_batches"] == 2
+        fake.gate.set()
+        for j in (j1, j2):
+            j["thread"].join(5.0)
+            assert "result" in j, j.get("error")
+        hist = app.scheduler.snapshot()["inflight_hist"]
+        assert hist.get(2, 0) >= 1          # a dispatch happened at depth 2
+        app.close()
+
+    def test_depth_one_never_two_in_flight(self, tiles):
+        app, fake = _mk_app(tiles, batch_close_ms=1.0,
+                            max_inflight_batches=1)
+        fake.gate.clear()
+        j1 = _bg(app.report_one, _payload("a"))
+        _spin(lambda: fake.sizes)
+        j2 = _bg(app.report_one, _payload("b"))
+        time.sleep(0.1)                     # give a buggy overlap a chance
+        assert len(fake.sizes) == 1         # depth bound respected
+        fake.gate.set()
+        for j in (j1, j2):
+            j["thread"].join(5.0)
+            assert "result" in j, j.get("error")
+        assert app.scheduler.snapshot()["inflight_hist"] == {1: 2}
+        app.close()
+
+    def test_inflight_uuid_defers_second_request(self, tiles):
+        """Cache ordering: uuid X's second request must not dispatch
+        while X's first batch is in flight — its merge would miss the
+        first batch's retained tail."""
+        app, fake = _mk_app(tiles, batch_close_ms=1.0,
+                            max_inflight_batches=2)
+        fake.gate.clear()
+        j1 = _bg(app.report_one, _payload("x", n=6))
+        _spin(lambda: fake.sizes)
+        j2 = _bg(app.report_one, _payload("x", n=6, t0=6.0))
+        time.sleep(0.1)
+        assert len(fake.sizes) == 1         # deferred, not dispatched
+        fake.gate.set()
+        for j in (j1, j2):
+            j["thread"].join(5.0)
+            assert "result" in j, j.get("error")
+        assert len(fake.sizes) == 2
+        assert app.scheduler.snapshot()["deferred"] >= 1
+        # the deferred request's merged trace saw the first one's tail:
+        # the fake's complete record set the cache cut at t1=5.5, so the
+        # straddling pair rides into batch 2 (6 new + cached tail)
+        assert app.stats["points"] > 12 - 6
+        app.close()
+
+
+class TestErrorIsolation:
+    def test_poison_fails_alone_co_batched_served(self, tiles):
+        app, fake = _mk_app(tiles, batch_close_ms=10_000.0,
+                            max_batch_traces=3)
+        fake.poison = {"bad"}
+        jobs = {u: _bg(app.report_one, _payload(u))
+                for u in ("good1", "bad", "good2")}
+        for u, j in jobs.items():
+            j["thread"].join(10.0)
+            assert not j["thread"].is_alive()
+        assert "result" in jobs["good1"] and "result" in jobs["good2"]
+        assert isinstance(jobs["bad"].get("error"), RuntimeError)
+        snap = app.scheduler.snapshot()
+        assert snap["isolated_retries"] == 1
+        # batched attempt + 3 isolated retries
+        assert len(fake.sizes) == 4
+        app.close()
+
+    def test_lone_failure_owns_its_error(self, tiles):
+        app, fake = _mk_app(tiles, batch_close_ms=5.0)
+        fake.poison = {"bad"}
+        with pytest.raises(RuntimeError):
+            app.report_one(_payload("bad"))
+        # no isolation pass for a single-submission batch
+        assert app.scheduler.snapshot()["isolated_retries"] == 0
+        # the scheduler survives: later requests are served
+        assert app.report_one(_payload("ok"))["segments"]
+        app.close()
+
+
+class TestAdmissionBound:
+    def test_full_queue_sheds_503_counted(self, tiles):
+        app, fake = _mk_app(tiles, batch_close_ms=1.0,
+                            max_inflight_batches=1,
+                            admission_queue_limit=2)
+        fake.gate.clear()
+        j1 = _bg(app.report_one, _payload("a", n=2))   # in flight
+        _spin(lambda: fake.sizes)
+        j2 = _bg(app.report_one, _payload("b", n=2))   # queued (2 traces... 1)
+        _spin(lambda: app.scheduler.snapshot()["admission_depth"] == 1)
+        # queue holds 1 trace; +2 would exceed limit 2 ⇒ shed
+        status, body = wsgi_call(app, "POST", "/report_many",
+                                 {"traces": [_payload("c"), _payload("d")]})
+        assert status == 503
+        assert app.scheduler.snapshot()["rejected"] == 1
+        fake.gate.set()
+        for j in (j1, j2):
+            j["thread"].join(5.0)
+            assert "result" in j, j.get("error")
+        app.close()
+
+    def test_oversized_submission_admitted_when_queue_empty(self, tiles):
+        app, fake = _mk_app(tiles, admission_queue_limit=1)
+        out = app.report_many([_payload("a"), _payload("b")])
+        assert len(out) == 2                # never unservable
+        app.close()
+
+
+class TestConfig:
+    def test_validate_rejects_bad_knobs(self):
+        for kw in ({"batching": "magic"}, {"batch_close_ms": 0.0},
+                   {"max_batch_traces": 0}, {"max_inflight_batches": 0},
+                   {"admission_queue_limit": 0}):
+            with pytest.raises(ValueError):
+                Config(service=ServiceConfig(**kw)).validate()
+
+    def test_for_mode_passes_scheduler_knobs_through(self):
+        cfg = Config.for_mode(
+            "bicycle",
+            service=ServiceConfig(batch_close_ms=9.0,
+                                  max_inflight_batches=3,
+                                  batching="scheduler"))
+        assert cfg.service.mode == "bicycle"
+        assert cfg.service.batch_close_ms == 9.0
+        assert cfg.service.max_inflight_batches == 3
+
+    def test_env_overrides(self):
+        svc = ServiceConfig().with_env_overrides({
+            "REPORTER_BATCHING": "combine",
+            "REPORTER_BATCH_CLOSE_MS": "12.5",
+            "REPORTER_MAX_INFLIGHT": "4"})
+        assert svc.batching == "combine"
+        assert svc.batch_close_ms == 12.5
+        assert svc.max_inflight_batches == 4
+
+    def test_json_roundtrip_keeps_knobs(self):
+        c = Config(service=ServiceConfig(batching="combine",
+                                         batch_close_ms=7.5,
+                                         max_batch_traces=64,
+                                         max_inflight_batches=3,
+                                         admission_queue_limit=99))
+        assert Config.from_json(c.to_json()) == c
+
+
+class TestParity:
+    def test_scheduled_reports_bit_identical_to_sequential(self, tiles):
+        """The acceptance contract: report JSON through the scheduler —
+        including shape-bucket padding and concurrent batch assembly —
+        equals the sequential combine path's, byte for byte."""
+        payloads = []
+        for i in range(9):
+            p = synthesize_probe(tiles, seed=60 + i, num_points=40,
+                                 gps_sigma=3.0).to_report_json()
+            p["uuid"] = f"par-{i}"
+            payloads.append(p)
+
+        seq = make_app(tiles, Config(
+            matcher_backend="jax",
+            service=ServiceConfig(batching="combine")),
+            transport=lambda u, b: 200)
+        expected = [seq.report_one(p) for p in payloads]
+
+        sched = make_app(tiles, Config(
+            matcher_backend="jax",
+            service=ServiceConfig(batching="scheduler", batch_close_ms=5.0)),
+            transport=lambda u, b: 200)
+        jobs = [_bg(sched.report_one, p) for p in payloads]
+        for j in jobs:
+            j["thread"].join(60.0)
+            assert "result" in j, j.get("error")
+        got = [j["result"] for j in jobs]
+        assert [json.dumps(g, sort_keys=True) for g in got] == \
+               [json.dumps(e, sort_keys=True) for e in expected]
+        # the scheduler actually batched and padded (9 concurrent
+        # single-trace requests cannot all have ridden alone unless the
+        # close raced 9 ways — either way shapes sit on rungs)
+        snap = sched.scheduler.snapshot()
+        assert snap["submissions"] == 9
+        # north-star counters credit REAL work only: padding rows are
+        # backed out of the matcher's traces/probes meters
+        assert sched.matcher.metrics.value("traces") == 9.0
+        assert sched.matcher.metrics.value("probes") == 9.0 * 40
+        sched.close()
+        seq.close()
+
+
+class TestHealthSurface:
+    def test_health_exposes_scheduler_state(self, tiles):
+        app, fake = _mk_app(tiles)
+        app.report_one(_payload("h"))
+        status, body = wsgi_call(app, "GET", "/health")
+        assert status == 200
+        s = body["scheduler"]
+        assert s["batches"] >= 1 and s["submissions"] >= 1
+        assert s["inflight_batches"] == 0
+        assert s["admission_depth"] == 0
+        assert "inflight_hist" in s and "padding_by_bucket" in s
+        app.close()
+
+    def test_combine_mode_has_no_scheduler_block(self, tiles):
+        app = make_app(tiles, Config(
+            matcher_backend="jax",
+            service=ServiceConfig(batching="combine")),
+            transport=lambda u, b: 200)
+        assert app.scheduler is None
+        assert "scheduler" not in app.health()
+        app.close()                          # no-op drain, must not raise
