@@ -750,13 +750,84 @@ _SWEEP_PAIR_FLOPS = 25          # f32 ops per point-segment pair in
 #                                 reported utilization is a floor
 
 
+def _sweep_culling_stats(bbox: "np.ndarray", sub: "np.ndarray | None",
+                         flat: "np.ndarray", radius: float) -> dict:
+    """Host replication of BOTH kernel culling levels for one dispatch's
+    points (pure numpy — unit-testable, schema-pinned by
+    tests/test_bench_schema.py). Level 1 mirrors _chunk_block_ids (chunk
+    sub-range bboxes vs block bboxes → block visit list); level 2 mirrors
+    the round-8 in-kernel test (min over the chunk's ACTUAL points of the
+    point-to-sub-slice-bbox distance vs the dilated radius) — so the
+    reported pair counts are exactly what the active kernel computes."""
+    import numpy as np
+
+    from reporter_tpu.ops import dense_candidates as dc
+
+    P, NSUB = dc._P, dc._NSUB
+    flat = flat.astype(np.float64)
+    n = len(flat)
+    nchunks = (n + P - 1) // P
+    pad = nchunks * P - n
+    if pad:                       # bench slices are uniform/full — pad with
+        flat = np.concatenate([flat, flat[-1:].repeat(pad, 0)])   # last pt
+    sr = flat.reshape(nchunks * NSUB, P // NSUB, 2)
+    lo = sr.min(axis=1) - radius                       # [nc*NSUB, 2]
+    hi = sr.max(axis=1) + radius
+    hit = ((bbox[None, :, 0] <= hi[:, 0:1])
+           & (bbox[None, :, 2] >= lo[:, 0:1])
+           & (bbox[None, :, 1] <= hi[:, 1:2])
+           & (bbox[None, :, 3] >= lo[:, 1:2]))         # NaN pad rows: False
+    hit = hit.reshape(nchunks, NSUB, -1).any(axis=1)   # [nchunks, nblocks]
+    hits_per_chunk = hit.sum(axis=1)
+    nvisits = int(hits_per_chunk.sum())
+    out = {
+        "blocks_total": int(bbox.shape[0]),
+        "block_visits_per_dispatch": nvisits,
+        "mean_blocks_per_chunk": round(float(hits_per_chunk.mean()), 1),
+        "culled_fraction": round(
+            1.0 - nvisits / max(nchunks * bbox.shape[0], 1), 4),
+        "sub_slices_per_block": 1,
+        "sub_visits_per_dispatch": nvisits,
+        "sub_fraction_of_block_cols": 1.0,
+    }
+    if sub is None:
+        return out
+    nsub = sub.shape[1] // 4
+    quads = sub.reshape(-1, nsub, 4)                   # [nblocks, nsub, 4]
+    rc = dc.cull_radius(radius)                        # kernel's dilation
+    chunks = flat.reshape(nchunks, P, 2)
+    sub_visits = 0
+    for c in range(nchunks):
+        blks = np.nonzero(hit[c])[0]
+        if not len(blks):
+            continue
+        q = quads[blks]                                # [nh, nsub, 4]
+        px = chunks[c, :, 0][:, None, None]
+        py = chunks[c, :, 1][:, None, None]
+        dx = np.maximum(np.maximum(q[None, :, :, 0] - px,
+                                   px - q[None, :, :, 2]), 0.0)
+        dy = np.maximum(np.maximum(q[None, :, :, 1] - py,
+                                   py - q[None, :, :, 3]), 0.0)
+        d2 = dx * dx + dy * dy                         # [P, nh, nsub]
+        d2 = np.where(np.isnan(d2), np.inf, d2)        # NaN quad = no slice
+        sub_visits += int((d2.min(axis=0) <= rc * rc).sum())
+    out["sub_slices_per_block"] = nsub
+    out["sub_visits_per_dispatch"] = sub_visits
+    out["sub_fraction_of_block_cols"] = round(
+        sub_visits / max(nvisits * nsub, 1), 4)
+    return out
+
+
 def _sweep_roofline(m, pts: "np.ndarray", per_dispatch_s: float) -> dict:
     """Calibrate one dispatch against the chip (VERDICT r4 next #4): the
-    culling pre-pass (ops.dense_candidates._chunk_block_ids) is
-    reproducible on host from the slice's points + the staged block
-    bboxes, so swept HBM bytes and pair FLOPs per dispatch are exactly
-    knowable — achieved vs peak says what fraction of a v5e the sweep
-    actually uses, instead of 'fast relative to round N-1'."""
+    culling passes (host-replicated in _sweep_culling_stats) are exactly
+    reproducible from the slice's points + the staged bbox tables, so
+    swept HBM bytes and pair FLOPs per dispatch are exactly knowable —
+    achieved vs peak says what fraction of a v5e the sweep actually
+    uses, instead of 'fast relative to round N-1'. Round 8: pair FLOPs
+    follow the ACTIVE kernel (sub-slice visits when the two-level kernel
+    runs); the whole-block number stays as pair_flops_block_level so the
+    before/after utilization comparison lives in every capture."""
     import numpy as np
 
     from reporter_tpu.ops import dense_candidates as dc
@@ -764,43 +835,180 @@ def _sweep_roofline(m, pts: "np.ndarray", per_dispatch_s: float) -> dict:
     if "seg_bbox" not in m._tables:
         return {"note": "grid backend staged — no dense sweep to calibrate"}
     bbox = np.asarray(m._tables["seg_bbox"])           # [nblocks, 4]
-    radius = float(m.params.search_radius)
-    P, NSUB = dc._P, dc._NSUB
-    flat = pts.reshape(-1, 2).astype(np.float64)
-    n = len(flat)
-    nchunks = (n + P - 1) // P
-    pad = nchunks * P - n
-    if pad:                       # bench slices are uniform/full — pad with
-        flat = np.concatenate([flat, flat[-1:].repeat(pad, 0)])   # last pt
-    sub = flat.reshape(nchunks * NSUB, P // NSUB, 2)
-    lo = sub.min(axis=1) - radius                      # [nc*NSUB, 2]
-    hi = sub.max(axis=1) + radius
-    hit = ((bbox[None, :, 0] <= hi[:, 0:1])
-           & (bbox[None, :, 2] >= lo[:, 0:1])
-           & (bbox[None, :, 1] <= hi[:, 1:2])
-           & (bbox[None, :, 3] >= lo[:, 1:2]))         # NaN pad rows: False
-    hits_per_chunk = hit.reshape(nchunks, NSUB, -1).any(axis=1).sum(axis=1)
-    nvisits = int(hits_per_chunk.sum())
+    sub = (np.asarray(m._tables["seg_sub"])
+           if "seg_sub" in m._tables else None)
+    subcull = bool(getattr(m.params, "sweep_subcull", True)) and sub is not None
+    stats = _sweep_culling_stats(bbox, sub if subcull else None,
+                                 pts.reshape(-1, 2),
+                                 float(m.params.search_radius))
+    P = dc._P
+    nvisits = stats["block_visits_per_dispatch"]
     block_bytes = dc.SP_NCOMP * dc._SBLK * 4
-    bytes_swept = nvisits * block_bytes
-    flops = nvisits * P * dc._SBLK * _SWEEP_PAIR_FLOPS
+    bytes_swept = nvisits * block_bytes                # DMA is whole blocks
+    subw = dc._SBLK // stats["sub_slices_per_block"]
+    flops_block = nvisits * P * dc._SBLK * _SWEEP_PAIR_FLOPS
+    flops = stats["sub_visits_per_dispatch"] * P * subw * _SWEEP_PAIR_FLOPS
     bw = bytes_swept / per_dispatch_s
     fl = flops / per_dispatch_s
     return {
-        "blocks_total": int(bbox.shape[0]),
-        "block_visits_per_dispatch": nvisits,
-        "mean_blocks_per_chunk": round(float(hits_per_chunk.mean()), 1),
-        "culled_fraction": round(
-            1.0 - nvisits / (nchunks * bbox.shape[0]), 4),
+        "kernel": ("subcull" if subcull else "block")
+                  + ("+bf16" if subcull
+                     and getattr(m.params, "sweep_lowp", "off") == "bf16"
+                     else ""),
+        **stats,
         "hbm_bytes_swept": int(bytes_swept),
         "pair_flops": int(flops),
+        "pair_flops_block_level": int(flops_block),
+        "topk_width": (subw if subcull else dc._SBLK)
+                      + m.params.max_candidates,
         "achieved_GBps": round(bw / 1e9, 1),
         "achieved_Gflops": round(fl / 1e9, 1),
         "pct_of_v5e_hbm_peak": round(100 * bw / _V5E_HBM_BYTES_PER_S, 1),
         "pct_of_v5e_vpu_f32_peak": round(100 * fl / _V5E_VPU_F32_PER_S, 1),
-        "note": ("pair-geometry FLOPs only (floor); top-K selection adds "
-                 "~2x on radius-passing blocks"),
+        "pct_vpu_block_level": round(
+            100 * (flops_block / per_dispatch_s) / _V5E_VPU_F32_PER_S, 1),
+        "note": ("pair-geometry FLOPs of the ACTIVE kernel (floor); "
+                 "top-K selection adds ~(width+K)/width on radius-passing "
+                 "slices; pair_flops_block_level = what the whole-block "
+                 "kernel would compute for the same dispatch"),
     }
+
+
+def _stage_uniform_slice(m, traces):
+    """Stage ONE uniform-length slice's quantized infeed on the device —
+    the shared staging of every device-dispatch probe (compute probe,
+    sweep-variant A/B), so the probes can never drift onto different
+    wire conventions than each other. Returns (args, pts, sub, T) with
+    the uploads synced; args feeds match_batch_wire_q."""
+    import numpy as np
+
+    import jax
+
+    from reporter_tpu.matcher.api import _bucket_len
+    from reporter_tpu.ops.match import OFFSET_QUANTUM
+
+    B = max(1, m.params.max_device_batch)
+    sub = [t for t in traces if len(t.xy) == len(traces[0].xy)][:B]
+    T = len(sub[0].xy)
+    b = _bucket_len(T)
+    pts = np.zeros((len(sub), b, 2), np.float32)
+    pts[:, :T] = np.stack([t.xy for t in sub])
+    pts[:, T:] = pts[:, :1]
+    lens = np.full(len(sub), T, np.int32)
+    origins = pts[:, 0, :].copy()
+    dq = np.round((pts - origins[:, None, :]) * np.float32(1 / OFFSET_QUANTUM))
+    args = (jax.device_put(dq.astype(np.int16)), jax.device_put(origins),
+            jax.device_put(lens))
+    np.asarray(args[0][0, 0])                   # sync the uploads
+    return args, pts, sub, T
+
+
+def _sweep_variants_probe(m, traces, link_rtt: float, K: int = 12,
+                          windows: int = 2) -> dict:
+    """Same-mood A/B of the round-8 sweep levers, the ISSUE-3 discipline:
+    ONE staged slice, three static param variants of the SAME executable
+    family — "subcull" (two-level culling + fused narrow top-K, the
+    default), "block" (the round-7 whole-block kernel), "subcull_bf16"
+    (coarse low-precision pair filter + exact refinement) — dispatched in
+    interleaved windows so every arm sees the same link mood. Also
+    asserts the three arms' result wires are BYTE-identical on this
+    slice (the exactness contract, proven on-chip every run). Each arm's
+    number is the best window (same best-of-N convention as every tile).
+    """
+    import numpy as np
+
+    from reporter_tpu.ops.match import match_batch_wire_q
+
+    if "seg_sub" not in m._tables:
+        return {"note": "no dense seg_sub staged — sweep variants n/a"}
+    args, _, sub, T = _stage_uniform_slice(m, traces)
+    spec = getattr(m, "_wire_spec", None)
+    arms = {
+        "subcull": m.params.replace(sweep_subcull=True, sweep_lowp="off"),
+        "block": m.params.replace(sweep_subcull=False, sweep_lowp="off"),
+        "subcull_bf16": m.params.replace(sweep_subcull=True,
+                                         sweep_lowp="bf16"),
+    }
+    warm = {}
+    errors: dict = {}
+    for a, p in list(arms.items()):  # compile + one readback per arm,
+        try:                         # outside the windows
+            warm[a] = np.asarray(match_batch_wire_q(
+                *args, m._tables, m.ts.meta, p, None, spec=spec))
+        except Exception as exc:     # an arm that fails to lower must not
+            del arms[a]              # sink the whole capture — record it
+            errors[a] = repr(exc)[:200]
+    if "subcull" not in warm:
+        return {"note": "subcull arm failed to compile/dispatch",
+                "arm_errors": errors}
+    # None (not a vacuous True) when comparison arms are missing — the
+    # identity claim must mean an actual cross-kernel comparison ran
+    identical = (all(np.array_equal(warm["subcull"], w)
+                     for w in warm.values())
+                 if len(warm) >= 2 else None)
+    del warm
+    best: dict = dict.fromkeys(arms)
+    for _ in range(windows):
+        for a, p in arms.items():
+            t0 = time.perf_counter()
+            for _ in range(K):
+                wire = match_batch_wire_q(*args, m._tables, m.ts.meta, p,
+                                          None, spec=spec)
+            np.asarray(wire)
+            dt = max((time.perf_counter() - t0 - link_rtt) / K, 1e-6)
+            if best[a] is None or dt < best[a]:
+                best[a] = dt
+    probes = len(sub) * T
+    out: dict = {a: {"device_ms_per_dispatch": round(best[a] * 1e3, 2),
+                     "device_probes_per_sec": round(probes / best[a], 1)}
+                 for a in arms}
+    out["dispatch_shape"] = f"{len(sub)}x{T}pts"
+    out["wires_bit_identical"] = (None if identical is None
+                                  else bool(identical))
+    if errors:
+        out["arm_errors"] = errors
+    if "block" in best:
+        out["speedup_subcull_vs_block"] = round(
+            best["block"] / best["subcull"], 3)
+    if "subcull_bf16" in best:
+        out["speedup_bf16_vs_subcull"] = round(
+            best["subcull"] / best["subcull_bf16"], 3)
+    return out
+
+
+def _service_overload_boundary(curve: list, arm: str = "scheduler") -> dict:
+    """First client level where the serving face shows overload — errors,
+    p99 blowup, or req/s REGRESSION vs the previous level (queue growth
+    shows up as both). The p99 threshold is scaled by the CLIENT RATIO
+    between levels: closed-loop p99 grows ~linearly with clients once
+    req/s plateaus (that's healthy saturation, not overload), so a 4x
+    client jump legitimately quadruples p99 — only growth well beyond
+    the client ratio marks the boundary. VERDICT weak #6: the boundary
+    should be a measured number, not 'never observed'; the closed-loop
+    curve now extends past 256 clients so this can fire."""
+    prev = None
+    for lvl in curve:
+        sub = lvl.get(arm, {})
+        if sub.get("errors"):
+            return {"clients": lvl["clients"], "reason": "errors"}
+        if prev is not None:
+            pp, cp = prev[1].get("p99_ms"), sub.get("p99_ms")
+            pr, cr = prev[1].get("req_per_sec"), sub.get("req_per_sec")
+            ratio = lvl["clients"] / max(prev[0], 1)
+            if pp and cp and cp > 3 * ratio * pp:
+                return {"clients": lvl["clients"], "reason": "p99_blowup"}
+            # rps threshold sits BELOW the link's documented ~2x mood
+            # swing: adjacent levels run minutes apart in different mood
+            # windows (only the arms within a level are interleaved), so
+            # a 20%-style drop is indistinguishable from link noise —
+            # demand a regression past the noise floor
+            if pr and cr and cr < 0.45 * pr:
+                return {"clients": lvl["clients"],
+                        "reason": "rps_regression"}
+        prev = (lvl["clients"], sub)
+    return {"clients": None,
+            "reason": (f"not reached at {curve[-1]['clients']} clients"
+                       if curve else "no curve")}
 
 
 def _device_compute_probe(m, traces, link_rtt: float,
@@ -815,28 +1023,12 @@ def _device_compute_probe(m, traces, link_rtt: float,
     2-byte sync), host C++ walk of the slice, and host-side submit of the
     full batch. The slowest leg names the optimization target; the
     roofline block calibrates the sweep against v5e peaks."""
-    import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from reporter_tpu.matcher.api import _bucket_len
-    from reporter_tpu.ops.match import (OFFSET_QUANTUM, match_batch_wire_q,
-                                        unpack_wire)
+    from reporter_tpu.ops.match import match_batch_wire_q, unpack_wire
 
     K = 24
-    B = max(1, m.params.max_device_batch)
-    sub = [t for t in traces if len(t.xy) == len(traces[0].xy)][:B]
-    T = len(sub[0].xy)
-    b = _bucket_len(T)
-    pts = np.zeros((len(sub), b, 2), np.float32)
-    pts[:, :T] = np.stack([t.xy for t in sub])
-    pts[:, T:] = pts[:, :1]
-    lens = np.full(len(sub), T, np.int32)
-    origins = pts[:, 0, :].copy()
-    dq = np.round((pts - origins[:, None, :]) * np.float32(1 / OFFSET_QUANTUM))
-    args = (jax.device_put(dq.astype(np.int16)), jax.device_put(origins),
-            jax.device_put(lens))
-    np.asarray(args[0][0, 0])                   # sync the uploads
+    args, pts, sub, T = _stage_uniform_slice(m, traces)
     spec = getattr(m, "_wire_spec", None)       # probe the PRODUCTION wire
     wire = match_batch_wire_q(*args, m._tables, m.ts.meta, m.params, None,
                               spec=spec)
@@ -1350,8 +1542,13 @@ def main() -> None:
             matcher_backend="jax",
             service=_SvcCfg(batching="combine"))),
     }
-    service_curve = _service_saturation_curve(svc_apps, ts, traces,
-                                              levels=(16, 64, 256))
+    # one level past 256 (round-8 satellite / VERDICT weak #6): 512
+    # clients probes for the overload boundary instead of stopping where
+    # nothing has ever broken; _service_overload_boundary names the first
+    # level that degrades (or records that 512 still held)
+    service_curve = _service_saturation_curve(
+        svc_apps, ts, traces,
+        levels=(16, 64, 256, 512) if tpu_ok else (16, 64, 256))
     # degraded (CPU) runs keep the paced sweep short: one core serves
     # both the submitters and the matcher, so high offers only measure
     # thread thrash
@@ -1450,6 +1647,8 @@ def main() -> None:
         "service_curve": service_curve,
         "service_ab": ab,
         "service_open_loop": service_open_loop,
+        "service_overload_boundary": _service_overload_boundary(
+            service_curve),
         **({"concurrent_errors": conc_errors[:4]} if conc_errors else {}),
         "cpu_reference_probes_per_sec": round(cpu_pps, 1),
         "oracle_sample_traces": n_cpu,
@@ -1477,6 +1676,10 @@ def main() -> None:
             "probes_per_sec_e2e": round(m_pps, 1),
             "decode_only_probes_per_sec": round(m_decode, 1),
             "hbm_tile_bytes": int(mts.hbm_bytes()),
+            # round-8 satellite: every tile carries its co-located
+            # attribution so the headline table is link-mood-free
+            "device_compute": _device_compute_probe(mm, mtraces, link_rtt,
+                                                    roofline=False),
             "tile_source": mtile_info["source"],
             "tile_stats": mts.stats,
         }
@@ -1505,6 +1708,8 @@ def main() -> None:
             "throughput_vs_unrestricted": round(r_pps / jax_pps, 3),
             "reach_rows_growth": round(
                 rts.reach_to.shape[0] / max(ts.reach_to.shape[0], 1), 3),
+            "device_compute": _device_compute_probe(rm, rtraces, link_rtt,
+                                                    roofline=False),
             "tile_source": rtile_info["source"],
             "tile_stats": rts.stats,
         }
@@ -1546,6 +1751,10 @@ def main() -> None:
             # VERDICT r4 next #3: attribute the xl slowdown — device sweep
             # vs readback vs host walk vs submit, plus the sweep roofline
             "device_compute": _device_compute_probe(xm, xtraces, link_rtt),
+            # round-8 tentpole evidence at metro-xl scale: kernel-lever
+            # A/B (subcull / whole-block / bf16) in interleaved windows +
+            # on-chip byte-identity of the three result wires
+            "sweep_ab": _sweep_variants_probe(xm, xtraces, link_rtt),
             "tile_source": xtile_info["source"],
             "tile_stats": xts.stats,
         }
@@ -1599,6 +1808,8 @@ def main() -> None:
             "reach_audit": _reach_audit_cached(
                 ots, [np.asarray(t.xy, np.float64) for t in otraces[:20]],
                 label=ots.name),
+            "device_compute": _device_compute_probe(om, otraces, link_rtt,
+                                                    roofline=False),
             "tile_source": otile_info["source"],
             "tile_stats": ots.stats,
         }
@@ -1720,6 +1931,27 @@ def main() -> None:
             r["colocated_probes_per_sec"] for r in d_runs]
         split["device_compute_s"] = round(time.perf_counter() - t0, 1)
 
+        # -- round-8 tentpole: sf kernel-lever A/B (same probe as xl's) --
+        t0 = time.perf_counter()
+        detail["sweep_ab"] = _sweep_variants_probe(jax_matcher, traces,
+                                                   link_rtt)
+        split["sweep_ab_s"] = round(time.perf_counter() - t0, 1)
+
+        # -- per-tile co-located e2e (round-8 satellite): the README's
+        # headline table — device-only pipeline bound per tile, no remote
+        # link in the denominator, so the number is free of the link's
+        # ~2x mood swings --------------------------------------------------
+        detail["colocated_e2e"] = {
+            name: blk["device_compute"]["colocated_e2e_probes_per_sec"]
+            for name, blk in (("sf", detail),
+                              ("bayarea", detail["metro"]),
+                              ("sf+r", detail["restricted"]),
+                              ("bayarea-xl", detail["xl"]),
+                              ("organic", detail["organic"]),
+                              ("organic-xl", detail["organic_xl"]))
+            if blk.get("device_compute", {}).get(
+                "colocated_e2e_probes_per_sec") is not None}
+
         # Re-measure EVERY tile back-to-back in a SECOND mood window
         # (~15 min after the first): the link's throughput swings ~1.5-2x
         # over minutes, so window-1 blocks measured minutes apart sit in
@@ -1828,13 +2060,16 @@ def _summary_line(doc: dict) -> dict:
             cur = cur[p]
         return cur
 
-    tiles_pps = {d.get("headline_tile", "sf"): doc["value"]}
-    for key, name in (("metro", "bayarea"), ("restricted", "sf+r"),
-                      ("xl", "bayarea-xl"), ("organic", "organic"),
-                      ("organic_xl", "organic-xl")):
+    # per-tile numbers ride as FIXED-ORDER kpps arrays (round 8: the 1 KB
+    # pin had no room for six names twice) — order is always [sf,
+    # bayarea, sf+r, bayarea-xl, organic, organic-xl]; exact values keep
+    # their names in the detail file
+    tiles_kpps: list = [int(doc["value"] / 1e3)]
+    for key in ("metro", "restricted", "xl", "organic", "organic_xl"):
         v = _g(key, "probes_per_sec_e2e")
-        if v is not None:
-            tiles_pps[name] = int(v)    # whole probes/s: the line budget
+        tiles_kpps.append(None if v is None else int(v / 1e3))
+    if all(v is None for v in tiles_kpps[1:]):
+        tiles_kpps = tiles_kpps[:1]     # sparse runs: just the headline
     per_tile = _g("audit", "per_tile", default={})
     summary = {
         "metric": doc["metric"],
@@ -1842,7 +2077,7 @@ def _summary_line(doc: dict) -> dict:
         "unit": doc["unit"],
         "vs_baseline": doc["vs_baseline"],
         "device": d.get("device"),
-        "tiles_pps": tiles_pps,
+        "tiles_kpps": tiles_kpps,
         "e2e_over_decode": d.get("e2e_over_decode"),
         "p50_trace_ms": d.get("p50_single_trace_latency_ms"),
         "p50_matcher_ms": d.get("p50_matcher_only_ms"),
@@ -1881,7 +2116,28 @@ def _summary_line(doc: dict) -> dict:
                  "cap": _g("streaming_capacity", "best_held_pps"),
                  "rej": _g("streaming_overload", "broker_rejected")},
         "colocated_pps": _g("device_compute", "colocated_probes_per_sec"),
-        "device_ms": _g("device_compute", "device_ms_per_dispatch"),
+        # per-tile co-located e2e in THOUSANDS of probes/s, fixed tile
+        # order [sf, bayarea, sf+r, bayarea-xl, organic, organic-xl] —
+        # the link-mood-free headline table (full per-tile attribution in
+        # detail.*.device_compute; exact values in detail.colocated_e2e)
+        "coe2e_kpps": [
+            None if v is None else int(v / 1e3)
+            for v in (_g("colocated_e2e", t) for t in
+                      ("sf", "bayarea", "sf+r", "bayarea-xl",
+                       "organic", "organic-xl"))],
+        # round-8 kernel-lever A/B on sf, thousands of device probes/s:
+        # [subcull, whole-block, subcull+bf16, wires byte-identical] —
+        # xl's copy + ms/dispatch live in detail.sweep_ab / detail.xl
+        "sweep_kpps": [
+            None if v is None else int(v / 1e3) if not isinstance(v, bool)
+            else int(v)
+            for v in (_g("sweep_ab", "subcull", "device_probes_per_sec"),
+                      _g("sweep_ab", "block", "device_probes_per_sec"),
+                      _g("sweep_ab", "subcull_bf16",
+                         "device_probes_per_sec"),
+                      _g("sweep_ab", "wires_bit_identical"))],
+        # first overloaded client level (None = survived the whole curve)
+        "svc_edge": _g("service_overload_boundary", "clients"),
         # serving-face A/B headline (full curves + open loop in detail):
         # [clients, scheduler req/s, queue-and-combine req/s, dispatches
         # at in-flight depth >= 2, errors] — same run, alternated rounds

@@ -33,6 +33,18 @@ class MatcherParams:
                                    # gather (ops/candidates.py, ~50x faster
                                    # than the sweep on CPU); "auto" picks by
                                    # the active jax backend
+    sweep_subcull: bool = True     # dense sweep: in-kernel sub-block bbox
+                                   # culling + fused narrow top-K (round 8
+                                   # kernel). False = the round-7
+                                   # whole-block kernel, kept for same-run
+                                   # A/B (bench sweep_ab leg). Bit-identical
+                                   # either way (test-asserted).
+    sweep_lowp: str = "off"        # "bf16" = conservative low-precision
+                                   # coarse pair filter with exact f32
+                                   # refinement inside surviving sub-blocks
+                                   # (also bit-identical — the bf16 pass
+                                   # only ever SKIPS provably-out-of-radius
+                                   # slices). "off" = f32 only.
     breakage_distance: float = 2000.0  # consecutive points farther apart break the HMM chain
     max_route_distance_factor: float = 5.0  # route dist > factor*gc ⇒ transition disallowed
     interpolation_distance: float = 10.0    # points closer than this are interpolated, not matched
@@ -49,6 +61,44 @@ class MatcherParams:
 
     def replace(self, **kw: Any) -> "MatcherParams":
         return dataclasses.replace(self, **kw)
+
+    def with_env_overrides(self, env: dict[str, str] | None = None,
+                           ) -> "MatcherParams":
+        """Kernel-tuning env overrides (the matcher analog of
+        ServiceConfig.with_env_overrides): only set variables apply.
+        RTPU_SWEEP_SUBCULL=0|1 and RTPU_SWEEP_LOWP=off|bf16 flip the
+        dense-sweep kernel levers without a code edit — the on-chip A/B
+        discipline every kernel knob here follows (RTPU_SBLK precedent).
+        """
+        e = os.environ if env is None else env
+        kw: dict[str, Any] = {}
+        # validate HERE, strictly: overrides apply after Config.validate()
+        # in SegmentMatcher, and a typo'd lever that silently fell back to
+        # its default would make an on-chip A/B measure an arm against
+        # itself and record a bogus 1.0x
+        if "RTPU_SWEEP_SUBCULL" in e:
+            raw = e["RTPU_SWEEP_SUBCULL"].strip().lower()
+            if raw in ("0", "false", "off", "no", ""):
+                kw["sweep_subcull"] = False
+            elif raw in ("1", "true", "on", "yes"):
+                kw["sweep_subcull"] = True
+            else:
+                raise ValueError(
+                    f"RTPU_SWEEP_SUBCULL={raw!r}: use 0/1")
+        if "RTPU_SWEEP_LOWP" in e:
+            lowp = e["RTPU_SWEEP_LOWP"] or "off"
+            if lowp not in ("off", "bf16"):
+                raise ValueError(
+                    f"RTPU_SWEEP_LOWP={lowp!r}: use 'off' or 'bf16'")
+            kw["sweep_lowp"] = lowp
+        out = dataclasses.replace(self, **kw) if kw else self
+        if out.sweep_lowp == "bf16" and not out.sweep_subcull:
+            # only the two-level kernel implements the low-precision
+            # pass; accepting the combo would silently run plain f32
+            raise ValueError(
+                "sweep_lowp='bf16' requires sweep_subcull=True — the "
+                "whole-block kernel has no low-precision pass")
+        return out
 
     @classmethod
     def preset(cls, mode: str) -> "MatcherParams":
@@ -229,6 +279,14 @@ class Config:
         # against the ACTUAL tileset's index_radius happens at trace time
         # (ops/match._check_grid_coverage) — this one guards the common
         # case where one Config drives both compiler and matcher.
+        if self.matcher.sweep_lowp not in ("off", "bf16"):
+            raise ValueError(
+                f"unknown matcher.sweep_lowp {self.matcher.sweep_lowp!r}; "
+                "use 'off' or 'bf16'")
+        if self.matcher.sweep_lowp == "bf16" and not self.matcher.sweep_subcull:
+            raise ValueError(
+                "matcher.sweep_lowp='bf16' requires sweep_subcull=True — "
+                "the whole-block kernel has no low-precision pass")
         if (self.matcher.candidate_backend == "grid"
                 and self.compiler.index_radius < self.matcher.search_radius):
             raise ValueError(
@@ -297,5 +355,7 @@ class Config:
                 cfg = cls.from_json(f.read())
         else:
             cfg = cls()
-        cfg = dataclasses.replace(cfg, service=cfg.service.with_env_overrides())
+        cfg = dataclasses.replace(cfg,
+                                  service=cfg.service.with_env_overrides(),
+                                  matcher=cfg.matcher.with_env_overrides())
         return cfg.validate()

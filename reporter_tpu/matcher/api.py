@@ -202,9 +202,20 @@ class SegmentMatcher:
     def __init__(self, tileset: TileSet, config: Config | None = None,
                  metrics: MetricsRegistry | None = None,
                  mesh=None):
+        import dataclasses as _dc
+
         self.ts = tileset
         self.config = (config or Config()).validate()
-        self.params: MatcherParams = self.config.matcher
+        # kernel-tuning env overrides (RTPU_SWEEP_*) apply at construction
+        # so on-chip A/B runs flip sweep levers without a code edit;
+        # params is a jit static, so each variant compiles separately.
+        # The override is mirrored back into self.config so anything that
+        # introspects/serializes the matcher's config sees the levers
+        # that actually compiled.
+        params = self.config.matcher.with_env_overrides()
+        if params is not self.config.matcher:
+            self.config = _dc.replace(self.config, matcher=params)
+        self.params: MatcherParams = params
         self.metrics = metrics or MetricsRegistry()
         backend = self.config.matcher_backend
         self._native_walker = None

@@ -70,6 +70,14 @@ _SBLK = int(os.environ.get("RTPU_SBLK", "512"))
 #                   512 re-validated post-narrow-grid — the env override
 #                   exists for interleaved A/B tuning, results are exact
 #                   at any block size since the merge is order-independent)
+_SUB = int(os.environ.get("RTPU_SUB", "128"))
+#                   sub-block columns for the IN-KERNEL second culling
+#                   level (round 8): each DMA'd _SBLK block is tested per
+#                   _SUB-column lane-width slice against the chunk's
+#                   actual points (exact point-vs-bbox distance, tighter
+#                   than the host pre-pass's chunk-bbox test), and the
+#                   pair geometry + top-K selection run only on slices
+#                   that can hold an in-radius pair. Must divide _SBLK.
 _NSUB = 8         # chunk sub-bboxes — 32 points per sub-bbox, the same
 #                   culling tightness as the old 128/4 (results identical)
 _NJ_CAP = 128     # narrow-grid width: max culled blocks per chunk before
@@ -85,6 +93,12 @@ class SegPack(NamedTuple):
 
     pack: np.ndarray   # f32 [8, S_pad] component rows, Morton-sorted columns
     bbox: np.ndarray   # f32 [nblocks, 4] per-block (xmin, ymin, xmax, ymax)
+    sub: "np.ndarray | None" = None
+    #                  # f32 [nblocks, (SBLK/SUB)*4] per-SUB-slice bboxes
+    #                  # (xmin, ymin, xmax, ymax quads; NaN = empty slice)
+    #                  # — the in-kernel second culling level; None on
+    #                  # packs built before round 8 (kernel falls back to
+    #                  # whole-block sweeps)
 
 
 def _morton(x: np.ndarray, y: np.ndarray) -> np.ndarray:
@@ -203,7 +217,37 @@ def build_seg_pack(seg_a: np.ndarray, seg_b: np.ndarray, seg_edge: np.ndarray,
         xs = np.concatenate([a[sl, 0], b[sl, 0]])
         ys = np.concatenate([a[sl, 1], b[sl, 1]])
         bbox[blk] = (xs.min(), ys.min(), xs.max(), ys.max())
-    return SegPack(pack=pack, bbox=bbox)
+
+    # Per-SUB-slice bboxes (round 8, the in-kernel second culling level).
+    # Padding columns (>= s) are excluded; slices with no real column get
+    # NaN quads, which every comparison in the kernel rejects. Vectorized:
+    # the padding tail is contiguous, so per-column extrema with +-inf
+    # sentinels reduce correctly and the all-pad slices are masked after.
+    nsub = block // _SUB if _SUB and block % _SUB == 0 else 1
+    subw = block // nsub
+    real = np.arange(spad) < s
+    big = np.float32(np.inf)
+    cxmin = np.where(real, np.minimum(pack[SP_AX], pack[SP_BX]), big)
+    cymin = np.where(real, np.minimum(pack[SP_AY], pack[SP_BY]), big)
+    cxmax = np.where(real, np.maximum(pack[SP_AX], pack[SP_BX]), -big)
+    cymax = np.where(real, np.maximum(pack[SP_AY], pack[SP_BY]), -big)
+    quads = np.stack([cxmin.reshape(-1, subw).min(1),
+                      cymin.reshape(-1, subw).min(1),
+                      cxmax.reshape(-1, subw).max(1),
+                      cymax.reshape(-1, subw).max(1)], axis=1)
+    quads[~real.reshape(-1, subw).any(1)] = np.nan
+    sub = quads.astype(np.float32).reshape(nblocks, nsub * 4)
+    return SegPack(pack=pack, bbox=bbox, sub=sub)
+
+
+def cull_radius(radius: float) -> float:
+    """The sub-slice cull's statically dilated radius: absorbs f32
+    rounding of the point-to-bbox lower bound so the in-kernel cull can
+    never drop a pair the exact r2 test would keep. ONE definition —
+    bench's host-side culling-stats replication imports it, so the
+    reported pair counts stay exactly what the kernel computes even if
+    this is retuned."""
+    return float(radius) * 1.0005 + 0.01
 
 
 def _block_geometry(px, py, seg):
@@ -314,6 +358,139 @@ def _sweep_kernel(ids_ref, pts_ref, seg_ref, edge_out, off_out, dist_out,
                                 jnp.sqrt(jnp.maximum(md, 0.0)), BIG)
 
 
+def _sweep_kernel_sub(ids_ref, pts_ref, seg_ref, sub_ref, edge_out, off_out,
+                      dist_out, d2_s, edge_s, off_s, *, r2: float, rc2: float,
+                      radius: float, k: int, nj: int, nsub: int, subw: int,
+                      lowp: str):
+    """Two-level sweep (round 8). Per ``subw``-column slice of the DMA'd
+    block: (1) an exact point-vs-slice-bbox distance test (min over the
+    chunk's actual points — tighter than the host pre-pass's chunk-bbox
+    overlap) gates all pair work; (2) the top-K update is ONE fused
+    _select_topk over the [P, subw + k] concat of the slice's distances
+    with the running scratch. The old shape selected over the full
+    _SBLK-wide block and then merged [P, 2k] — ~4x the selection
+    reductions when a single slice holds every in-radius pair, and the
+    roofline says selection roughly doubles effective sweep cost.
+
+    ``lowp="bf16"`` inserts a recentered bf16 coarse pair pass per
+    surviving slice: exact f32 geometry + selection run only when the
+    coarse distances admit an in-radius pair within a conservative
+    margin (a 16-ulp bound on the recentered coordinate magnitude plus
+    0.5 m slack), so the refinement is exact and results stay
+    bit-identical to the f32-only path by construction.
+
+    Exactness of the culling: slice bboxes are built from the same f32
+    endpoint values the geometry reads, the point-to-bbox distance is a
+    lower bound on every point-to-segment distance in the slice, and
+    ``rc2`` carries a small static dilation over ``r2`` to absorb f32
+    rounding of the bound itself — so no in-radius pair is ever skipped.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        d2_s[:] = jnp.full_like(d2_s, BIG)
+        edge_s[:] = jnp.full_like(edge_s, -1)
+        off_s[:] = jnp.zeros_like(off_s)
+
+    # same launch-skip discipline as _sweep_kernel: padded id slots repeat
+    # the previous id, so non-hit grid steps cost only the program launch
+    fresh = (j == 0) | (ids_ref[i, j] != ids_ref[i, jnp.maximum(j - 1, 0)])
+
+    @pl.when(fresh)
+    def _():
+        px = pts_ref[:, 0:1]
+        py = pts_ref[:, 1:2]
+        sb = sub_ref[:]                                    # [1, nsub*4]
+        for s in range(nsub):                              # static unroll
+            lox = sb[0:1, 4 * s + 0:4 * s + 1]             # [1, 1] each
+            loy = sb[0:1, 4 * s + 1:4 * s + 2]
+            hix = sb[0:1, 4 * s + 2:4 * s + 3]
+            hiy = sb[0:1, 4 * s + 3:4 * s + 4]
+            dx = jnp.maximum(jnp.maximum(lox - px, px - hix), 0.0)
+            dy = jnp.maximum(jnp.maximum(loy - py, py - hiy), 0.0)
+            bb2 = dx * dx + dy * dy                        # [P, 1]
+
+            # NaN quads (all-padding slices) compare False -> skipped
+            @pl.when(jnp.min(bb2) <= rc2)
+            def _(s=s, lox=lox, loy=loy, hix=hix, hiy=hiy):
+                seg = seg_ref[:, s * subw:(s + 1) * subw]
+
+                def exact():
+                    d2, edge, offabs = _block_geometry(px, py, seg)
+                    d2 = jnp.where((edge >= 0) & (d2 <= r2), d2, BIG)
+
+                    @pl.when(jnp.min(d2) < BIG)
+                    def _():
+                        md, me, mo = _select_topk(
+                            jnp.concatenate([d2_s[:], d2], axis=1),
+                            jnp.concatenate([edge_s[:], edge], axis=1),
+                            jnp.concatenate([off_s[:], offabs], axis=1), k)
+                        d2_s[:] = md
+                        edge_s[:] = me
+                        off_s[:] = mo
+
+                if lowp != "bf16":
+                    exact()
+                else:
+                    # recenter on the slice bbox AND clamp every operand
+                    # into the bbox dilated by ~radius: the slice's real
+                    # endpoints already lie inside (unchanged); far-away
+                    # chunk points and zero-padding columns clamp to the
+                    # boundary. Projection onto a convex set containing
+                    # the slice's segments never increases the distance
+                    # to them, so the coarse test stays conservative —
+                    # and the bf16 error scale is bounded by the SLICE
+                    # extent + radius instead of the whole chunk's
+                    # spread (unclamped, a 2 km trace chunk inflated the
+                    # margin until the filter stopped culling anything)
+                    mx = jnp.float32(radius) * 1.001 + 0.5
+                    cx = (lox + hix) * 0.5
+                    cy = (loy + hiy) * 0.5
+                    ex = (hix - lox) * 0.5 + mx            # [1, 1]
+                    ey = (hiy - loy) * 0.5 + mx
+                    pxc = jnp.clip(px - cx, -ex, ex)
+                    pyc = jnp.clip(py - cy, -ey, ey)
+                    axc = jnp.clip(seg[SP_AX:SP_AX + 1, :] - cx, -ex, ex)
+                    ayc = jnp.clip(seg[SP_AY:SP_AY + 1, :] - cy, -ey, ey)
+                    bxc = jnp.clip(seg[SP_BX:SP_BX + 1, :] - cx, -ex, ex)
+                    byc = jnp.clip(seg[SP_BY:SP_BY + 1, :] - cy, -ey, ey)
+                    scale = jnp.maximum(ex, ey)            # |coord| bound
+                    bf = jnp.bfloat16
+                    pxl, pyl = pxc.astype(bf), pyc.astype(bf)
+                    axl, ayl = axc.astype(bf), ayc.astype(bf)
+                    abx = bxc.astype(bf) - axl
+                    aby = byc.astype(bf) - ayl
+                    den = jnp.maximum(abx * abx + aby * aby,
+                                      jnp.asarray(1e-12, bf))
+                    t = jnp.clip(((pxl - axl) * abx + (pyl - ayl) * aby)
+                                 / den,
+                                 jnp.asarray(0.0, bf), jnp.asarray(1.0, bf))
+                    dxl = pxl - (axl + t * abx)
+                    dyl = pyl - (ayl + t * aby)
+                    d2c = (dxl * dxl + dyl * dyl).astype(jnp.float32)
+                    # conservative inflation: bf16 rounds each operand to
+                    # <= scale * 2^-9 absolute error and the ~10-op chain
+                    # accumulates a few ulps more — scale * 2^-4 (6.25%)
+                    # is ~16x that bound, plus a 0.5 m absolute slack for
+                    # the tiny-coordinate regime
+                    rl = (jnp.float32(radius) + scale * jnp.float32(0.0625)
+                          + jnp.float32(0.5))              # [1, 1]
+
+                    @pl.when(jnp.min(d2c) <= jnp.min(rl * rl))
+                    def _():
+                        exact()
+
+    @pl.when(j == nj - 1)
+    def _():
+        md = d2_s[:]
+        edge_out[:] = edge_s[:]
+        off_out[:] = off_s[:]
+        dist_out[:] = jnp.where(md < BIG,
+                                jnp.sqrt(jnp.maximum(md, 0.0)), BIG)
+
+
 def _chunk_block_ids(pts, valid, bbox, radius: float, nchunks: int):
     """Culling pre-pass: ([nchunks, nblocks] i32 block ids to visit,
     [nchunks] i32 hit counts).
@@ -355,8 +532,17 @@ def _chunk_block_ids(pts, valid, bbox, radius: float, nchunks: int):
 
 
 def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
-                  k: int):
-    pack, bbox = seg_pack
+                  k: int, subcull: bool = True, lowp: str = "off"):
+    pack, bbox = seg_pack[0], seg_pack[1]
+    sub = seg_pack[2] if len(seg_pack) > 2 else None
+    use_sub = bool(subcull) and sub is not None
+    if lowp == "bf16" and not use_sub:
+        # only the two-level kernel implements the low-precision pass;
+        # silently running plain f32 would let an A/B "bf16 arm" measure
+        # f32 against itself (the config layer raises the same way)
+        raise ValueError(
+            "lowp='bf16' requires the two-level kernel: subcull=True and "
+            "a seg_pack built with sub quads")
     n = points.shape[0]
     spad = pack.shape[1]
     nchunks = max(1, (n + _P - 1) // _P)
@@ -373,16 +559,34 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
 
     ids, nhits = _chunk_block_ids(pts, val, bbox, radius, nchunks)
 
+    if use_sub:
+        nsub4 = int(sub.shape[1])
+        nsub = nsub4 // 4
+        subw = _SBLK // nsub
+        rc = cull_radius(radius)
+    r2 = float(radius) * float(radius)
+
     def call(ids_g, pts_g, nj):
         nc = ids_g.shape[0]
+        in_specs = [
+            pl.BlockSpec((_P, 2), lambda i, j, ids: (i, 0)),
+            pl.BlockSpec((SP_NCOMP, _SBLK),
+                         lambda i, j, ids: (0, ids[i, j])),
+        ]
+        inputs = [ids_g, pts_g, pack]
+        if use_sub:
+            in_specs.append(
+                pl.BlockSpec((1, nsub4), lambda i, j, ids: (ids[i, j], 0)))
+            inputs.append(sub)
+            kern = functools.partial(
+                _sweep_kernel_sub, r2=r2, rc2=rc * rc, radius=float(radius),
+                k=k, nj=nj, nsub=nsub, subw=subw, lowp=lowp)
+        else:
+            kern = functools.partial(_sweep_kernel, r2=r2, k=k, nj=nj)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(nc, nj),
-            in_specs=[
-                pl.BlockSpec((_P, 2), lambda i, j, ids: (i, 0)),
-                pl.BlockSpec((SP_NCOMP, _SBLK),
-                             lambda i, j, ids: (0, ids[i, j])),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((_P, k), lambda i, j, ids: (i, 0)),
                 pl.BlockSpec((_P, k), lambda i, j, ids: (i, 0)),
@@ -395,8 +599,7 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
             ],
         )
         return pl.pallas_call(
-            functools.partial(_sweep_kernel, r2=float(radius) * float(radius),
-                              k=k, nj=nj),
+            kern,
             grid_spec=grid_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((nc * _P, k), jnp.int32),
@@ -404,7 +607,7 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
                 jax.ShapeDtypeStruct((nc * _P, k), jnp.float32),
             ],
             interpret=_INTERPRET,
-        )(ids_g, pts_g, pack)
+        )(*inputs)
 
     def sweep(ids_w):
         """Full sweep at one static id-list width. The grid dim must
@@ -478,19 +681,28 @@ def _use_pallas() -> bool:
 
 def find_candidates_dense(points, seg_pack, radius: float,
                           max_candidates: int,
-                          valid=None) -> CandidateSet:
+                          valid=None, subcull: bool = True,
+                          lowp: str = "off") -> CandidateSet:
     """points f32 [N, 2] → CandidateSet with [N, K] fields (flat batch).
 
-    seg_pack: a SegPack (or (pack, bbox) tuple of arrays). valid (bool [N],
-    optional) marks padding points — they still produce (ignored) rows but
-    are excluded from the culling bboxes. Uses the pallas sweep on
-    accelerators, the jnp full sweep on CPU backends.
+    seg_pack: a SegPack (or (pack, bbox[, sub]) tuple of arrays). valid
+    (bool [N], optional) marks padding points — they still produce
+    (ignored) rows but are excluded from the culling bboxes. Uses the
+    pallas sweep on accelerators, the jnp full sweep on CPU backends.
+
+    subcull enables the in-kernel sub-block culling + fused narrow top-K
+    (round 8; needs the pack's ``sub`` quads — silently falls back to the
+    whole-block kernel without them). lowp="bf16" adds the conservative
+    low-precision coarse pair filter with exact f32 refinement. Both are
+    bit-identical to the whole-block kernel and the jnp reference by
+    construction (interpret-mode test-asserted).
     """
     if valid is None:
         valid = jnp.ones(points.shape[0], bool)
     if _use_pallas():
         edge, off, dist = _dense_pallas(points, valid, seg_pack, radius,
-                                        max_candidates)
+                                        max_candidates, subcull=subcull,
+                                        lowp=lowp)
     else:
         edge, off, dist = _dense_jnp(points, seg_pack, radius, max_candidates)
     return CandidateSet(edge=edge, offset=off, dist=dist, valid=edge >= 0)

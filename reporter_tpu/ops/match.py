@@ -62,9 +62,12 @@ def batch_candidates(points, valid_pt, tables, meta,
     if backend == "dense":
         flat = find_candidates_dense(
             points.reshape(B * T, 2),
-            (tables["seg_pack"], tables["seg_bbox"]),
+            (tables["seg_pack"], tables["seg_bbox"],
+             tables.get("seg_sub")),
             params.search_radius, params.max_candidates,
-            valid=valid_pt.reshape(B * T))
+            valid=valid_pt.reshape(B * T),
+            subcull=getattr(params, "sweep_subcull", True),
+            lowp=getattr(params, "sweep_lowp", "off"))
         return CandidateSet(*(x.reshape(B, T, -1) for x in flat))
     if backend != "grid":
         raise ValueError(

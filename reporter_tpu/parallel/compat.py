@@ -1,0 +1,24 @@
+"""jax version compat for the mesh product path.
+
+``jax.shard_map`` graduated from ``jax.experimental.shard_map`` with a
+kwarg rename on the way (``check_rep`` → ``check_vma``). Every module in
+parallel/ imports :func:`shard_map` from here so one shim covers both
+generations: on a jax new enough to carry the top-level alias we use it
+untouched; otherwise the experimental entry point is wrapped to accept
+the modern ``check_vma`` spelling. Call sites always pass mesh/specs as
+keywords and only ever set ``check_vma`` — the one kwarg whose name
+moved — so the wrapper stays this small.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax <= 0.4.x: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)

@@ -13,6 +13,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from reporter_tpu.config import MatcherParams
 from reporter_tpu.ops.match import MatchOutput, match_traces
+from reporter_tpu.parallel.compat import shard_map
 from reporter_tpu.tiles.tileset import TileSet
 
 
@@ -35,7 +36,7 @@ def make_dp_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams):
                             NamedSharding(mesh, P()))
     meta = ts.meta
 
-    local = jax.shard_map(
+    local = shard_map(
         lambda p, v, tbl: match_traces(p, v, tbl, meta, params),
         mesh=mesh,
         in_specs=(P(axes), P(axes), jax.tree.map(lambda _: P(), tables)),

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from reporter_tpu.config import MatcherParams
 from reporter_tpu.ops.match import wire_from_f32, wire_from_q8, wire_from_q16
+from reporter_tpu.parallel.compat import shard_map
 from reporter_tpu.tiles.tileset import TileSet
 
 _IMPLS = {"f32": wire_from_f32, "q16": wire_from_q16, "q8": wire_from_q8}
@@ -109,7 +110,7 @@ class DpWireMatcher:
                 return impl(*ins, tbl, meta, params, None, spec)
             in_specs = (data,) * nargs + (tbl_specs,)
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             local, mesh=self.mesh, in_specs=in_specs, out_specs=data,
             check_vma=False))   # same constant-carry caveat as parallel/dp
         self._fns[key] = fn

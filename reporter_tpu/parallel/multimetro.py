@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from reporter_tpu.config import MatcherParams
 from reporter_tpu.ops.candidates import GridMeta
 from reporter_tpu.ops.match import MatchOutput, match_traces
+from reporter_tpu.parallel.compat import shard_map
 from reporter_tpu.tiles.tileset import TileSet
 
 _PAD_VALUES: dict[str, Any] = {
@@ -37,6 +38,9 @@ _PAD_VALUES: dict[str, Any] = {
     "seg_pack": np.int32(-1).view(np.float32),
     # NaN bboxes never overlap anything → padded blocks are never selected
     "seg_bbox": np.float32(np.nan),
+    # same rule for the in-kernel sub-block quads (rows pad in sync with
+    # seg_bbox: whole _SBLK blocks)
+    "seg_sub": np.float32(np.nan),
     "reach_to": -1,          # no reachable target
     "reach_dist": np.float32(np.inf),
     "edge_osmlr": -1,
@@ -168,7 +172,7 @@ def make_multimetro_matcher(mesh: Mesh, stacked: StackedTiles,
     # check_vma off: the Viterbi scan seeds its carry from constants, which
     # the varying-manual-axes checker rejects inside shard_map even though
     # the computation is per-shard correct (constants are trivially varying).
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(P("tile", "dp"), P("tile", "dp"), tbl_specs),
         out_specs=(P("tile", "dp"), P("tile")),

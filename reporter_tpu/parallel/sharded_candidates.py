@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from reporter_tpu.config import MatcherParams
 from reporter_tpu.ops.candidates import CandidateSet
+from reporter_tpu.parallel.compat import shard_map
 from reporter_tpu.ops.dense_candidates import (
     _SBLK,
     SegPack,
@@ -46,6 +47,7 @@ from reporter_tpu.tiles.tileset import TileSet
 class ShardedTables(NamedTuple):
     seg_pack: jnp.ndarray    # [8, S_pad] — sharded over columns
     seg_bbox: jnp.ndarray    # [nblocks, 4] — sharded over rows
+    seg_sub: jnp.ndarray     # [nblocks, nsub*4] — sharded over rows
     edge_len: jnp.ndarray    # replicated
     reach_row: jnp.ndarray   # replicated (edge → governing reach row)
     reach_to: jnp.ndarray
@@ -67,12 +69,16 @@ def shard_tables(mesh: Mesh, ts: TileSet, axis: str = "tile",
     pack[:, :spad] = sp.pack
     bbox = np.full((total // _SBLK, 4), np.nan, np.float32)
     bbox[:sp.bbox.shape[0]] = sp.bbox
+    sub = np.full((total // _SBLK, sp.sub.shape[1]), np.nan, np.float32)
+    sub[:sp.sub.shape[0]] = sp.sub
 
     return ShardedTables(
         seg_pack=jax.device_put(jnp.asarray(pack),
                                 NamedSharding(mesh, P(None, axis))),
         seg_bbox=jax.device_put(jnp.asarray(bbox),
                                 NamedSharding(mesh, P(axis))),
+        seg_sub=jax.device_put(jnp.asarray(sub),
+                               NamedSharding(mesh, P(axis))),
         edge_len=jax.device_put(jnp.asarray(ts.edge_len),
                                 NamedSharding(mesh, P())),
         reach_row=jax.device_put(jnp.asarray(ts.edge_reach_row),
@@ -110,12 +116,14 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     tables = shard_tables(mesh, ts, axis)
     radius, k = params.search_radius, params.max_candidates
 
-    def local(points, valid, seg_pack, seg_bbox, edge_len, reach_row,
-              reach_to, reach_dist):
+    def local(points, valid, seg_pack, seg_bbox, seg_sub, edge_len,
+              reach_row, reach_to, reach_dist):
         B, T = points.shape[:2]
         flat = find_candidates_dense(
-            points.reshape(B * T, 2), (seg_pack, seg_bbox), radius, k,
-            valid=valid.reshape(B * T))
+            points.reshape(B * T, 2), (seg_pack, seg_bbox, seg_sub),
+            radius, k, valid=valid.reshape(B * T),
+            subcull=getattr(params, "sweep_subcull", True),
+            lowp=getattr(params, "sweep_lowp", "off"))
         # all-gather each shard's K-list over ICI, then K-merge
         ge = jax.lax.all_gather(flat.edge, axis)        # [shards, N, K]
         gd = jax.lax.all_gather(flat.dist, axis)
@@ -136,10 +144,10 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
                            chain_start=vit.chain_start, matched=vit.matched)
 
     other = [a for a in mesh.axis_names if a != axis]
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh,
         in_specs=(P(*other) if other else P(), P(*other) if other else P(),
-                  P(None, axis), P(axis), P(), P(), P(), P()),
+                  P(None, axis), P(axis), P(axis), P(), P(), P(), P()),
         out_specs=P(*other) if other else P(),
         check_vma=False,
     )
@@ -147,7 +155,7 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     @jax.jit
     def step(points, valid) -> MatchOutput:
         return sharded(points, valid, tables.seg_pack, tables.seg_bbox,
-                       tables.edge_len, tables.reach_row,
+                       tables.seg_sub, tables.edge_len, tables.reach_row,
                        tables.reach_to, tables.reach_dist)
 
     return step

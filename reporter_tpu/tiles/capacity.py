@@ -57,17 +57,19 @@ def dense_staged_bytes(ts: TileSet) -> tuple[int, int]:
     fixed — per-edge arrays + node-keyed reach rows, replicated by design
     (every shard's Viterbi needs them).
     """
-    from reporter_tpu.ops.dense_candidates import (_SBLK, SP_NCOMP,
+    from reporter_tpu.ops.dense_candidates import (_SBLK, _SUB, SP_NCOMP,
                                                    packed_columns)
 
     # exact shape math for build_seg_pack's layout ([SP_NCOMP, S_pad] f32
-    # pack + [S_pad/_SBLK, 4] f32 bboxes) — computing it beats REBUILDING
-    # the Morton pack (~seconds at 0.6M segments on a one-core host).
+    # pack + [S_pad/_SBLK, 4] f32 block bboxes + the per-sub-block quads
+    # [S_pad/_SBLK, (SBLK/SUB)*4]) — computing it beats REBUILDING the
+    # Morton pack (~seconds at 0.6M segments on a one-core host).
     # packed_columns accounts for the long-segment pre-split at the
     # shared dense_candidates.SPLIT_LEN (the pack holds MORE columns than
     # ts.seg_edge on tiles with long segments).
     spad = packed_columns(ts.seg_len)
-    shardable = (SP_NCOMP * spad + (spad // _SBLK) * 4) * 4
+    nsub = _SBLK // _SUB if _SUB and _SBLK % _SUB == 0 else 1
+    shardable = (SP_NCOMP * spad + (spad // _SBLK) * 4 * (1 + nsub)) * 4
     fixed = int(ts.edge_len.nbytes + ts.edge_reach_row.nbytes
                 + ts.edge_osmlr.nbytes + ts.reach_to.nbytes
                 + ts.reach_dist.nbytes)

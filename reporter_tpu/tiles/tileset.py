@@ -267,6 +267,9 @@ class TileSet:
                                 self.seg_off, self.seg_len)
             out["seg_pack"] = jnp.asarray(sp.pack)
             out["seg_bbox"] = jnp.asarray(sp.bbox)
+            # per-sub-block bbox quads: the kernel's in-block second
+            # culling level (round 8) — tiny next to seg_pack
+            out["seg_sub"] = jnp.asarray(sp.sub)
         return out
 
     def hbm_bytes(self) -> int:

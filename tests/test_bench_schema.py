@@ -1,0 +1,147 @@
+"""Pin the BENCH_DETAIL.json roofline / culling-stats schema (round-8
+satellite): the utilization evidence (%-of-peak, two-level culling
+counts, kernel tag, block-level "before" flops) must survive future
+kernel changes — a refactor that silently drops a field would erase the
+capture's before/after story. Pure-host checks: the culling replication
+is numpy, the roofline runs against a fake matcher object."""
+
+import importlib.util
+import os
+from types import SimpleNamespace
+
+import numpy as np
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench_module", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tiny_pack():
+    """A few segments through build_seg_pack — the real layout builder,
+    so the stats replication is exercised against real quads."""
+    from reporter_tpu.ops.dense_candidates import build_seg_pack
+
+    rng = np.random.default_rng(5)
+    n = 40
+    a = rng.uniform(0, 900.0, (n, 2)).astype(np.float32)
+    b = (a + rng.uniform(-80.0, 80.0, (n, 2))).astype(np.float32)
+    seg_len = np.linalg.norm(b - a, axis=1).astype(np.float32)
+    return build_seg_pack(a, b, np.arange(n, dtype=np.int32),
+                          np.zeros(n, np.float32), seg_len)
+
+
+CULLING_KEYS = {
+    "blocks_total", "block_visits_per_dispatch", "mean_blocks_per_chunk",
+    "culled_fraction", "sub_slices_per_block", "sub_visits_per_dispatch",
+    "sub_fraction_of_block_cols",
+}
+
+ROOFLINE_KEYS = CULLING_KEYS | {
+    "kernel", "hbm_bytes_swept", "pair_flops", "pair_flops_block_level",
+    "topk_width", "achieved_GBps", "achieved_Gflops",
+    "pct_of_v5e_hbm_peak", "pct_of_v5e_vpu_f32_peak",
+    "pct_vpu_block_level", "note",
+}
+
+
+def test_culling_stats_schema_and_invariants():
+    bench = _load_bench()
+    sp = _tiny_pack()
+    rng = np.random.default_rng(6)
+    pts = rng.uniform(0, 900.0, (300, 2))
+
+    stats = bench._sweep_culling_stats(sp.bbox, sp.sub, pts, 50.0)
+    assert CULLING_KEYS <= set(stats)
+    nsub = stats["sub_slices_per_block"]
+    assert nsub >= 1
+    # level 2 can only SHRINK level 1's work, never exceed it
+    assert (stats["sub_visits_per_dispatch"]
+            <= stats["block_visits_per_dispatch"] * nsub)
+    assert 0.0 <= stats["sub_fraction_of_block_cols"] <= 1.0
+    assert 0.0 <= stats["culled_fraction"] <= 1.0
+
+    # without sub quads the stats degrade to block-level identities
+    flat = bench._sweep_culling_stats(sp.bbox, None, pts, 50.0)
+    assert flat["sub_slices_per_block"] == 1
+    assert (flat["sub_visits_per_dispatch"]
+            == flat["block_visits_per_dispatch"])
+
+
+def test_roofline_schema_both_kernels():
+    import jax.numpy as jnp
+
+    from reporter_tpu.config import MatcherParams
+
+    bench = _load_bench()
+    sp = _tiny_pack()
+    tables = {"seg_pack": jnp.asarray(sp.pack),
+              "seg_bbox": jnp.asarray(sp.bbox),
+              "seg_sub": jnp.asarray(sp.sub)}
+    pts = np.random.default_rng(7).uniform(0, 900.0, (256, 2)
+                                           ).astype(np.float32)
+    for params in (MatcherParams(),
+                   MatcherParams(sweep_subcull=False),
+                   MatcherParams(sweep_lowp="bf16")):
+        m = SimpleNamespace(_tables=tables, params=params)
+        out = bench._sweep_roofline(m, pts, per_dispatch_s=0.1)
+        assert ROOFLINE_KEYS <= set(out), params
+        assert out["pair_flops"] <= out["pair_flops_block_level"]
+        if params.sweep_subcull:
+            assert out["kernel"].startswith("subcull")
+        else:
+            assert out["kernel"] == "block"
+        if params.sweep_lowp == "bf16":
+            assert out["kernel"].endswith("+bf16")
+
+
+def test_summary_line_carries_roofline_era_fields():
+    """The compact driver line must keep the round-8 fields: per-tile
+    co-located table, sweep A/B, overload boundary."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "colocated_e2e": {"sf": 3000000.0, "bayarea-xl": 1800000.0},
+               "sweep_ab": {
+                   "subcull": {"device_probes_per_sec": 3500000.0},
+                   "block": {"device_probes_per_sec": 3000000.0},
+                   "subcull_bf16": {"device_probes_per_sec": 3300000.0},
+                   "wires_bit_identical": True},
+               "service_overload_boundary": {"clients": 512},
+           }}
+    line = bench._summary_line(doc)
+    assert line["coe2e_kpps"][0] == 3000    # sf first, fixed order
+    assert line["coe2e_kpps"][3] == 1800    # bayarea-xl fourth
+    assert line["sweep_kpps"] == [3500, 3000, 3300, 1]
+    assert line["svc_edge"] == 512
+
+
+def test_service_overload_boundary_rules():
+    bench = _load_bench()
+
+    def lvl(clients, p99, rps, errors=0):
+        return {"clients": clients,
+                "scheduler": {"p99_ms": p99, "req_per_sec": rps,
+                              "errors": errors}}
+
+    held = [lvl(16, 100.0, 100.0), lvl(64, 150.0, 300.0),
+            lvl(256, 300.0, 900.0), lvl(512, 600.0, 1500.0)]
+    out = bench._service_overload_boundary(held)
+    assert out["clients"] is None and "512" in out["reason"]
+
+    blow = held[:3] + [lvl(512, 3000.0, 1500.0)]
+    assert bench._service_overload_boundary(blow) == {
+        "clients": 512, "reason": "p99_blowup"}
+
+    regress = held[:3] + [lvl(512, 500.0, 300.0)]
+    assert bench._service_overload_boundary(regress) == {
+        "clients": 512, "reason": "rps_regression"}
+
+    errs = held[:2] + [lvl(256, 300.0, 900.0, errors=3)]
+    assert bench._service_overload_boundary(errs) == {
+        "clients": 256, "reason": "errors"}

@@ -70,9 +70,23 @@ def _synthetic_doc():
         "streaming_overload": {"broker_rejected": 1234567},
         "device_compute": {"colocated_probes_per_sec": 3150000.2,
                            "device_ms_per_dispatch": 155.31},
-        "service_ab": {"clients": 256, "scheduler_rps": 1544.3,
+        "colocated_e2e": {"sf": 3030000.1, "bayarea": 2810000.2,
+                          "sf+r": 2950000.3, "bayarea-xl": 1890000.4,
+                          "organic": 2610000.5, "organic-xl": 1720000.6},
+        "sweep_ab": {
+            "subcull": {"device_probes_per_sec": 3560000.7,
+                        "device_ms_per_dispatch": 138.11},
+            "block": {"device_probes_per_sec": 3030000.8,
+                      "device_ms_per_dispatch": 162.22},
+            "subcull_bf16": {"device_probes_per_sec": 3410000.9,
+                             "device_ms_per_dispatch": 144.33},
+            "wires_bit_identical": True,
+        },
+        "service_ab": {"clients": 512, "scheduler_rps": 1544.3,
                        "legacy_rps": 713.9, "speedup": 2.163,
                        "inflight_ge2_dispatches": 37, "errors": 0},
+        "service_overload_boundary": {"clients": 512,
+                                      "reason": "p99_blowup"},
         "total_seconds": 801.5,
     }
     return {"metric": "probes_per_sec_e2e", "value": 2280000.1,

@@ -36,7 +36,8 @@ class TestPlanStaging:
         shardable, fixed = dense_staged_bytes(tiny_tiles)
         assert fixed == staged_fixed
         real = (int(np.asarray(tables["seg_pack"]).nbytes)
-                + int(np.asarray(tables["seg_bbox"]).nbytes))
+                + int(np.asarray(tables["seg_bbox"]).nbytes)
+                + int(np.asarray(tables["seg_sub"]).nbytes))
         assert shardable == real    # exact: same builder, same layout
 
     def test_sharded_past_budget_and_monotone(self, tiny_tiles):

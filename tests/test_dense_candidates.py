@@ -246,95 +246,122 @@ def test_match_batch_dense_vs_grid(ts, tables):
                                   np.asarray(out_g.matched))
 
 
-def test_pallas_kernel_interpret_parity():
-    """Run the actual pallas kernel through the interpreter (CPU) and
-    compare with the jnp sweep — guards kernel logic without TPU access.
-    Subprocess: _INTERPRET is read at module import."""
-    import os
-    import subprocess
-    import sys
+def test_seg_pack_sub_quads(ts):
+    """The per-sub-block quads (round 8, the kernel's second culling
+    level): every real column's endpoints sit inside its own slice's
+    quad, all-padding slices carry NaN, and the quads never exceed the
+    whole block's bbox."""
+    from reporter_tpu.ops.dense_candidates import _SBLK, _SUB
 
-    script = """
-import os
-os.environ["JAX_PLATFORMS"] = "cpu"
-import jax
-jax.config.update("jax_platforms", "cpu")
-import numpy as np, jax.numpy as jnp
-from reporter_tpu.config import CompilerParams
-from reporter_tpu.netgen.synthetic import generate_city
-from reporter_tpu.netgen.traces import synthesize_fleet
-from reporter_tpu.ops.dense_candidates import find_candidates_dense, _dense_jnp
-from reporter_tpu.tiles.compiler import compile_network
-
-ts = compile_network(generate_city("tiny", seed=11), CompilerParams())
-t = ts.device_tables()
-fleet = synthesize_fleet(ts, 2, num_points=40, seed=5)
-pts = np.stack([p.xy for p in fleet]).astype(np.float32).reshape(-1, 2)
-pall = find_candidates_dense(jnp.asarray(pts), (t["seg_pack"], t["seg_bbox"]), 50.0, 8)
-e, o, d = _dense_jnp(jnp.asarray(pts), (t["seg_pack"], None), 50.0, 8)
-assert (np.asarray(pall.edge) == np.asarray(e)).all(), "edge mismatch"
-assert np.allclose(np.asarray(pall.dist), np.asarray(d), rtol=1e-5, atol=1e-2)
-print("INTERPRET_PARITY_OK")
-"""
-    env = dict(os.environ)
-    env["RTPU_PALLAS_INTERPRET"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    proc = subprocess.run([sys.executable, "-c", script], env=env,
-                          capture_output=True, text=True, timeout=300,
-                          cwd=os.path.dirname(os.path.dirname(
-                              os.path.abspath(__file__))))
-    assert "INTERPRET_PARITY_OK" in proc.stdout, proc.stderr[-2000:]
+    sp = build_seg_pack(ts.seg_a, ts.seg_b, ts.seg_edge, ts.seg_off,
+                        ts.seg_len)
+    nsub = _SBLK // _SUB if _SBLK % _SUB == 0 else 1
+    subw = _SBLK // nsub
+    assert sp.sub.shape == (sp.bbox.shape[0], nsub * 4)
+    edges = sp.pack[6].view(np.int32)
+    for blk in range(sp.bbox.shape[0]):
+        for s in range(nsub):
+            cols = slice(blk * _SBLK + s * subw, blk * _SBLK + (s + 1) * subw)
+            real = edges[cols] >= 0
+            quad = sp.sub[blk, 4 * s:4 * s + 4]
+            if not real.any():
+                assert np.isnan(quad).all()
+                continue
+            xs = np.concatenate([sp.pack[0, cols][real],
+                                 sp.pack[2, cols][real]])
+            ys = np.concatenate([sp.pack[1, cols][real],
+                                 sp.pack[3, cols][real]])
+            assert xs.min() >= quad[0] - 1e-3 and xs.max() <= quad[2] + 1e-3
+            assert ys.min() >= quad[1] - 1e-3 and ys.max() <= quad[3] + 1e-3
+            if not np.isnan(sp.bbox[blk]).any():
+                assert quad[0] >= sp.bbox[blk, 0] - 1e-3
+                assert quad[2] <= sp.bbox[blk, 2] + 1e-3
 
 
-def test_pallas_narrow_grid_cap_both_branches():
-    """The narrow-grid launch (_NJ_CAP truncation) and its full-width
-    fallback must both reproduce the jnp sweep exactly. Interpret-mode
-    subprocess with the cap forced tiny so BOTH cond branches execute:
-    a spatially tight batch fits the cap (narrow sweep), a spread-out
-    batch exceeds it (fallback)."""
-    import os
-    import subprocess
-    import sys
+def test_pallas_kernels_interpret_parity(ts, monkeypatch):
+    """EVERY pallas sweep kernel through the interpreter vs the jnp
+    reference — the bit-identity gate for kernel logic without TPU
+    access. One in-process test replaces the old per-case subprocesses:
+    ``_INTERPRET`` / ``_SBLK`` / ``_SUB`` / ``_NJ_CAP`` are module
+    globals read at CALL time, so monkeypatch flips them, and interpret
+    pallas costs seconds PER CALL (the narrow-grid cond traces BOTH
+    sweeps each call), so coverage is folded into four calls over one
+    shared batch shape:
 
-    script = """
-import os
-os.environ["JAX_PLATFORMS"] = "cpu"
-import jax
-jax.config.update("jax_platforms", "cpu")
-import numpy as np, jax.numpy as jnp
-from reporter_tpu.config import CompilerParams
-from reporter_tpu.netgen.synthetic import generate_city
-import reporter_tpu.ops.dense_candidates as dc
-from reporter_tpu.tiles.compiler import compile_network
+      1. round-8 two-level kernel, narrow launch EXECUTING (_NJ_CAP=1,
+         spatially tight batch) — junction-node d=0 ties included;
+      2. same kernel, full-width fallback executing (spread batch with
+         48-52 m radius-boundary points: the in/out decision rides the
+         exact r2 test);
+      3. bf16 coarse-filter variant (cond lifted — one trace), same
+         spread batch: conservative-refinement exactness incl. ties;
+      4. the retained r7 whole-block kernel (sweep_subcull=False), cond
+         live — the bench A/B arm stays pinned too.
 
-dc._NJ_CAP = 4      # force the cond on a 13-block tile
-ts = compile_network(generate_city("sf"), CompilerParams())
-t = ts.device_tables()
-assert t["seg_bbox"].shape[0] > dc._NJ_CAP
-rng = np.random.default_rng(3)
-lo = ts.node_xy.min(axis=0)
-hi = ts.node_xy.max(axis=0)
+    _SBLK forced to 128 / _SUB to 64 so even the tiny tile spans
+    multiple blocks x 2 sub-slices per block (multi-block merge + the
+    `fresh` skip + both cond branches all exercise)."""
+    import jax.numpy as jnp
 
-# tight batch: one street corner's worth of points -> hits <= cap
-tight = (lo + 0.4 * (hi - lo)
-         + rng.uniform(0, 60.0, (300, 2))).astype(np.float32)
-# spread batch: points over the whole metro -> some chunk exceeds the cap
-spread = rng.uniform(lo, hi, (300, 2)).astype(np.float32)
+    import reporter_tpu.ops.dense_candidates as dc
 
-for name, pts in (("tight", tight), ("spread", spread)):
-    pall = dc.find_candidates_dense(
-        jnp.asarray(pts), (t["seg_pack"], t["seg_bbox"]), 50.0, 8)
-    e, o, d = dc._dense_jnp(jnp.asarray(pts), (t["seg_pack"], None), 50.0, 8)
-    assert (np.asarray(pall.edge) == np.asarray(e)).all(), name
-    assert np.allclose(np.asarray(pall.dist), np.asarray(d),
-                       rtol=1e-5, atol=1e-2), name
-print("NARROW_GRID_OK")
-"""
-    env = dict(os.environ)
-    env["RTPU_PALLAS_INTERPRET"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    proc = subprocess.run([sys.executable, "-c", script], env=env,
-                          capture_output=True, text=True, timeout=600,
-                          cwd=os.path.dirname(os.path.dirname(
-                              os.path.abspath(__file__))))
-    assert "NARROW_GRID_OK" in proc.stdout, proc.stderr[-2000:]
+    monkeypatch.setattr(dc, "_INTERPRET", True)
+    monkeypatch.setattr(dc, "_SBLK", 128)
+    monkeypatch.setattr(dc, "_SUB", 64)
+
+    sp = build_seg_pack(ts.seg_a, ts.seg_b, ts.seg_edge, ts.seg_off,
+                        ts.seg_len, block=128)
+    assert sp.bbox.shape[0] >= 2 and sp.sub.shape[1] == 8
+    packs = (jnp.asarray(sp.pack), jnp.asarray(sp.bbox),
+             jnp.asarray(sp.sub))
+
+    rng = np.random.default_rng(7)
+    lo = ts.node_xy.min(0)
+    hi = ts.node_xy.max(0)
+    N = 96                       # ONE shape: jnp reference compiles once
+
+    def pad(p):
+        p = np.asarray(p, np.float32)
+        return np.tile(p, (-(-N // len(p)), 1))[:N]
+
+    local = pad(np.concatenate([      # corner cluster + exact node ties
+        lo + rng.uniform(0, 40.0, (64, 2)).astype(np.float32),
+        ts.node_xy[:32].astype(np.float32)]))
+    mid = ((ts.seg_a + ts.seg_b) * 0.5)[:48]
+    ang = rng.uniform(0, 2 * np.pi, len(mid))
+    r_off = rng.uniform(48.0, 52.0, len(mid))[:, None]
+    spread = pad(np.concatenate([     # tile-wide + boundary + node ties
+        rng.uniform(lo - 30, hi + 30, (32, 2)),
+        ts.node_xy[:16],
+        mid + np.stack([np.cos(ang), np.sin(ang)], 1) * r_off]))
+
+    refs = {}
+
+    def check(pts, name, cap, **kw):
+        monkeypatch.setattr(dc, "_NJ_CAP", cap)
+        pj = jnp.asarray(pts)
+        if name not in refs:
+            refs[name] = dc._dense_jnp(pj, (packs[0], None), 50.0, 8)
+        e, o, d = refs[name]
+        c = dc.find_candidates_dense(pj, packs, 50.0, 8, **kw)
+        tag = (name, cap, kw)
+        assert (np.asarray(c.edge) == np.asarray(e)).all(), tag
+        assert np.allclose(np.asarray(c.dist), np.asarray(d),
+                           rtol=1e-5, atol=1e-2), tag
+        assert np.allclose(np.asarray(c.offset), np.asarray(o),
+                           rtol=1e-5, atol=1e-2), tag
+
+    check(local, "local", cap=1)                    # narrow executes
+    check(spread, "spread", cap=1)                  # fallback executes
+    check(spread, "spread", cap=8, lowp="bf16")     # no cond: one trace
+    check(spread, "spread", cap=1, subcull=False)   # r7 whole-block arm
+
+    # documented 2-tuple fallback: a pack WITHOUT sub quads silently
+    # runs the whole-block kernel even with subcull requested (pre-r8
+    # packs / external callers) — no cond (cap high): one trace
+    monkeypatch.setattr(dc, "_NJ_CAP", 8)
+    c = dc.find_candidates_dense(jnp.asarray(spread), packs[:2], 50.0, 8)
+    e, o, d = refs["spread"]
+    assert (np.asarray(c.edge) == np.asarray(e)).all()
+    assert np.allclose(np.asarray(c.dist), np.asarray(d),
+                       rtol=1e-5, atol=1e-2)

@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples")
 
@@ -37,7 +39,12 @@ def test_streaming_demo_runs():
     assert "replay" in out.lower() or "restore" in out.lower(), out
 
 
+@pytest.mark.slow
 def test_multichip_demo_runs():
+    # slow: with the shard_map compat shim (parallel/compat.py) this demo
+    # runs green on old-jax CPU boxes again, but the 8-device mesh
+    # product-path compile costs ~a minute in a subprocess — outside the
+    # tier-1 truncating budget (see tests/test_parallel.py docstring)
     out = _run("multichip.py")
     assert "bit-identical to single-device: True" in out
     assert "MetroRouter over submeshes" in out

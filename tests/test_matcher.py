@@ -527,3 +527,48 @@ class TestAccuracy:
             best = mj.match_topk(dragged, exact=exact)[0][1]
             want = mj.match_topk(clean, exact=exact)[0][1]
             assert route(best, k) == route(want, k), exact
+
+
+class TestSweepEnvOverrides:
+    """RTPU_SWEEP_* env levers (round 8): strict parsing, the
+    bf16-requires-subcull invariant, and the SegmentMatcher mirror of
+    the applied override back into self.config — the A/B-capture
+    attributability contract (a typo'd lever must RAISE, never silently
+    measure an arm against itself)."""
+
+    def test_parsing_and_combo_validation(self, monkeypatch):
+        from reporter_tpu.config import MatcherParams
+
+        monkeypatch.setenv("RTPU_SWEEP_SUBCULL", "off")
+        assert MatcherParams().with_env_overrides().sweep_subcull is False
+        monkeypatch.setenv("RTPU_SWEEP_SUBCULL", "1")
+        assert MatcherParams().with_env_overrides().sweep_subcull is True
+        monkeypatch.setenv("RTPU_SWEEP_SUBCULL", "maybe")
+        with pytest.raises(ValueError, match="RTPU_SWEEP_SUBCULL"):
+            MatcherParams().with_env_overrides()
+
+        monkeypatch.setenv("RTPU_SWEEP_SUBCULL", "1")
+        monkeypatch.setenv("RTPU_SWEEP_LOWP", "bf16")
+        assert MatcherParams().with_env_overrides().sweep_lowp == "bf16"
+        monkeypatch.setenv("RTPU_SWEEP_LOWP", "bf-16")
+        with pytest.raises(ValueError, match="RTPU_SWEEP_LOWP"):
+            MatcherParams().with_env_overrides()
+        # the whole-block kernel has no low-precision pass: the combo
+        # must raise instead of silently running plain f32
+        monkeypatch.setenv("RTPU_SWEEP_SUBCULL", "0")
+        monkeypatch.setenv("RTPU_SWEEP_LOWP", "bf16")
+        with pytest.raises(ValueError, match="sweep_subcull"):
+            MatcherParams().with_env_overrides()
+        with pytest.raises(ValueError, match="sweep_subcull"):
+            Config(matcher=MatcherParams(sweep_lowp="bf16",
+                                         sweep_subcull=False)).validate()
+
+    def test_matcher_mirrors_override_into_config(self, tiny_tiles,
+                                                  monkeypatch):
+        monkeypatch.setenv("RTPU_SWEEP_SUBCULL", "0")
+        m = SegmentMatcher(tiny_tiles, Config(matcher_backend="jax"))
+        assert m.params.sweep_subcull is False
+        assert m.config.matcher.sweep_subcull is False   # no stale view
+        monkeypatch.delenv("RTPU_SWEEP_SUBCULL")
+        m2 = SegmentMatcher(tiny_tiles, Config(matcher_backend="jax"))
+        assert m2.params.sweep_subcull is True

@@ -1,5 +1,14 @@
 """Sharding tests on the virtual 8-device CPU mesh (SURVEY.md §4:
-"multi-device tests without a cluster")."""
+"multi-device tests without a cluster").
+
+The shard_map-dependent suites are marked ``slow``: on old-jax boxes the
+compat shim (parallel/compat.py) makes them RUN again, but a full mesh
+product-path compile on a one-core CPU host costs ~most of a minute,
+and the tier-1 budget (ROADMAP.md: 870 s, truncating) cannot absorb
+that without pushing later test files off the end — measured round 6:
+letting these pass inside tier-1 cost ~60 dots of tail coverage. Run
+them explicitly (``pytest tests/test_parallel.py``) or let the
+multichip dry-run (``__graft_entry__.py 8``) exercise the same path."""
 
 import numpy as np
 import jax
@@ -55,6 +64,7 @@ class TestMesh:
             make_mesh(tile=3)
 
 
+@pytest.mark.slow
 class TestDataParallel:
     def test_matches_single_device(self, metro_a):
         ts = metro_a
@@ -76,6 +86,7 @@ class TestDataParallel:
         assert len(got.edge.sharding.device_set) == 8
 
 
+@pytest.mark.slow
 class TestMultiMetro:
     def test_per_metro_outputs_match_single(self, metro_a, metro_b):
         stacked = stack_tilesets([metro_a, metro_b])
@@ -186,6 +197,7 @@ class TestDispatch:
                             dp=1, bucket=8)
 
 
+@pytest.mark.slow
 class TestShardedCandidates:
     """Segment-table sharding (the TP analog): results must be
     bit-identical to the unsharded dense matcher, including at exact
@@ -228,6 +240,7 @@ class TestShardedCandidates:
                                    np.asarray(out_u.offset), atol=1e-4)
 
 
+@pytest.mark.slow
 class TestDenseBackendSharded:
     """The TPU-shaped path (dense sweep under shard_map) must stay green:
     'auto' resolves to grid on the CPU test mesh, so pin dense explicitly."""
@@ -418,6 +431,7 @@ print(f"TWOPROC-OK-{pid}", flush=True)
             assert f"TWOPROC-OK-{pid}" in out, (out, err[-2000:])
 
 
+@pytest.mark.slow
 class TestDpE2EProductPath:
     """The mesh-aware PRODUCT path (parallel/dp_e2e): SegmentMatcher /
     ReporterApp constructed with a mesh must produce byte-identical
@@ -479,6 +493,7 @@ class TestDpE2EProductPath:
         assert pub1 == pub8
 
 
+@pytest.mark.slow
 class TestMeshedMetroRouter:
     """BASELINE config 4's product shape: metros routed host-side (EP),
     each metro's matcher dp-sharded over its OWN device submesh, behind
@@ -527,3 +542,36 @@ class TestMeshedMetroRouter:
             make_router([tiny_tiles],
                         meshes={"nope": make_mesh(tile=1, dp=2,
                                                   devices=jax.devices()[:2])})
+
+
+class TestShardMapCompat:
+    """parallel/compat.py: the one shard_map import every mesh module
+    shares. Fast (no mesh compile) — stays in the tier-1 pass even
+    though the product-path suites above are slow-marked."""
+
+    def test_resolves_and_runs_psum(self):
+        from jax.sharding import PartitionSpec as P
+
+        from reporter_tpu.parallel.compat import shard_map
+        from reporter_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(tile=1, dp=8)
+        f = shard_map(lambda x: jax.lax.psum(x, ("tile", "dp")), mesh=mesh,
+                      in_specs=P(("tile", "dp")), out_specs=P())
+        out = f(jnp.ones((8, 4), jnp.float32))
+        assert float(np.asarray(out).sum()) == 8 * 4
+
+    def test_check_vma_kwarg_accepted(self):
+        """check_vma must be accepted on BOTH jax generations (old jax
+        spells it check_rep — the shim translates)."""
+        from jax.sharding import PartitionSpec as P
+
+        from reporter_tpu.parallel.compat import shard_map
+        from reporter_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(tile=1, dp=8)
+        f = shard_map(lambda x: x * 2.0, mesh=mesh,
+                      in_specs=P(("tile", "dp")), out_specs=P(("tile", "dp")),
+                      check_vma=False)
+        np.testing.assert_array_equal(
+            np.asarray(f(jnp.ones((8, 2), jnp.float32))), 2.0)
