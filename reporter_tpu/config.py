@@ -58,6 +58,29 @@ class MatcherParams:
                                    # submit-all-then-harvest overlaps device
                                    # compute with result transfers (measured
                                    # optimum on a remote-attached v5e)
+    dispatch_timeout_s: float = 0.0  # device-dispatch watchdog: the axon
+                                   # tunnel dies by HANGING, not erroring
+                                   # (CLAUDE.md), so a wedged dispatch must
+                                   # be timed out, not caught. 0 = off (the
+                                   # default: zero overhead, zero behavior
+                                   # change). On timeout the dispatch raises
+                                   # DispatchTimeout (matcher/api.py) —
+                                   # streaming releases the wave's held rows
+                                   # for retry, the scheduler retries per
+                                   # submission. Set it ABOVE the worst-case
+                                   # cold jit compile for your shapes (or
+                                   # warm up first): the watchdog cannot
+                                   # tell a compiling dispatch from a hung
+                                   # one, and a too-tight timeout churns
+                                   # retries until the cache warms.
+    dispatch_fallback: str = "retry"  # what a timed-out dispatch degrades
+                                   # to: "retry" = raise and let the caller
+                                   # re-flush (bit-identical when the link
+                                   # recovers); "reference_cpu" = serve the
+                                   # batch from the in-process exact-
+                                   # Dijkstra oracle (slow, link-free) —
+                                   # graceful degradation when the tunnel
+                                   # is gone for good
 
     def replace(self, **kw: Any) -> "MatcherParams":
         return dataclasses.replace(self, **kw)
@@ -91,6 +114,19 @@ class MatcherParams:
                 raise ValueError(
                     f"RTPU_SWEEP_LOWP={lowp!r}: use 'off' or 'bf16'")
             kw["sweep_lowp"] = lowp
+        if "RTPU_DISPATCH_TIMEOUT_S" in e:
+            t = float(e["RTPU_DISPATCH_TIMEOUT_S"])
+            if t < 0:
+                raise ValueError(
+                    f"RTPU_DISPATCH_TIMEOUT_S={t}: must be >= 0")
+            kw["dispatch_timeout_s"] = t
+        if "RTPU_DISPATCH_FALLBACK" in e:
+            fb = e["RTPU_DISPATCH_FALLBACK"] or "retry"
+            if fb not in ("retry", "reference_cpu"):
+                raise ValueError(
+                    f"RTPU_DISPATCH_FALLBACK={fb!r}: use 'retry' or "
+                    "'reference_cpu'")
+            kw["dispatch_fallback"] = fb
         out = dataclasses.replace(self, **kw) if kw else self
         if out.sweep_lowp == "bf16" and not out.sweep_subcull:
             # only the two-level kernel implements the low-precision
@@ -179,6 +215,27 @@ class ServiceConfig:
     admission_queue_limit: int = 8192  # queued traces admitted before the
     #                                    service sheds with 503 (bounded
     #                                    memory; counted rejections)
+    # Publisher resilience (service/datastore.py). Defaults keep the
+    # pre-chaos behavior exactly (one attempt, failures counted+dropped):
+    # retries/dead-letter are DEPLOYMENT policy, opted into per worker.
+    publish_retries: int = 0       # extra POST attempts per batch after
+    #                                the first fails (bounded exponential
+    #                                backoff with deterministic jitter —
+    #                                faults.backoff_schedule)
+    publish_backoff_ms: float = 50.0    # backoff base (doubles per retry)
+    publish_backoff_cap_ms: float = 2000.0  # backoff ceiling
+    publish_backoff_jitter: float = 0.1     # +[0, jitter)x seeded jitter
+    dead_letter_dir: str = ""      # non-empty ⇒ batches that exhaust their
+    #                                retries are SPOOLED to disk and
+    #                                replayed automatically after the next
+    #                                successful POST — an outage sheds to
+    #                                disk, not to /dev/null. ONE DIR PER
+    #                                WORKER PROCESS (like --checkpoint):
+    #                                the spool file carries no inter-
+    #                                process locking, and two workers
+    #                                sharing it would corrupt each
+    #                                other's torn-tail truncation and
+    #                                prefix rewrites
 
     def with_env_overrides(self, env: dict[str, str] | None = None) -> "ServiceConfig":
         """Apply env vars on top of this config; only set variables override."""
@@ -200,6 +257,12 @@ class ServiceConfig:
             kw["batch_close_ms"] = float(e["REPORTER_BATCH_CLOSE_MS"])
         if "REPORTER_MAX_INFLIGHT" in e:
             kw["max_inflight_batches"] = int(e["REPORTER_MAX_INFLIGHT"])
+        if "DATASTORE_RETRIES" in e:
+            kw["publish_retries"] = int(e["DATASTORE_RETRIES"])
+        if "DATASTORE_BACKOFF_MS" in e:
+            kw["publish_backoff_ms"] = float(e["DATASTORE_BACKOFF_MS"])
+        if "DATASTORE_DEAD_LETTER_DIR" in e:
+            kw["dead_letter_dir"] = e["DATASTORE_DEAD_LETTER_DIR"]
         return dataclasses.replace(self, **kw) if kw else self
 
     @classmethod
@@ -306,6 +369,20 @@ class Config:
                              "service.max_inflight_batches must be >= 1")
         if svc.admission_queue_limit < 1:
             raise ValueError("service.admission_queue_limit must be >= 1")
+        if svc.publish_retries < 0:
+            raise ValueError("service.publish_retries must be >= 0")
+        if svc.publish_backoff_ms <= 0 or svc.publish_backoff_cap_ms <= 0:
+            raise ValueError("service.publish_backoff_ms and "
+                             "publish_backoff_cap_ms must be > 0")
+        if svc.publish_backoff_jitter < 0:
+            raise ValueError("service.publish_backoff_jitter must be >= 0")
+        if self.matcher.dispatch_timeout_s < 0:
+            raise ValueError("matcher.dispatch_timeout_s must be >= 0")
+        if self.matcher.dispatch_fallback not in ("retry", "reference_cpu"):
+            raise ValueError(
+                f"unknown matcher.dispatch_fallback "
+                f"{self.matcher.dispatch_fallback!r}; use 'retry' or "
+                "'reference_cpu'")
         s = self.streaming
         if s.num_partitions < 1 or s.poll_max_records < 1 or s.flush_min_points < 1:
             raise ValueError(

@@ -16,12 +16,14 @@ batch.
 
 from __future__ import annotations
 
+import threading
 from collections.abc import Sequence as _SequenceABC
 from dataclasses import dataclass
 from typing import Any, Sequence
 
 import numpy as np
 
+from reporter_tpu import faults
 from reporter_tpu.config import Config, MatcherParams
 from reporter_tpu.geometry import lonlat_to_xy
 from reporter_tpu.matcher import cpu_reference
@@ -35,6 +37,16 @@ from reporter_tpu.tiles.tileset import TileSet
 from reporter_tpu.utils.metrics import MetricsRegistry
 
 _BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
+
+
+class DispatchTimeout(RuntimeError):
+    """A device dispatch exceeded ``matcher.dispatch_timeout_s``.
+
+    The remote-attached tunnel dies by HANGING, never by erroring
+    (CLAUDE.md) — so this is raised by a watchdog, not caught from jax.
+    Callers treat it as retryable: the streaming pipeline releases the
+    wave's held rows for a later re-flush (columnar._harvest), the batch
+    scheduler retries per submission, and the WSGI face maps it to 503."""
 
 
 @dataclass
@@ -219,6 +231,26 @@ class SegmentMatcher:
         self.metrics = metrics or MetricsRegistry()
         backend = self.config.matcher_backend
         self._native_walker = None
+        # dispatch-watchdog degradation state (jax backend): the fallback
+        # oracle matcher is built lazily on the FIRST timeout — a healthy
+        # deployment never pays for it
+        self._fallback: "SegmentMatcher | None" = None
+        # TWO locks on purpose: _fallback_lock serializes the oracle
+        # (DijkstraCache is not thread-safe) and is held for a whole —
+        # slow — fallback match; _watchdog_lock guards only the breaker
+        # bookkeeping below and is held for nanoseconds. One lock for
+        # both would let a single in-progress oracle batch block every
+        # concurrent healthy dispatch at its breaker check until it
+        # spuriously timed out too.
+        self._fallback_lock = threading.Lock()
+        self._watchdog_lock = threading.Lock()
+        # circuit breaker: count of watchdog threads abandoned and still
+        # stuck inside a dispatch. Each pins its wave's traces until the
+        # wedge clears, so the count must be BOUNDED — past the cap the
+        # matcher degrades immediately instead of feeding more threads
+        # (and more memory) to a dead link.
+        self._abandoned_dispatches = 0
+        self._abandoned_cap = 4
         if mesh is not None and backend != "jax":
             raise ValueError("mesh sharding requires matcher_backend='jax'")
         if backend == "jax":
@@ -228,6 +260,13 @@ class SegmentMatcher:
             self._wire_spec = wire_spec(
                 tileset.num_edges,
                 float(tileset.edge_len.max()) if tileset.num_edges else 0.0)
+            # params is a jit STATIC: the host-only watchdog knobs must
+            # not reach the wire entries, or two deployments differing
+            # only in dispatch_timeout_s would compile disjoint
+            # executable populations (and the first faulted retry would
+            # stall on a pointless recompile)
+            wire_params = params.replace(dispatch_timeout_s=0.0,
+                                         dispatch_fallback="retry")
             if mesh is None:
                 # stage only the layout the resolved candidate backend
                 # sweeps (the unused one is the largest table at metro
@@ -235,10 +274,10 @@ class SegmentMatcher:
                 self._tables = tileset.device_tables(
                     self.params.candidate_backend)
                 self._wire = _LocalWire(self._tables, self.ts.meta,
-                                        self.params, self._wire_spec)
+                                        wire_params, self._wire_spec)
             else:
                 from reporter_tpu.parallel.dp_e2e import DpWireMatcher
-                self._wire = DpWireMatcher(mesh, tileset, self.params,
+                self._wire = DpWireMatcher(mesh, tileset, wire_params,
                                            self._wire_spec)
                 self._tables = self._wire.tables    # mesh-replicated
             self._route_fn = reach_route_fn(tileset)
@@ -288,10 +327,114 @@ class SegmentMatcher:
             if self.backend == "reference_cpu":
                 out = [self._match_cpu(t) for t in traces]
             else:
-                out = self._match_jax_many(traces)
+                out = self._guarded_jax_many(traces)
         self.metrics.count("traces", len(traces))
         self.metrics.count("probes", sum(len(t.xy) for t in traces))
         return out
+
+    def _guarded_jax_many(self, traces: Sequence[Trace]):
+        """Device dispatch under the watchdog (dispatch_timeout_s > 0).
+
+        The watchdog runs the dispatch on a fresh daemon thread and
+        bounds the wait: the axon tunnel's failure mode is an infinite
+        stall inside a host transfer, which no try/except can catch. On
+        timeout the stuck thread is ABANDONED (daemon — it can never
+        block exit) and the call degrades per ``dispatch_fallback``:
+
+          "retry"          raise DispatchTimeout — the caller re-flushes
+                           (streaming held-row release / scheduler
+                           per-submission retry); bit-identical when the
+                           link recovers, because retried waves re-run
+                           the same wire program on the same rows;
+          "reference_cpu"  serve THIS batch from the in-process exact-
+                           Dijkstra oracle — slow, but link-free.
+
+        The ``dispatch`` fault site fires here (inside the guarded body)
+        so an injected hang stalls exactly where a dead tunnel would."""
+        timeout = float(self.params.dispatch_timeout_s)
+        if timeout <= 0:
+            faults.fire("dispatch")
+            return self._match_jax_many(traces)
+        with self._watchdog_lock:
+            tripped = self._abandoned_dispatches >= self._abandoned_cap
+        if tripped:
+            # circuit open: enough abandoned dispatches are already stuck
+            # on the dead link — degrade IMMEDIATELY rather than pin yet
+            # another thread + trace batch (a permanently hung tunnel
+            # must cost bounded memory, not one thread per retry).
+            # Counted as a timeout TOO: /stats' dispatch_timeout must
+            # keep moving while the breaker is open, or an operator
+            # reads "timeouts stopped" at exactly the worst moment.
+            self.metrics.count("dispatch_breaker_open")
+            self.metrics.count("dispatch_timeout")
+            return self._degrade(traces, timeout)
+        box: dict = {}
+        done = threading.Event()
+        state = {"abandoned": False, "finished": False}
+
+        def _run():
+            try:
+                faults.fire("dispatch")     # injected stall lands HERE
+                with self._watchdog_lock:
+                    gave_up = state["abandoned"]
+                if gave_up:
+                    return    # the watchdog gave up while we stalled: a
+                    #           zombie dispatch must not race the retry
+                box["out"] = self._match_jax_many(traces)
+            except BaseException as exc:    # noqa: BLE001 — relayed below
+                box["exc"] = exc
+            finally:
+                with self._watchdog_lock:
+                    state["finished"] = True
+                    if state["abandoned"]:      # wedge cleared: un-count
+                        self._abandoned_dispatches -= 1
+                done.set()
+
+        threading.Thread(target=_run, daemon=True,
+                         name="dispatch-watchdog").start()
+        finished = done.wait(timeout)
+        if not finished:
+            with self._watchdog_lock:
+                if not state["finished"]:       # really stuck: abandon it
+                    state["abandoned"] = True
+                    self._abandoned_dispatches += 1
+                else:
+                    finished = True   # landed in the timeout race window
+        if finished:
+            if "exc" in box:
+                raise box["exc"]
+            return box["out"]
+        self.metrics.count("dispatch_timeout")
+        return self._degrade(traces, timeout)
+
+    def _degrade(self, traces: Sequence[Trace], timeout: float):
+        """What a bounded dispatch becomes: the oracle (link-free) under
+        dispatch_fallback='reference_cpu', else a retryable
+        DispatchTimeout for the caller's held-row/isolation machinery."""
+        if self.params.dispatch_fallback == "reference_cpu":
+            self.metrics.count("dispatch_fallback")
+            fb = self._fallback_matcher()
+            with self._fallback_lock:   # DijkstraCache isn't thread-safe
+                return fb.match_many(traces)
+        raise DispatchTimeout(
+            f"device dispatch exceeded {timeout:.3f}s "
+            f"({len(traces)} traces); wave released for retry")
+
+    def _fallback_matcher(self) -> "SegmentMatcher":
+        """The degradation target: an exact-Dijkstra oracle matcher over
+        the same tileset/params, built on first use. Its own metrics
+        registry (the outer call already counts traces/probes); callers
+        serialize on ``self._fallback_lock`` — the shared DijkstraCache
+        is not thread-safe and the scheduler's workers dispatch
+        concurrently."""
+        import dataclasses as _dc
+
+        with self._fallback_lock:
+            if self._fallback is None:
+                self._fallback = SegmentMatcher(
+                    self.ts, _dc.replace(self.config,
+                                         matcher_backend="reference_cpu"))
+        return self._fallback
 
     def matched_points(self, trace: Trace) -> list[MatchedPoint]:
         """Per-point decode (no segment association) — test/diagnostic hook."""

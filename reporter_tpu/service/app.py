@@ -27,7 +27,7 @@ import time
 from typing import Any, Callable, Iterable
 
 from reporter_tpu.config import Config
-from reporter_tpu.matcher.api import SegmentMatcher, Trace
+from reporter_tpu.matcher.api import DispatchTimeout, SegmentMatcher, Trace
 from reporter_tpu.service.cache import PartialTraceCache
 from reporter_tpu.service.datastore import DatastorePublisher, Transport
 from reporter_tpu.service.scheduler import BatchScheduler, ServiceOverloaded
@@ -129,9 +129,10 @@ class ReporterApp:
         self.matcher = SegmentMatcher(tileset, self.config, mesh=mesh)
         self.cache = PartialTraceCache(ttl=svc.cache_ttl,
                                        max_uuids=svc.cache_max_uuids)
-        self.publisher = DatastorePublisher(url=svc.datastore_url,
-                                            mode=svc.mode,
-                                            transport=transport)
+        from reporter_tpu.service.datastore import publisher_kwargs
+        self.publisher = DatastorePublisher(
+            transport=transport,
+            **publisher_kwargs(svc, metrics=self.matcher.metrics))
         self.min_segment_length = svc.min_segment_length
         self._lock = threading.Lock()     # combine mode: one batch in flight
         self._pending: list[_Submission] = []
@@ -315,6 +316,9 @@ class ReporterApp:
             "cached_uuids": len(self.cache),
             "published": self.publisher.published,
             "dropped": self.publisher.dropped,
+            "publish_retried": self.publisher.retried,
+            "dead_lettered": self.publisher.dead_lettered,
+            "dead_letter_pending": self.publisher.dead_letter_pending,
             **stats,
         }
         if self.scheduler is not None:
@@ -377,6 +381,12 @@ class ReporterApp:
         except ServiceOverloaded as exc:
             # bounded admission queue full (or draining): shed explicitly
             # with a retryable status instead of queueing without bound
+            self._bump("errors")
+            return _respond(start_response, 503, {"error": str(exc)})
+        except DispatchTimeout as exc:
+            # the device link wedged past the watchdog (and any
+            # per-submission retry): retryable server-side condition, not
+            # a client error and not an opaque 500
             self._bump("errors")
             return _respond(start_response, 503, {"error": str(exc)})
         except Exception:                                 # pragma: no cover

@@ -359,6 +359,13 @@ class BatchScheduler:
                 "inflight_hist": dict(self.inflight_hist),
                 "padding_by_bucket": dict(self.padding_by_bucket),
                 "draining": closed,
+                # watchdog visibility (matcher/api.py counts these): how
+                # many dispatches the timeout bounded and how many were
+                # served by the reference_cpu degradation path
+                "dispatch_timeouts": int(
+                    self.metrics.value("dispatch_timeout")),
+                "dispatch_fallbacks": int(
+                    self.metrics.value("dispatch_fallback")),
                 **self.stats,
             }
 

@@ -48,6 +48,11 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--max-steps", type=int, default=None,
                     help="stop after N steps (tests/drains); default: run "
                          "until signalled")
+    ap.add_argument("--exit-on-drain", action="store_true",
+                    help="exit once the owned partitions' lag reaches 0 "
+                         "(the pre-staged-broker shape: bench recovery / "
+                         "consumer-group legs drive subprocess workers "
+                         "this way)")
     ap.add_argument("--stdin-format", default=None,
                     help="also read raw payloads from stdin, normalized "
                          "via ProbeFormatter ('auto'|'json'|'csv')")
@@ -61,6 +66,18 @@ def main(argv: "list[str] | None" = None) -> int:
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # honor a parent's CPU pin: the image's sitecustomize re-pins the
+        # axon platform at interpreter start, so the env var alone is not
+        # enough (CLAUDE.md) — bench chaos legs spawn CPU workers this way
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from reporter_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()   # restarts are the POINT of the recovery
+    #                              story: a restarted worker must reload,
+    #                              not recompile, its wire programs
 
     from reporter_tpu.config import Config
     from reporter_tpu.streaming.durable_queue import DurableIngestQueue
@@ -126,6 +143,7 @@ def main(argv: "list[str] | None" = None) -> int:
 
     reports = steps = 0
     last_ckpt = time.monotonic()
+    stall, prev_lag = 0, None
     try:
         while not stop["now"]:
             reports += pipe.step()
@@ -136,11 +154,35 @@ def main(argv: "list[str] | None" = None) -> int:
                 last_ckpt = time.monotonic()
             if args.max_steps is not None and steps >= args.max_steps:
                 break
-            if pipe.stats()["lag"] == 0:
+            st = pipe.stats()
+            if args.exit_on_drain:
+                # drained = lag 0, OR lag pinned by a sub-threshold
+                # buffered tail with nothing in flight (the commit floor
+                # sits below buffered rows by design; the finally-drain
+                # below flushes them) — same no-progress rule as the
+                # bench pump loops
+                if st["lag"] == 0:
+                    break
+                if (st["lag"] == prev_lag
+                        and st.get("inflight_waves", 0) == 0
+                        and st.get("publish_pending", 0) == 0):
+                    stall += 1
+                    if stall >= 3:
+                        break
+                else:
+                    stall = 0
+                prev_lag = st["lag"]
+            elif st["lag"] == 0:
                 time.sleep(args.poll_interval)
     finally:
         reports += pipe.drain()
         pipe.flush_histograms()
+        if getattr(pipe.publisher, "dead_letter_pending", 0):
+            # an outage that covered the LAST wave leaves batches spooled
+            # with no later success to auto-replay them — try once at
+            # shutdown (fails fast if the datastore is still dark; the
+            # spool survives for the next run to inherit)
+            pipe.publisher.replay_dead_letters()
         if args.checkpoint:
             pipe.checkpoint(args.checkpoint)
         close = getattr(pipe, "close", None)
@@ -148,10 +190,13 @@ def main(argv: "list[str] | None" = None) -> int:
             close()                 # + publisher threads
         queue.close()
     print(json.dumps({"steps": steps, "reports": reports,
+                      "committed": list(pipe.committed),
                       **{k: v for k, v in pipe.stats().items()
                          if k in ("lag", "published", "malformed",
                                   "hist_rows", "qhist_rows",
-                                  "buffered_points")}}))
+                                  "buffered_points", "publish_retried",
+                                  "dead_lettered", "dead_letter_pending",
+                                  "dispatch_timeouts")}}))
     return 0
 
 

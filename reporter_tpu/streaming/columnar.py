@@ -38,7 +38,8 @@ import numpy as np
 
 from reporter_tpu.config import Config
 from reporter_tpu.geometry import lonlat_to_xy
-from reporter_tpu.matcher.api import MatchBatch, SegmentMatcher, Trace
+from reporter_tpu.matcher.api import (DispatchTimeout, MatchBatch,
+                                      SegmentMatcher, Trace)
 from reporter_tpu.service.datastore import DatastorePublisher, Transport
 from reporter_tpu.streaming.histogram import SpeedHistogram
 from reporter_tpu.streaming.queue import partition_of
@@ -676,15 +677,15 @@ class ColumnarStreamPipeline:
         self.cache = ColumnarTraceCache(ttl=svc.cache_ttl,
                                         max_uuids=svc.cache_max_uuids)
         self._depth = int(sc.pipeline_depth)
+        from reporter_tpu.service.datastore import publisher_kwargs
+        pub_kw = publisher_kwargs(svc, metrics=self.matcher.metrics)
         if self._depth > 0:
             from reporter_tpu.service.datastore import AsyncDatastorePublisher
-            self.publisher = AsyncDatastorePublisher(url=svc.datastore_url,
-                                                     mode=svc.mode,
-                                                     transport=transport)
+            self.publisher = AsyncDatastorePublisher(transport=transport,
+                                                     **pub_kw)
         else:
-            self.publisher = DatastorePublisher(url=svc.datastore_url,
-                                                mode=svc.mode,
-                                                transport=transport)
+            self.publisher = DatastorePublisher(transport=transport,
+                                                **pub_kw)
         self.min_segment_length = svc.min_segment_length
         self.clock = clock
         self.committed = [0] * sc.num_partitions
@@ -704,6 +705,9 @@ class ColumnarStreamPipeline:
         self._prev_lag = 0
         self._last_flush_p50: "float | None" = None
         self.overrun = 0          # records lost to broker drop-oldest shed
+        self.dispatch_timeouts = 0   # waves released by the watchdog
+        self.waves_completed = 0     # waves fully processed (progress
+        #                              signal for the drain stall guard)
 
         # uuid interning + per-code buffer state
         self._code_of: dict[str, int] = {}
@@ -766,16 +770,34 @@ class ColumnarStreamPipeline:
         sc = self.config.streaming
         n = self._harvest(block=True)
         self._poll_all(sc.poll_max_records)
+        stalls = 0
         while True:
             ripe = np.nonzero(self._count > 0)[0]
             if not len(ripe):
                 break
+            before_to = self.dispatch_timeouts
+            before_wc = self.waves_completed
             if self._depth == 0:
                 n += self._flush(ripe)
             else:
                 if not self._submit_wave(ripe):
                     break
                 n += self._harvest(block=True)
+            if (self.dispatch_timeouts > before_to
+                    and self.waves_completed == before_wc):
+                # a live loop retries timed-out waves forever; a DRAIN
+                # must not — three consecutive rounds with a watchdog
+                # trip and ZERO completed waves means the link is gone,
+                # and the shutdown path should say so instead of
+                # spinning. (A trip alongside completed waves is a
+                # flapping link making progress: keep draining.)
+                stalls += 1
+                if stalls >= 3:
+                    raise DispatchTimeout(
+                        "drain stalled: device dispatch timed out with "
+                        f"no completed waves {stalls} rounds running")
+            else:
+                stalls = 0
         self.publisher.drain()
         self._commit()
         now = self.clock()
@@ -1022,6 +1044,17 @@ class ColumnarStreamPipeline:
             try:
                 result, match_dt = wave.future.result()
                 n += self._complete_wave(wave, result, match_dt)
+            except DispatchTimeout:
+                # graceful degradation, not death: the watchdog bounded a
+                # wedged device dispatch (the tunnel hangs, it doesn't
+                # error). Release the wave's held rows — the next step
+                # re-selects and re-flushes them (the held-row contract;
+                # bit-identical on a recovered link) — count it, and keep
+                # the loop alive.
+                self._release_failed(wave)
+                self.dispatch_timeouts += 1
+                self.matcher.metrics.gauge("stream_dispatch_timeouts",
+                                           self.dispatch_timeouts)
             except BaseException:
                 # matcher OR result-processing failure: either way the
                 # rows must go back in play, not leak held forever (a
@@ -1057,7 +1090,10 @@ class ColumnarStreamPipeline:
 
     def _flush(self, ripe_codes: np.ndarray) -> int:
         """Sequential flush (pipeline_depth=0): match, report, publish in
-        line — one wave, fully processed before returning."""
+        line — one wave, fully processed before returning. A watchdog
+        timeout degrades exactly like the pipelined path's (_harvest):
+        rows released for the next step's retry, counted, loop alive —
+        NOT a raise that would kill the sequential worker loop."""
         prep = self._prepare_wave(ripe_codes)
         if prep is None:
             return 0
@@ -1065,6 +1101,12 @@ class ColumnarStreamPipeline:
         try:
             result, match_dt = self._timed_match(traces)
             return self._complete_wave(wave, result, match_dt)
+        except DispatchTimeout:
+            self._release_failed(wave)
+            self.dispatch_timeouts += 1
+            self.matcher.metrics.gauge("stream_dispatch_timeouts",
+                                       self.dispatch_timeouts)
+            return 0
         except BaseException:
             self._release_failed(wave)   # same leak-proofing as _harvest
             raise
@@ -1101,6 +1143,7 @@ class ColumnarStreamPipeline:
         self.last_flush_latency = acc
         self._last_flush_p50 = (float(np.median(lat)) if len(lat) else None)
         L.compact(L.held[:L.n] != wave.id)
+        self.waves_completed += 1
         return n
 
     def _reports_from_columns(self, batch: MatchBatch,
@@ -1227,6 +1270,10 @@ class ColumnarStreamPipeline:
                                    if not w.published),
             "wave_points": int(self._wave_points),
             "overrun": int(self.overrun),
+            "dispatch_timeouts": int(self.dispatch_timeouts),
+            "publish_retried": self.publisher.retried,
+            "dead_lettered": self.publisher.dead_lettered,
+            "dead_letter_pending": self.publisher.dead_letter_pending,
             **self.stats_counters,
         }
         overload = getattr(self.queue, "overload_stats", None)

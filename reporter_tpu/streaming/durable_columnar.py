@@ -130,11 +130,26 @@ class DurableColumnarIngestQueue(ColumnarIngestQueue):
     # ---- ColumnarIngestQueue durability hooks (run under the lock) ------
 
     def _persist_batch(self, p: int, cols: ProbeColumns) -> None:
+        from reporter_tpu import faults
+
         f = self._files[p]
         if f.tell() == 0:                 # fresh file: header frame first
             hdr = json.dumps({"_floor": self._floor[p]}).encode()
             f.write(_LEN.pack(len(hdr)) + hdr)
-        f.write(_encode_batch(cols))
+        frame = _encode_batch(cols)
+        rule = faults.check("broker")
+        if rule is not None and rule.kind == "torn":
+            # injected mid-append death: half a frame reaches disk, then
+            # the "process" dies — the torn-tail reload path must drop
+            # exactly this frame and keep every acked one before it
+            f.write(frame[:len(frame) // 2])
+            f.flush()
+            raise faults.InjectedCrash(
+                f"injected torn append (partition {p})")
+        if rule is not None and rule.kind in ("crash", "fail"):
+            raise faults.InjectedCrash(
+                f"injected broker append crash (partition {p})")
+        f.write(frame)
         f.flush()
         if self._fsync:
             os.fsync(f.fileno())

@@ -10,10 +10,13 @@ them (they are duck-typed over the attribute surface used here)."""
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Iterable, Sequence
 
 import numpy as np
+
+from reporter_tpu import faults
 
 
 def commit_floor(consumed: Sequence[int],
@@ -111,7 +114,14 @@ def save_checkpoint(path: str, committed: list, cache_dump: dict,
 
     Buffers are NOT stored: committed offsets sit at the oldest unflushed
     record, so replaying from them reconstructs every buffer exactly —
-    the buffer is derived state, the log is the truth."""
+    the buffer is derived state, the log is the truth.
+
+    ATOMIC: the snapshot is written to a tmp file, fsync'd, and renamed
+    over the old one — a worker killed mid-checkpoint (the chaos leg
+    SIGKILLs exactly here sometimes) leaves either the old complete
+    snapshot or the new complete snapshot, never a torn npz that a
+    restart would crash parsing. The ``checkpoint`` fault site fires
+    between write and rename: the simulated death the contract covers."""
     state = {
         "committed": committed,
         "cache": cache_dump,
@@ -119,13 +129,21 @@ def save_checkpoint(path: str, committed: list, cache_dump: dict,
     }
     if not path.endswith(".npz"):
         path += ".npz"   # savez appends it; normalize so restore matches
-    np.savez_compressed(
-        path,
-        state=np.frombuffer(json.dumps(state).encode(), dtype=np.uint8),
-        hist=hist_snap,
-        hist_flushed=hist_flushed,
-        qhist=qhist_snap,
-        qhist_flushed=qhist_flushed)
+    tmp = path + ".tmp.npz"        # savez would append .npz to a bare tmp
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f,
+            state=np.frombuffer(json.dumps(state).encode(), dtype=np.uint8),
+            hist=hist_snap,
+            hist_flushed=hist_flushed,
+            qhist=qhist_snap,
+            qhist_flushed=qhist_flushed)
+        f.flush()
+        os.fsync(f.fileno())
+    faults.fire("checkpoint")      # injected mid-checkpoint death: tmp is
+    #                                on disk, the rename never happens —
+    #                                the previous snapshot must survive
+    os.replace(tmp, path)
 
 
 def load_checkpoint(path: str, pl) -> dict:

@@ -121,6 +121,69 @@ def test_summary_line_carries_roofline_era_fields():
     assert line["svc_edge"] == 512
 
 
+def test_coverage_diff_matches_traversals_not_bytes():
+    """detail.recovery's lost/duplicated accounting: a replayed wave may
+    legally shift a report's interpolated t0/t1 by a few samples — the
+    at-least-once bound is coverage of the traversal, and deliveries
+    beyond one per traversal are the counted replay tax."""
+    from collections import Counter
+
+    bench = _load_bench()
+    a = Counter({(7, -1, 10.0, 20.0): 1, (7, -1, 70.0, 80.0): 1,
+                 (9, 7, 15.0, 25.0): 1})
+    # same traversals, one boundary-shifted, one delivered twice, plus a
+    # replay-only extra the reference never saw
+    b = Counter({(7, -1, 12.5, 21.0): 1, (7, -1, 70.0, 80.0): 2,
+                 (9, 7, 15.0, 25.0): 1, (11, -1, 0.0, 5.0): 1})
+    lost, dup = bench._coverage_diff(a, b)
+    assert lost == 0                 # every reference traversal covered
+    assert dup == 2                  # one double delivery + one extra
+    # a genuinely missing traversal counts as lost
+    lost2, _ = bench._coverage_diff(a, Counter({(7, -1, 70.0, 80.0): 1}))
+    assert lost2 == 2
+
+
+def test_summary_line_carries_chaos_fields():
+    """The rec token: [recovery s, duplicated, LOST (must be 0),
+    dead-letter rows pending at outage end (must be 0), 2v1 speedup]."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "recovery": {"recovery_seconds": 12.3,
+                            "duplicated_reports": 456,
+                            "lost_reports": 0},
+               "publish_outage": {"dead_letter_pending_end": 0},
+               "streaming_soak_mp": {"speedup_2v1": 0.91},
+           }}
+    line = bench._summary_line(doc)
+    assert line["rec"] == [12.3, 456, 0, 0, 0.91]
+    # sparse runs degrade to None slots, never KeyError
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["rec"] == [None] * 5
+
+
+def test_recovery_leg_schema_keys():
+    """Pin the detail.recovery keys the docs/README cite — a refactor
+    that drops one erases the capture's recovery story. Checked against
+    the leg's early-return-free result shape (source-level pin: the keys
+    must appear in the function body)."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._recovery_bench)
+    for key in ("recovery_seconds", "duplicated_reports", "lost_reports",
+                "lost_segments", "at_least_once_ok", "reports_at_kill",
+                "committed_at_restart", "broker_probes"):
+        assert f'"{key}"' in src, key
+    src_o = inspect.getsource(bench._publish_outage_soak)
+    for key in ("publish_retried", "dead_lettered", "dead_letter_replayed",
+                "dead_letter_pending_end", "spool_drained",
+                "rss_max_delta_mb"):
+        assert f'"{key}"' in src_o, key
+
+
 def test_service_overload_boundary_rules():
     bench = _load_bench()
 

@@ -87,6 +87,11 @@ def _synthetic_doc():
                        "inflight_ge2_dispatches": 37, "errors": 0},
         "service_overload_boundary": {"clients": 512,
                                       "reason": "p99_blowup"},
+        "recovery": {"recovery_seconds": 123.4,
+                     "duplicated_reports": 123456,
+                     "lost_reports": 0},
+        "publish_outage": {"dead_letter_pending_end": 0},
+        "streaming_soak_mp": {"speedup_2v1": 0.912},
         "total_seconds": 801.5,
     }
     return {"metric": "probes_per_sec_e2e", "value": 2280000.1,

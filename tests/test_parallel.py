@@ -427,6 +427,18 @@ print(f"TWOPROC-OK-{pid}", flush=True)
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env) for pid in range(2)]
         outs = [p.communicate(timeout=180) for p in procs]
+        if any("Multiprocess computations aren't implemented on the CPU "
+               "backend" in err for _, err in outs):
+            # environment capability gap, not a product bug: this box's
+            # jax build refuses cross-process collectives on the CPU
+            # backend (surfaced in r9 once the tier-1 suite stopped
+            # truncating before test_parallel). The 1-process group and
+            # the bootstrap validation tests above still run; skip with
+            # the evidence rather than fail every run here.
+            pytest.skip("installed jax cannot run multiprocess CPU "
+                        "collectives (XlaRuntimeError: Multiprocess "
+                        "computations aren't implemented on the CPU "
+                        "backend)")
         for pid, (out, err) in enumerate(outs):
             assert f"TWOPROC-OK-{pid}" in out, (out, err[-2000:])
 

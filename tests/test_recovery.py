@@ -1,0 +1,481 @@
+"""Chaos-hardening suite (ISSUE 4): deterministic fault injection, the
+publisher's retry/backoff/dead-letter machinery, atomic checkpoints,
+torn-broker recovery, kill→replay at-least-once, and the dispatch
+watchdog's retry + reference_cpu degradation — all on CPU, no TPU, no
+network. The bench's chaos legs drive the same mechanisms at soak scale
+via subprocesses; these tests pin the semantics cheaply in-proc."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from reporter_tpu import faults
+from reporter_tpu.config import (CompilerParams, Config, MatcherParams,
+                                 ServiceConfig, StreamingConfig)
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.service.datastore import DatastorePublisher
+from reporter_tpu.service.reports import Report
+from reporter_tpu.streaming.columnar import (ColumnarIngestQueue,
+                                             ColumnarStreamPipeline,
+                                             pack_records)
+from reporter_tpu.tiles.compiler import compile_network
+
+
+# ---------------------------------------------------------------------------
+# fault plan + backoff schedule (pure host logic)
+
+
+def test_fault_plan_parse_windows_and_counting():
+    p = faults.FaultPlan.parse(
+        "publish:fail@2-4;dispatch:hang(1.5)@0;checkpoint:crash@1;"
+        "broker:torn@3-")
+    assert p.rules["dispatch"][0].seconds == 1.5
+    assert p.rules["broker"][0].hi == float("inf")
+    # publish fires exactly on calls 2 and 3
+    fired = []
+    for i in range(6):
+        try:
+            p.fire("publish")
+            fired.append(False)
+        except faults.InjectedFault:
+            fired.append(True)
+    assert fired == [False, False, True, True, False, False]
+    # crash kind raises InjectedCrash, on the second call only
+    p.fire("checkpoint")
+    with pytest.raises(faults.InjectedCrash):
+        p.fire("checkpoint")
+    st = p.stats()
+    assert st["calls"]["publish"] == 6 and st["fired"]["publish"] == 2
+
+
+def test_fault_plan_probabilistic_is_seeded_deterministic():
+    def outcomes(seed):
+        p = faults.FaultPlan.parse("publish:fail@0-~0.5", seed=seed)
+        out = []
+        for _ in range(40):
+            try:
+                p.fire("publish")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = outcomes(3), outcomes(3)
+    assert a == b                       # same seed ⇒ same schedule
+    assert 0 < sum(a) < 40              # actually probabilistic
+    assert outcomes(4) != a             # seed moves the schedule
+
+
+def test_fault_plan_bad_specs_rejected():
+    for bad in ("nosite:fail@0", "publish:explode@0", "publish:fail",
+                "publish@0"):
+        with pytest.raises(ValueError):
+            faults.FaultPlan.parse(bad)
+
+
+def test_env_plan_reaches_publish_site(monkeypatch):
+    """RTPU_FAULTS is the subprocess channel: a publisher in a worker the
+    bench spawned must consult the env plan with no code wiring."""
+    monkeypatch.setattr(faults, "_env_plan", faults.FaultPlan.parse(
+        "publish:fail@0-"))
+    pub = DatastorePublisher("http://x/", transport=lambda u, b: 200)
+    assert not pub.publish([_report()])
+    assert pub.dropped == 1
+
+
+def test_backoff_schedule_deterministic_and_bounded():
+    s1 = faults.backoff_schedule(6, 0.05, 0.4, jitter=0.1, seed=9)
+    s2 = faults.backoff_schedule(6, 0.05, 0.4, jitter=0.1, seed=9)
+    assert s1 == s2                     # byte-for-byte deterministic
+    assert faults.backoff_schedule(6, 0.05, 0.4, jitter=0.1, seed=10) != s1
+    base = [min(0.4, 0.05 * 2 ** i) for i in range(6)]
+    for d, b in zip(s1, base):
+        assert b <= d <= b * 1.1        # jitter only ever ADDS, capped
+    assert faults.backoff_schedule(0, 0.05, 0.4) == []
+
+
+# ---------------------------------------------------------------------------
+# publisher retry / dead-letter spool
+
+
+def _report(seg=7, t0=0.0, t1=4.0):
+    return Report(segment_id=seg, next_segment_id=None, start_time=t0,
+                  end_time=t1, length=25.0, queue_length=0.0)
+
+
+def test_publisher_retries_then_dead_letters_then_replays(tmp_path):
+    calls = {"n": 0}
+
+    def transport(url, body):
+        calls["n"] += 1
+        if calls["n"] <= 5:
+            raise OSError("outage")
+        return 200
+
+    pub = DatastorePublisher(
+        "http://x/", transport=transport, retries=1, backoff_ms=1.0,
+        backoff_cap_ms=2.0, dead_letter_dir=str(tmp_path))
+    r = _report()
+    # attempts 1,2 fail → spooled; attempts 3,4 fail → spooled
+    assert not pub.publish([r]) and not pub.publish([_report(seg=9)])
+    assert pub.retried == 2 and pub.dead_lettered == 2
+    assert pub.dead_letter_pending == 2 and pub.dropped == 0
+    spool = tmp_path / "dead_letter.jsonl"
+    assert spool.exists() and len(spool.read_text().splitlines()) == 2
+    # attempt 5 fails, attempt 6 succeeds → batch lands AND the spool
+    # auto-replays to empty (outage over)
+    assert pub.publish([_report(seg=11)])
+    assert pub.dead_letter_pending == 0 and pub.dead_letter_replayed == 2
+    assert pub.published == 3
+    assert spool.read_text() == ""
+
+
+def test_publisher_spool_survives_restart(tmp_path):
+    down = DatastorePublisher("http://x/", retries=0,
+                              transport=lambda u, b: (_ for _ in ()).throw(
+                                  OSError("down")),
+                              dead_letter_dir=str(tmp_path))
+    down.publish([_report(), _report(seg=8)])
+    assert down.dead_letter_pending == 2
+    # a NEW publisher over the same dir inherits and drains the spool
+    up = DatastorePublisher("http://x/", transport=lambda u, b: 200,
+                            dead_letter_dir=str(tmp_path))
+    assert up.dead_letter_pending == 2
+    replayed, remaining = up.replay_dead_letters()
+    assert (replayed, remaining) == (2, 0)
+    assert up.published == 2
+
+
+def test_publisher_spool_torn_tail_truncated_on_restart(tmp_path):
+    """A spool torn mid-append (SIGKILL) must be truncated at reopen:
+    otherwise the next append concatenates onto the fragment, welding
+    two batches into one unparseable line that wedges replay forever."""
+    down = DatastorePublisher("http://x/", retries=0,
+                              transport=lambda u, b: (_ for _ in ()).throw(
+                                  OSError("down")),
+                              dead_letter_dir=str(tmp_path))
+    down.publish([_report()])
+    spool = tmp_path / "dead_letter.jsonl"
+    whole = spool.read_bytes()
+    spool.write_bytes(whole + whole[: len(whole) // 2])   # torn tail
+    # restart: inherits ONE complete entry; the fragment is cut from the
+    # file so the next dead-letter lands on a clean line boundary
+    up = DatastorePublisher("http://x/", retries=0,
+                            transport=lambda u, b: (_ for _ in ()).throw(
+                                OSError("still down")),
+                            dead_letter_dir=str(tmp_path))
+    assert up.dead_letter_pending == 1
+    assert spool.read_bytes() == whole
+    up.publish([_report(seg=9)])          # appends cleanly after the cut
+    up._transport = lambda u, b: 200      # datastore back
+    assert up.replay_dead_letters() == (2, 0)
+    assert up.published == 2
+
+
+def test_publisher_gauges_surface_at_stats(tmp_path):
+    from reporter_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    pub = DatastorePublisher(
+        "http://x/", retries=2, backoff_ms=1.0, backoff_cap_ms=2.0,
+        transport=lambda u, b: (_ for _ in ()).throw(OSError("down")),
+        dead_letter_dir=str(tmp_path), metrics=reg)
+    pub.publish([_report()])
+    snap = reg.snapshot()
+    assert snap["publish_retry"] == 2.0
+    assert snap["dead_letter"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpoint + torn broker append
+
+
+class _HistHost:
+    """Duck-typed pl for load_checkpoint: histograms + baselines only."""
+
+    def __init__(self, rows=4):
+        from reporter_tpu.streaming.histogram import SpeedHistogram
+
+        self.hist = SpeedHistogram(rows, (0.0, 5.0, 10.0))
+        self.qhist = SpeedHistogram(rows, (0.0, 10.0))
+        self._hist_flushed = self.hist.snapshot()
+        self._qhist_flushed = self.qhist.snapshot()
+
+
+def test_checkpoint_crash_mid_write_leaves_old_snapshot(tmp_path):
+    from reporter_tpu.streaming.state import load_checkpoint, save_checkpoint
+
+    host = _HistHost()
+    path = str(tmp_path / "ck")
+    snap = host.hist.snapshot()
+    qsnap = host.qhist.snapshot()
+    save_checkpoint(path, [1, 2], {}, snap, snap, qsnap, qsnap)
+    # second checkpoint dies between tmp write and rename
+    with faults.use(faults.FaultPlan.parse("checkpoint:crash@0")):
+        with pytest.raises(faults.InjectedCrash):
+            save_checkpoint(path, [9, 9], {}, snap, snap, qsnap, qsnap)
+    state = load_checkpoint(path, _HistHost())
+    assert state["committed"] == [1, 2]   # old snapshot intact, not torn
+    # and a later checkpoint succeeds over the leftover tmp
+    save_checkpoint(path, [3, 4], {}, snap, snap, qsnap, qsnap)
+    assert load_checkpoint(path, _HistHost())["committed"] == [3, 4]
+
+
+def test_torn_broker_append_recovers_acked_prefix(tmp_path):
+    from reporter_tpu.streaming.durable_columnar import (
+        DurableColumnarIngestQueue,
+    )
+
+    d = str(tmp_path / "broker")
+    q = DurableColumnarIngestQueue(d, num_partitions=1)
+    recs = [{"uuid": "u", "lat": 1.0, "lon": 2.0, "time": float(i)}
+            for i in range(6)]
+    q.append_columns(pack_records(recs[:3]))
+    # the next append tears mid-frame (simulated death mid-write; call
+    # indices count from the plan's installation, so this is call 0)
+    with faults.use(faults.FaultPlan.parse("broker:torn@0")):
+        with pytest.raises(faults.InjectedCrash):
+            q.append_columns(pack_records(recs[3:]))
+    q.close()
+    q2 = DurableColumnarIngestQueue(d, num_partitions=1)
+    assert q2.end_offset(0) == 3          # acked prefix, torn tail dropped
+    polled = q2.poll(0, 0, 10)
+    assert [r["time"] for _, r in polled] == [0.0, 1.0, 2.0]
+    # and the truncated file accepts new appends cleanly
+    q2.append_columns(pack_records(recs[3:]))
+    assert q2.end_offset(0) == 6
+    q2.close()
+
+
+# ---------------------------------------------------------------------------
+# pipeline-level chaos (tiny tile, CPU grid backend — cheap)
+
+
+@pytest.fixture(scope="module")
+def chaos_tiles():
+    return compile_network(generate_city("tiny"),
+                           CompilerParams(reach_radius=500.0,
+                                          osmlr_max_length=250.0))
+
+
+@pytest.fixture(scope="module")
+def chaos_fleet(chaos_tiles):
+    return synthesize_fleet(chaos_tiles, 6, num_points=60, seed=9)
+
+
+def _record_chunks(fleet, k=10):
+    """Round-robin arrival: every vehicle's point i before any i+1."""
+    n = len(fleet[0].times)
+    for lo in range(0, n, k):
+        out = []
+        for p in fleet:
+            for i in range(lo, min(lo + k, n)):
+                (lon, lat), t = p.lonlat[i], p.times[i]
+                out.append({"uuid": p.uuid, "lat": float(lat),
+                            "lon": float(lon), "time": float(t)})
+        yield out
+
+
+def _drive(ts, fleet, plan=None, timeout_s=0.0, fallback="retry",
+           queue=None, transport=None):
+    """Feed the fleet through a pipelined columnar worker under a fault
+    plan; returns (published report-row keys, stats)."""
+    queue = queue or ColumnarIngestQueue(4)
+    cfg = Config(
+        matcher_backend="jax",
+        matcher=MatcherParams(dispatch_timeout_s=timeout_s,
+                              dispatch_fallback=fallback),
+        service=ServiceConfig(datastore_url="http://sink.invalid/"),
+        streaming=StreamingConfig(flush_min_points=20,
+                                  hist_flush_interval=0.0,
+                                  pipeline_depth=1))
+    captured: list = []
+    pipe = ColumnarStreamPipeline(
+        ts, cfg, queue=queue,
+        transport=transport or (lambda u, b: (captured.append(b), 200)[1]))
+    with faults.use(plan):
+        for batch in _record_chunks(fleet):
+            queue.append_many(batch)
+            pipe.step()
+        for _ in range(30):
+            pipe.step()
+            st = pipe.stats()
+            if (queue.lag(pipe.committed) == 0
+                    and st["buffered_points"] == 0):
+                break
+        pipe.drain()
+    st = pipe.stats()
+    pipe.close()
+    rows = []
+    for body in captured:
+        for r in json.loads(body)["reports"]:
+            rows.append((r["id"], -1 if r["next_id"] is None else
+                         r["next_id"], round(r["t0"], 3), round(r["t1"], 3),
+                         round(r["length"], 2)))
+    return sorted(rows), st
+
+
+def test_dispatch_timeout_releases_wave_and_retry_is_bit_identical(
+        chaos_tiles, chaos_fleet):
+    """The watchdog trips on an injected hang (the tunnel's real failure
+    mode), the wave's held rows go back in play, and the retried stream's
+    published reports are IDENTICAL to the uninterrupted run's — the
+    degradation path costs latency, never data."""
+    rows0, st0 = _drive(chaos_tiles, chaos_fleet)
+    assert len(rows0) > 0 and st0["dispatch_timeouts"] == 0
+    plan = faults.FaultPlan.parse("dispatch:hang(1.5)@1")
+    rows1, st1 = _drive(chaos_tiles, chaos_fleet, plan=plan, timeout_s=0.4)
+    assert st1["dispatch_timeouts"] == 1
+    assert rows1 == rows0
+
+
+def test_dispatch_timeout_falls_back_to_reference_cpu(chaos_tiles,
+                                                      chaos_fleet):
+    """With the link gone for good (every dispatch hangs), the
+    reference_cpu knob serves every wave from the in-process oracle:
+    degraded throughput, zero availability loss, counted fallbacks."""
+    plan = faults.FaultPlan.parse("dispatch:hang(5)@0-")
+    rows, st = _drive(chaos_tiles, chaos_fleet, plan=plan, timeout_s=0.2,
+                      fallback="reference_cpu")
+    assert len(rows) > 0
+    assert st["dispatch_timeouts"] == 0   # no wave was ever RELEASED —
+    #                                       each degraded inline instead
+
+
+def test_dispatch_timeout_maps_to_503_on_the_wsgi_face(chaos_tiles):
+    """A wedged dispatch surfaces to HTTP clients as a retryable 503,
+    not an opaque 500 (combine mode: the raise reaches the handler)."""
+    import io
+
+    from reporter_tpu.service.app import make_app
+
+    app = make_app(chaos_tiles, Config(
+        matcher_backend="jax",
+        matcher=MatcherParams(dispatch_timeout_s=0.2),
+        service=ServiceConfig(batching="combine")))
+    body = json.dumps({"uuid": "u1", "trace": [
+        {"lat": 0.001 * i, "lon": 0.001 * i, "time": float(i)}
+        for i in range(4)]}).encode()
+    status: list = []
+    env = {"REQUEST_METHOD": "POST", "PATH_INFO": "/report",
+           "CONTENT_LENGTH": str(len(body)),
+           "wsgi.input": io.BytesIO(body)}
+    with faults.use(faults.FaultPlan.parse("dispatch:hang(5)@0-")):
+        app(env, lambda s, h: status.append(s))
+    assert status[0].startswith("503")
+    app.close()
+
+
+def test_kill_and_replay_covers_uninterrupted_run(chaos_tiles, chaos_fleet,
+                                                  tmp_path):
+    """In-proc kill→restore→replay over a durable broker: a pipeline is
+    abandoned mid-stream (its unpublished tail dies with it), a new one
+    restores the checkpoint and replays from the commit floor. Published
+    union must COVER the uninterrupted run's reports — duplicates
+    allowed (at-least-once), losses not."""
+    from reporter_tpu.streaming.durable_columnar import (
+        DurableColumnarIngestQueue,
+    )
+
+    d = str(tmp_path / "broker")
+    cfg = Config(
+        matcher_backend="jax",
+        service=ServiceConfig(datastore_url="http://sink.invalid/"),
+        streaming=StreamingConfig(flush_min_points=20,
+                                  hist_flush_interval=0.0,
+                                  pipeline_depth=1))
+    chunks = list(_record_chunks(chaos_fleet))
+
+    # uninterrupted twin (same broker content, in-memory copy)
+    base_rows, _ = _drive(chaos_tiles, chaos_fleet)
+
+    q = DurableColumnarIngestQueue(d, 4)
+    captured: list = []
+    transport = lambda u, b: (captured.append(b), 200)[1]   # noqa: E731
+    pipe = ColumnarStreamPipeline(chaos_tiles, cfg, queue=q,
+                                  transport=transport)
+    ckpt = str(tmp_path / "worker.ckpt")
+    for batch in chunks[:3]:
+        q.append_many(batch)
+        pipe.step()
+    pipe.checkpoint(ckpt)               # consistent cut
+    for batch in chunks[3:]:
+        q.append_many(batch)
+        pipe.step()
+    # CRASH: no drain, no final checkpoint — in-flight waves and the
+    # publisher thread die with the process
+    pre_crash = list(captured)
+    pipe.close()
+    q.close()
+
+    q2 = DurableColumnarIngestQueue(d, 4)
+    captured2: list = []
+    pipe2 = ColumnarStreamPipeline(chaos_tiles, cfg, queue=q2,
+                                   transport=lambda u, b:
+                                   (captured2.append(b), 200)[1])
+    pipe2.restore(ckpt)
+    assert pipe2.committed == pipe2._consumed   # replay from the floor
+    for _ in range(40):
+        pipe2.step()
+        if (q2.lag(pipe2.committed) == 0
+                and pipe2.stats()["buffered_points"] == 0):
+            break
+    pipe2.drain()
+    pipe2.close()
+    q2.close()
+
+    def rows(bodies):
+        out = []
+        for body in bodies:
+            for r in json.loads(body)["reports"]:
+                out.append((r["id"], round(r["t0"], 3), round(r["t1"], 3)))
+        return out
+
+    recovered = rows(pre_crash) + rows(captured2)
+    base = [(i, t0, t1) for (i, _nx, t0, t1, _ln) in base_rows]
+    # coverage: every uninterrupted traversal appears (same segment,
+    # overlapping interval) in the killed+recovered stream. DELIBERATELY
+    # re-derived here (strict overlap, no start-time tolerance) rather
+    # than importing bench._coverage_diff: the test pins a STRICTER
+    # bound independently, so a bug in the bench accounting can't
+    # silently weaken both (the bench's own semantics are pinned by
+    # tests/test_bench_schema.py)
+    from collections import defaultdict
+    by_id = defaultdict(list)
+    for i, t0, t1 in recovered:
+        by_id[i].append((t0, t1))
+    lost = 0
+    for i, t0, t1 in base:
+        if not any(min(t1, b1) - max(t0, b0) > -1e-9
+                   for b0, b1 in by_id.get(i, ())):
+            lost += 1
+    assert lost == 0, (lost, len(base))
+    assert len(recovered) >= len(base)  # duplicates allowed, never fewer
+
+
+def test_worker_cli_exit_on_drain(chaos_tiles, chaos_fleet, tmp_path,
+                                  capsys):
+    """--exit-on-drain ends the run once the broker is drained even when
+    a sub-threshold tail pins the commit floor (the finally-drain
+    flushes it) — the shape every bench chaos worker runs in."""
+    from reporter_tpu.streaming.__main__ import main
+    from reporter_tpu.streaming.durable_columnar import (
+        DurableColumnarIngestQueue,
+    )
+
+    tiles = str(tmp_path / "tiles.npz")
+    chaos_tiles.save(tiles)
+    broker = str(tmp_path / "broker")
+    q = DurableColumnarIngestQueue(broker, 4)
+    for batch in _record_chunks(chaos_fleet):
+        q.append_many(batch)
+    q.close()
+    assert main(["--tiles", tiles, "--broker-dir", broker, "--columnar",
+                 "--exit-on-drain"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["lag"] == 0 and out["buffered_points"] == 0
+    assert out["reports"] > 0
+    assert out["dead_letter_pending"] == 0
