@@ -530,7 +530,8 @@ def _soak_point(ts, traces, n_stream: int, seconds: float,
                 offered_pps: int, wave_points: int,
                 autotune: bool = False, drain_timeout: float = 30.0,
                 queue_bound: "int | None" = None,
-                overload_policy: str = "reject") -> dict:
+                overload_policy: str = "reject",
+                collect_stages: bool = False) -> dict:
     """One live operating point: a paced producer THREAD offers
     ``offered_pps`` into the columnar broker (a real broker keeps
     receiving during a flush — a slow flush shows up as LAG, never as a
@@ -570,6 +571,10 @@ def _soak_point(ts, traces, n_stream: int, seconds: float,
                                            wave_min_points=40,
                                            wave_max_points=960,
                                            wave_target_latency=2.0))
+    # collect_stages: read the pipeline's per-probe stage components at
+    # the end (the latency-attribution leg's traced arm — the CALLER
+    # enables the global tracer, under try/finally, so an exception
+    # mid-soak can't leave every later leg silently traced).
     pipe = ColumnarStreamPipeline(ts, cfg, queue=queue)
     lat_chunks: list = []
 
@@ -635,6 +640,7 @@ def _soak_point(ts, traces, n_stream: int, seconds: float,
     end_lag = int(queue.lag(pipe.committed))
     st = pipe.stats()
     pipe.close()
+    stage_samples = pipe.take_stage_samples() if collect_stages else None
     # exact probes taken off the broker (committed floor); counting
     # matched+buffered instead would double-count cache-tail points that
     # re-enter each flush's merged trace
@@ -663,6 +669,8 @@ def _soak_point(ts, traces, n_stream: int, seconds: float,
         "match_seconds": round(st["match_seconds"], 2),
         "wave_points_end": st["wave_points"],
     }
+    if collect_stages:
+        out["stage_attribution"] = _attribution_from_samples(stage_samples)
     if queue_bound is not None:
         out.update({
             "broker_bound_per_partition": queue_bound,
@@ -738,6 +746,206 @@ def _streaming_overload(ts, traces, n_stream: int,
                        offered_pps=offer, wave_points=360, autotune=False,
                        drain_timeout=20.0, queue_bound=150_000,
                        overload_policy="reject")
+
+
+# ---------------------------------------------------------------------------
+# Latency attribution (ISSUE 5 tentpole): the per-stage decomposition of
+# probe→report time as a RECORDED, reconciled artifact — the round-5
+# verdict's "where do the 2.5-20 s go" answered by spans, not prose.
+
+_ATTRIBUTION_STAGES = ("broker_dwell", "prepare", "device_match",
+                      "report_build")
+
+
+def _attribution_from_samples(samples: "dict | None") -> dict:
+    """Per-stage decomposition of the e2e p50/p99 + the reconciliation
+    ratio, from the pipeline's take_stage_samples() arrays. Pure numpy
+    (schema-tested without a pipeline).
+
+    The four attribution stages partition each probe's arrival→report
+    timeline at the wave's recorded boundaries, so their per-probe sum
+    equals the e2e sample EXACTLY. Each stage's reported p50_ms/p99_ms
+    is that stage's MEAN over the probes whose e2e lands in a narrow
+    quantile window around the e2e p50/p99 — "what the median (p99)
+    probe's time was spent on" — NOT the stage's marginal quantile:
+    marginal p50s of right-skewed stages do not sum to the p50 of the
+    sum (measured 0.54× on a CPU validation run), while the conditional
+    decomposition sums to the window's mean e2e exactly, leaving only
+    window-mean-vs-percentile slack in the recorded ratio (the ±15%
+    acceptance bound absorbs it). 'publish' (the async POST attempt,
+    per wave) lands after the probe→report cut and is reported
+    alongside as marginal quantiles, excluded from the reconciling
+    sum."""
+    import numpy as np
+
+    if not samples or "e2e" not in samples or not len(samples["e2e"]):
+        return {"samples": 0, "stages": {}, "e2e_p50_ms": None,
+                "e2e_p99_ms": None, "stage_sum_p50_ms": None,
+                "stage_sum_over_e2e_p50": None,
+                "reconciles_within_15pct": None}
+    e2e = samples["e2e"]
+    order = np.argsort(e2e, kind="stable")
+
+    def _window(lo_q, hi_q):
+        lo = int(np.floor(lo_q * (len(order) - 1)))
+        hi = int(np.ceil(hi_q * (len(order) - 1))) + 1
+        return order[lo:max(lo + 1, hi)]
+
+    w50 = _window(0.45, 0.55)
+    w99 = _window(0.985, 0.995)
+    stages = {}
+    sum_p50 = 0.0
+    for name in _ATTRIBUTION_STAGES:
+        comp = samples[name]
+        p50 = round(float(comp[w50].mean()) * 1e3, 2)
+        p99 = round(float(comp[w99].mean()) * 1e3, 2)
+        stages[name] = {"p50_ms": p50, "p99_ms": p99}
+        sum_p50 += p50
+    if "publish" in samples and len(samples["publish"]):
+        pub = samples["publish"]
+        stages["publish"] = {
+            "p50_ms": round(float(np.percentile(pub, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(pub, 99)) * 1e3, 2),
+            "note": "async POST attempt, per wave; "
+                    "after the probe->report cut"}
+    e_p50 = round(float(np.percentile(e2e, 50)) * 1e3, 2)
+    e_p99 = round(float(np.percentile(e2e, 99)) * 1e3, 2)
+    ratio = round(sum_p50 / e_p50, 4) if e_p50 else None
+    return {
+        "samples": int(len(e2e)),
+        "stages": stages,
+        "e2e_p50_ms": e_p50,
+        "e2e_p99_ms": e_p99,
+        "stage_sum_p50_ms": round(sum_p50, 2),
+        "stage_sum_over_e2e_p50": ratio,
+        "reconciles_within_15pct": (None if ratio is None
+                                    else bool(abs(ratio - 1.0) <= 0.15)),
+    }
+
+
+def _service_face_attribution(ts, traces, n_req: int = 24,
+                              conc: int = 4) -> dict:
+    """The serving twin: stage p50s from the metrics series a scheduler
+    deployment already exports — queue age (admission→dispatch), device
+    match, report build, publish — against the measured request p50 of a
+    small concurrent closed loop. Component p50s come from per-BATCH /
+    per-submission series while the e2e is per request, so this
+    decomposition is indicative (recorded ratio, not acceptance-gated);
+    the soak-side attribution above is the reconciled one."""
+    import threading
+
+    import numpy as np
+
+    from reporter_tpu.config import Config
+    from reporter_tpu.service.app import ReporterApp
+
+    app = ReporterApp(ts, Config(matcher_backend="jax"),
+                      transport=lambda u, b: 200)
+    payloads = _service_payloads(ts, traces, n_req, tag="lattr")
+    if not payloads:
+        return {"requests": 0}
+    app.report_many([payloads[0]])      # compile warmup, untimed
+    durs: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def worker(chunk):
+        for p in chunk:
+            t0 = time.perf_counter()
+            try:
+                app.report_many([p])
+            except Exception as exc:          # recorded, not fatal
+                with lock:
+                    errors.append(repr(exc))
+                continue
+            with lock:
+                durs.append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=worker, args=(payloads[i::conc],))
+               for i in range(conc)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = app.matcher.metrics.snapshot()
+    app.close()
+
+    def _ms(key):
+        v = snap.get(key)
+        return (None if v is None or not np.isfinite(v)
+                else round(float(v) * 1e3, 2))
+
+    stages = {
+        "sched_queue": _ms("sched_queue_age_seconds_p50"),
+        "device_match": _ms("match_seconds_p50"),
+        "report_build": _ms("report_build_seconds_p50"),
+        "publish": _ms("publish_seconds_p50"),
+    }
+    e2e_p50 = (round(float(np.median(durs)) * 1e3, 2) if durs else None)
+    known = [v for v in stages.values() if v is not None]
+    return {
+        "requests": len(durs),
+        "concurrency": conc,
+        "stages_p50_ms": stages,
+        "request_p50_ms": e2e_p50,
+        "stage_sum_over_request_p50": (
+            round(sum(known) / e2e_p50, 4) if e2e_p50 and known else None),
+        **({"errors": errors[:4]} if errors else {}),
+    }
+
+
+def _latency_attribution(ts, traces, n_stream: int, offered_pps: int,
+                         seconds: float = 8.0) -> dict:
+    """detail.latency_attribution: two back-to-back soak points at the
+    SAME held offer — tracing ON (stage spans + per-probe attribution)
+    vs tracing OFF — so the capture carries (a) the per-stage
+    decomposition of probe→report p50/p99 with its reconciliation
+    against the independently accumulated e2e samples, and (b) the
+    measured throughput cost of leaving tracing on (the <3% acceptance
+    A/B), under the same link mood. Plus the service-face decomposition
+    from the metrics series."""
+    from reporter_tpu.utils import tracing
+
+    # untimed warm point first: the arm that runs cold pays first-compile
+    # for its whole window (measured: a cold traced arm recorded 0
+    # sustained pps) — the A/B must compare tracing cost, not compile
+    # order
+    _soak_point(ts, traces, n_stream, min(3.0, seconds), offered_pps,
+                wave_points=120)
+    prev_traced = tracing.tracer().enabled
+    try:
+        tracing.configure(enabled=True)
+        on = _soak_point(ts, traces, n_stream, seconds, offered_pps,
+                         wave_points=120, collect_stages=True)
+        # the OFF arm must force-disable, not restore: under RTPU_TRACE=1
+        # prev_traced is True and the "untraced" soak would run traced —
+        # a traced-vs-traced A/B reading ~0% while labeled an A/B
+        tracing.configure(enabled=False)
+        off = _soak_point(ts, traces, n_stream, seconds, offered_pps,
+                          wave_points=120)
+    finally:
+        # an exception mid-soak must not leave the process-global tracer
+        # in the wrong state for every later leg (ON would silently tax
+        # the composite's perf numbers; OFF would void an env-requested
+        # trace)
+        tracing.configure(enabled=prev_traced)
+    s_on, s_off = on["sustained_pps"], off["sustained_pps"]
+    overhead = (round((s_off - s_on) / s_off * 100.0, 2) if s_off else None)
+    attribution = on.pop("stage_attribution")
+    return {
+        "config": on["config"] + ", traced-vs-untraced A/B",
+        "offered_pps": offered_pps,
+        **attribution,
+        "soak_p50_probe_to_report_ms": on["p50_probe_to_report_ms"],
+        "soak_p99_probe_to_report_ms": on["p99_probe_to_report_ms"],
+        "sustained_pps_traced": s_on,
+        "sustained_pps_untraced": s_off,
+        "tracing_overhead_pct": overhead,
+        "overhead_note": ("negative = noise in the traced arm's favor; "
+                          "both arms held the same offer under the same "
+                          "link mood"),
+        "service_face": _service_face_attribution(ts, traces),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -2407,6 +2615,15 @@ def main() -> None:
                                                    n_stream=2000)
         split["streaming_soak_s"] = round(time.perf_counter() - t0, 1)
 
+        # -- latency attribution (ISSUE 5 tentpole): per-stage
+        # probe→report decomposition at the held soak offer, reconciled
+        # against the measured e2e p50, + the tracing-overhead A/B and
+        # the service-face decomposition -----------------------------------
+        t0 = time.perf_counter()
+        detail["latency_attribution"] = _latency_attribution(
+            ts, traces, n_stream=2000, offered_pps=100_000)
+        split["latency_attribution_s"] = round(time.perf_counter() - t0, 1)
+
         # -- overload soak (VERDICT r5 missing #2): 2× the sustainable
         # rate against a bounded broker, counted shedding -----------------
         t0 = time.perf_counter()
@@ -2528,6 +2745,17 @@ def main() -> None:
             "REPORTER_BENCH_CHAOS") == "1":
         _run_chaos_legs(ts, traces, detail, split)
 
+    # Latency attribution runs on EVERY composite (chip, manual,
+    # CPU-forced): the acceptance artifact is the reconciled per-stage
+    # decomposition, and the CPU validation capture must carry it too —
+    # scaled down so one core serving producer+consumer stays honest.
+    if "latency_attribution" not in detail:
+        t0 = time.perf_counter()
+        detail["latency_attribution"] = _latency_attribution(
+            ts, traces, n_stream=min(500, len(traces)),
+            offered_pps=(50_000 if tpu_ok else 2_000), seconds=5.0)
+        split["latency_attribution_s"] = round(time.perf_counter() - t0, 1)
+
     detail["setup_split"] = split
     detail["setup_seconds"] = round(
         split["device_probe_s"] + split["tile_s"] + split["fleet_s"], 1)
@@ -2606,18 +2834,18 @@ def _summary_line(doc: dict) -> dict:
             "src": sorted({v.get("fidelity_source", "?")
                            for v in per_tile.values()}),
         },
-        "gt_edge_rate": {
-            k: _g(*path, "point_edge_rate") for k, path in
-            ((d.get("headline_tile", "sf"), ("ground_truth",)),
-             ("bayarea-xl", ("xl", "ground_truth")),
-             ("organic", ("organic", "ground_truth")),
-             ("organic-xl", ("organic_xl", "ground_truth")))
-            if _g(*path, "point_edge_rate") is not None},
-        "reach_miss": {
-            k: _g(k2, "reach_audit", "step_miss_rate") for k, k2 in
-            (("bayarea-xl", "xl"), ("organic", "organic"),
-             ("organic-xl", "organic_xl"))
-            if _g(k2, "reach_audit", "step_miss_rate") is not None},
+        # fixed-order arrays (the r8 kpps compaction, applied here when
+        # the lattr token needed the bytes back): gt_edge = point-on-
+        # edge rate for [headline tile, bayarea-xl, organic, organic-xl],
+        # reach_miss = step miss rate for [bayarea-xl, organic,
+        # organic-xl]; named exact values stay in detail.*.ground_truth /
+        # detail.*.reach_audit
+        "gt_edge": [_g(*path, "point_edge_rate") for path in
+                    (("ground_truth",), ("xl", "ground_truth"),
+                     ("organic", "ground_truth"),
+                     ("organic_xl", "ground_truth"))],
+        "reach_miss": [_g(k, "reach_audit", "step_miss_rate")
+                       for k in ("xl", "organic", "organic_xl")],
         "stream_pps": _g("streaming", "probes_per_sec"),
         # dict-pipeline pps + soak p99/offered/duration + the full
         # capacity grid live in the detail file only: the FINAL line must
@@ -2660,6 +2888,13 @@ def _summary_line(doc: dict) -> dict:
                 _g("recovery", "lost_reports"),
                 _g("publish_outage", "dead_letter_pending_end"),
                 _g("streaming_soak_mp", "speedup_2v1")],
+        # latency attribution headline (full decomposition in
+        # detail.latency_attribution): [e2e p50 ms at the held offer,
+        # sum-of-stage-p50s / e2e-p50 (1.0 = perfect reconciliation),
+        # tracing-overhead % from the traced-vs-untraced A/B]
+        "lattr": [_g("latency_attribution", "e2e_p50_ms"),
+                  _g("latency_attribution", "stage_sum_over_e2e_p50"),
+                  _g("latency_attribution", "tracing_overhead_pct")],
         # first overloaded client level (None = survived the whole curve)
         "svc_edge": _g("service_overload_boundary", "clients"),
         # serving-face A/B headline (full curves + open loop in detail):

@@ -225,6 +225,24 @@ class ServiceConfig:
     publish_backoff_ms: float = 50.0    # backoff base (doubles per retry)
     publish_backoff_cap_ms: float = 2000.0  # backoff ceiling
     publish_backoff_jitter: float = 0.1     # +[0, jitter)x seeded jitter
+    # Span tracing / flight recorder (utils/tracing.py). The recorder is
+    # PROCESS-GLOBAL (the fault sites in matcher/publisher/scheduler all
+    # write the same ring); these knobs only ever turn it ON — an
+    # env-enabled recorder (RTPU_TRACE=1) is never disabled by a second
+    # component constructed with the defaults.
+    trace: bool = False            # record host-side spans (consume /
+    #                                prepare / device match / report
+    #                                build / publish, wave-tagged).
+    #                                Off = one attribute read per call
+    #                                site (the 100k+ pps offer must not
+    #                                pay for idle observability)
+    trace_ring: int = 4096         # flight-recorder span capacity
+    trace_dir: str = ""            # non-empty ⇒ post-mortem Chrome-trace
+    #                                dumps are written here automatically
+    #                                on dispatch-timeout, breaker-open,
+    #                                dead-letter, and admission-shed
+    #                                events (and on demand via
+    #                                tracing.tracer().dump())
     dead_letter_dir: str = ""      # non-empty ⇒ batches that exhaust their
     #                                retries are SPOOLED to disk and
     #                                replayed automatically after the next
@@ -263,6 +281,14 @@ class ServiceConfig:
             kw["publish_backoff_ms"] = float(e["DATASTORE_BACKOFF_MS"])
         if "DATASTORE_DEAD_LETTER_DIR" in e:
             kw["dead_letter_dir"] = e["DATASTORE_DEAD_LETTER_DIR"]
+        if "RTPU_TRACE" in e:
+            from reporter_tpu.utils.tracing import env_flag
+
+            kw["trace"] = env_flag(e["RTPU_TRACE"])
+        if "RTPU_TRACE_RING" in e:
+            kw["trace_ring"] = int(e["RTPU_TRACE_RING"])
+        if "RTPU_TRACE_DIR" in e:
+            kw["trace_dir"] = e["RTPU_TRACE_DIR"]
         return dataclasses.replace(self, **kw) if kw else self
 
     @classmethod
@@ -376,6 +402,8 @@ class Config:
                              "publish_backoff_cap_ms must be > 0")
         if svc.publish_backoff_jitter < 0:
             raise ValueError("service.publish_backoff_jitter must be >= 0")
+        if svc.trace_ring < 1:
+            raise ValueError("service.trace_ring must be >= 1")
         if self.matcher.dispatch_timeout_s < 0:
             raise ValueError("matcher.dispatch_timeout_s must be >= 0")
         if self.matcher.dispatch_fallback not in ("retry", "reference_cpu"):

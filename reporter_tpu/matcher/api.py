@@ -34,6 +34,7 @@ from reporter_tpu.matcher.segments import (
     reach_route_fn,
 )
 from reporter_tpu.tiles.tileset import TileSet
+from reporter_tpu.utils import tracing
 from reporter_tpu.utils.metrics import MetricsRegistry
 
 _BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
@@ -323,7 +324,9 @@ class SegmentMatcher:
         lazy columnar MatchBatch (read .columns for bulk consumers)."""
         from reporter_tpu.utils.profiling import device_trace
 
-        with self.metrics.stage("match"), device_trace():
+        tr = tracing.tracer()
+        with self.metrics.stage("match"), device_trace(), \
+                tr.span("match_many", traces=len(traces)):
             if self.backend == "reference_cpu":
                 out = [self._match_cpu(t) for t in traces]
             else:
@@ -367,10 +370,19 @@ class SegmentMatcher:
             # reads "timeouts stopped" at exactly the worst moment.
             self.metrics.count("dispatch_breaker_open")
             self.metrics.count("dispatch_timeout")
+            tracing.post_mortem("breaker_open", failing="device_dispatch",
+                                traces=len(traces),
+                                abandoned=self._abandoned_dispatches)
             return self._degrade(traces, timeout)
         box: dict = {}
         done = threading.Event()
         state = {"abandoned": False, "finished": False}
+
+        tracing.tracer().instant("device_dispatch",
+                                 traces=len(traces))
+        # (recorded BEFORE the guarded body: a dispatch that hangs
+        # forever still shows up in the post-mortem as the last thing
+        # the matcher started)
 
         def _run():
             try:
@@ -405,6 +417,8 @@ class SegmentMatcher:
                 raise box["exc"]
             return box["out"]
         self.metrics.count("dispatch_timeout")
+        tracing.post_mortem("dispatch_timeout", failing="device_dispatch",
+                            traces=len(traces), timeout_s=timeout)
         return self._degrade(traces, timeout)
 
     def _degrade(self, traces: Sequence[Trace], timeout: float):

@@ -49,14 +49,22 @@ def _needs_build(lib_path: str) -> bool:
 
 def build_native_lib(force: bool = False,
                      sanitize: "str | None" = None) -> str | None:
-    """Compile the shared library; returns its path or None on failure."""
+    """Compile the shared library; returns its path or None on failure.
+
+    The temp .so lives in its own ``tempfile`` DIRECTORY under the
+    source dir (same filesystem, so the publish rename stays atomic
+    w.r.t. concurrent importers) and the whole directory is removed on
+    every exit path — the bare ``mkstemp(dir=_SRC_DIR)`` temps used
+    before this leaked ``tmp*.so`` strays into the package tree whenever
+    a sanitizer build's driver subprocess was killed mid-compile."""
+    import shutil
+
     lib_path = os.path.join(_SRC_DIR, _lib_name(sanitize))
     if not force and not _needs_build(lib_path):
         return lib_path
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
-    # Build to a temp name then rename: atomic w.r.t. concurrent importers.
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
-    os.close(fd)
+    tmpdir = tempfile.mkdtemp(prefix="tmpbuild_", dir=_SRC_DIR)
+    tmp = os.path.join(tmpdir, _lib_name(sanitize))
     cmd = ["g++", *( _SANITIZE_FLAGS[sanitize] or ["-O3"]), "-std=c++17",
            "-shared", "-fPIC", "-o", tmp, *srcs, "-lpthread"]
     try:
@@ -64,15 +72,14 @@ def build_native_lib(force: bool = False,
         if proc.returncode != 0:
             log.warning("native build failed (falling back to Python):\n%s",
                         proc.stderr[-2000:])
-            os.unlink(tmp)
             return None
         os.replace(tmp, lib_path)
         return lib_path
     except (OSError, subprocess.SubprocessError) as exc:
         log.warning("native build unavailable: %s", exc)
-        if os.path.exists(tmp):
-            os.unlink(tmp)
         return None
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def load_native_lib(sanitize: "str | None" = None) -> "ctypes.CDLL | None":

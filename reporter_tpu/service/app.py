@@ -37,6 +37,7 @@ from reporter_tpu.service.reports import (
     latest_complete_time,
 )
 from reporter_tpu.tiles.tileset import TileSet
+from reporter_tpu.utils import tracing
 
 log = logging.getLogger("reporter_tpu.service")
 
@@ -126,6 +127,7 @@ class ReporterApp:
                  transport: Transport | None = None, mesh=None):
         self.config = (config or Config()).validate()
         svc = self.config.service
+        tracing.configure_from_service(svc)   # span recorder (global)
         self.matcher = SegmentMatcher(tileset, self.config, mesh=mesh)
         self.cache = PartialTraceCache(ttl=svc.cache_ttl,
                                        max_uuids=svc.cache_max_uuids)
@@ -273,6 +275,7 @@ class ReporterApp:
         all_reports: list[Report] = []
         retains: list[tuple[str, list[dict], float]] = []
         n_traces = n_points = n_reports = 0
+        t_build0 = time.perf_counter()
         for (uuid, merged), records in zip(items, per_trace):
             reports = build_reports(records, self.min_segment_length)
             all_reports.extend(reports)
@@ -294,7 +297,15 @@ class ReporterApp:
             n_traces += 1
             n_points += len(merged)
             n_reports += len(reports)
+        # per-stage series feeding /stats p50s, /metrics histograms, and
+        # the bench's service-face latency attribution: a request's wall
+        # time decomposes as queue age (scheduler) + match + build +
+        # publish, each its own observed series
+        m = self.matcher.metrics
+        t_pub0 = time.perf_counter()
+        m.observe("report_build_seconds", t_pub0 - t_build0)
         self.publisher.publish(all_reports)
+        m.observe("publish_seconds", time.perf_counter() - t_pub0)
         for uuid, merged, from_time in retains:   # arrival order: a later
             self.cache.retain(uuid, merged, from_time)   # duplicate wins
         with self._stats_lock:
@@ -359,6 +370,13 @@ class ReporterApp:
                 # "Metrics": probes/sec, p50 match latency, failure rate)
                 return _respond(start_response, 200,
                                 self.matcher.metrics.snapshot())
+            if path == "/metrics" and method == "GET":
+                # Prometheus text exposition (fixed-bucket histograms
+                # alongside /stats' reservoir percentiles; /stats is
+                # unchanged — operators keep both faces)
+                return _respond_text(
+                    start_response, 200,
+                    self.matcher.metrics.render_prometheus())
             if path == "/report" and method == "POST":
                 body = _read_json(environ)
                 self._bump("requests")
@@ -407,6 +425,15 @@ def _read_json(environ: dict) -> Any:
         return json.loads(raw)
     except json.JSONDecodeError as exc:
         raise BadRequest(f"invalid JSON: {exc}") from exc
+
+
+def _respond_text(start_response: Callable, status: int, text: str):
+    body = text.encode()
+    start_response(f"{status} OK", [
+        ("Content-Type", "text/plain; version=0.0.4; charset=utf-8"),
+        ("Content-Length", str(len(body))),
+    ])
+    return [body]
 
 
 def _respond(start_response: Callable, status: int, payload: dict):

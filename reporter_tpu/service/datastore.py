@@ -20,6 +20,7 @@ from typing import Callable
 
 from reporter_tpu import faults
 from reporter_tpu.service.reports import Report
+from reporter_tpu.utils import tracing
 
 log = logging.getLogger("reporter_tpu.datastore")
 
@@ -157,6 +158,11 @@ class DatastorePublisher:
         with self._count_lock:
             self.dead_lettered += n_rows
         self._gauges()
+        # flight-recorder post-mortem: a batch just exhausted its retries
+        # — the dump shows what the pipeline was doing in the seconds
+        # before the outage won (no-op unless tracing + dump dir are on)
+        tracing.post_mortem("dead_letter", failing="publish",
+                            rows=n_rows, pending=self._spool_pending)
 
     @property
     def dead_letter_pending(self) -> int:
@@ -417,7 +423,9 @@ class AsyncDatastorePublisher(DatastorePublisher):
                 fn, on_done, n_rows = job
                 ok = False
                 try:
-                    ok = fn()
+                    with tracing.tracer().span("publish_post",
+                                               rows=n_rows):
+                        ok = fn()
                 except Exception:
                     # _post only catches transport-shaped errors; anything
                     # else (bad URL scheme → ValueError, garbled response →

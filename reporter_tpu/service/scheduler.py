@@ -49,6 +49,8 @@ import time
 from collections import deque
 from typing import TYPE_CHECKING, Sequence
 
+from reporter_tpu.utils import tracing
+
 if TYPE_CHECKING:                            # pragma: no cover
     from reporter_tpu.matcher.api import Trace
     from reporter_tpu.service.app import ReporterApp
@@ -122,6 +124,7 @@ class BatchScheduler:
         self._cv = threading.Condition()
         self._queue: "deque[_ScheduledSubmission]" = deque()
         self._queued_traces = 0
+        self._dispatch_serial = 0      # batch id for trace spans (under _cv)
         self._inflight = 0
         self._inflight_uuids: set[str] = set()
         self._closed = False
@@ -158,19 +161,30 @@ class BatchScheduler:
         with self._cv:
             if self._closed:
                 raise ServiceOverloaded("service is shutting down")
-            if self._queued_traces + len(pairs) > self.limit and self._queue:
+            queued = self._queued_traces
+            if queued + len(pairs) > self.limit and self._queue:
                 # Always admit into an empty queue: a single oversized
                 # report_many must not be unservable.
                 with self._stats_lock:
                     self.stats["rejected"] += 1
-                raise ServiceOverloaded(
-                    f"admission queue full ({self._queued_traces} traces "
-                    f"queued, limit {self.limit})")
-            sub = _ScheduledSubmission(pairs, self._clock())
-            self._queue.append(sub)
-            self._queued_traces += len(pairs)
-            self.metrics.gauge("sched_admission_depth", len(self._queue))
-            self._cv.notify_all()
+                sub = None
+            else:
+                sub = _ScheduledSubmission(pairs, self._clock())
+                self._queue.append(sub)
+                self._queued_traces += len(pairs)
+                self.metrics.gauge("sched_admission_depth",
+                                   len(self._queue))
+                self._cv.notify_all()
+        if sub is None:
+            # post-mortem OUTSIDE _cv: dumping the ring is disk I/O and
+            # must not stall every concurrent submit() plus the dispatch
+            # thread at exactly the overload peak (the other fault sites
+            # all dump outside their locks too)
+            tracing.post_mortem("shed", failing="admission",
+                                queued_traces=queued, limit=self.limit)
+            raise ServiceOverloaded(
+                f"admission queue full ({queued} traces "
+                f"queued, limit {self.limit})")
         while not sub.done.wait(timeout=5.0):
             with self._cv:
                 closed = self._closed
@@ -207,8 +221,13 @@ class BatchScheduler:
                 # hand off UNDER _cv: close() clears the queue and enqueues
                 # the worker sentinels in one _cv section, so a dispatched
                 # batch is always FIFO-ahead of every sentinel — a job can
-                # never land behind them and starve its clients
-                self._work.put((batch, uuids))
+                # never land behind them and starve its clients. The batch
+                # serial rides in the job: a worker reading a shared
+                # counter later would race other dispatches' increments
+                # and mis-tag its trace spans.
+                serial = self._dispatch_serial
+                self._dispatch_serial += 1
+                self._work.put((batch, uuids, serial))
             now = self._clock()
             for s in batch:
                 self.metrics.observe("sched_queue_age_seconds",
@@ -268,30 +287,14 @@ class BatchScheduler:
 
     # ---- executor side ---------------------------------------------------
 
-    def _run_batch(self, batch: "list[_ScheduledSubmission]", uuids) -> None:
+    def _run_batch(self, batch: "list[_ScheduledSubmission]", uuids,
+                   serial: int) -> None:
         try:
             combined = [pair for s in batch for pair in s.pairs]
-            try:
-                results = self.app._process_validated(combined)
-                lo = 0
-                for s in batch:
-                    s.results = results[lo:lo + len(s.pairs)]
-                    lo += len(s.pairs)
-            except Exception:
-                # Error isolation: retry per submission, in arrival order
-                # (preserves duplicate-uuid sequencing). A request that
-                # fails ALONE owns its error; co-batched requests are
-                # still served. Single-submission batches skip the retry
-                # — the batched attempt WAS the isolated attempt.
-                if len(batch) == 1:
-                    raise
-                with self._stats_lock:
-                    self.stats["isolated_retries"] += 1
-                for s in batch:
-                    try:
-                        s.results = self.app._process_validated(s.pairs)
-                    except Exception as exc:
-                        s.error = exc
+            with tracing.tracer().span("sched_batch", wave=serial,
+                                       submissions=len(batch),
+                                       traces=len(combined)):
+                self._run_batch_traced(batch, combined)
         except Exception as exc:
             for s in batch:
                 s.error = exc
@@ -303,6 +306,30 @@ class BatchScheduler:
                 self._cv.notify_all()
             for s in batch:
                 s.done.set()
+
+    def _run_batch_traced(self, batch: "list[_ScheduledSubmission]",
+                          combined) -> None:
+        try:
+            results = self.app._process_validated(combined)
+            lo = 0
+            for s in batch:
+                s.results = results[lo:lo + len(s.pairs)]
+                lo += len(s.pairs)
+        except Exception:
+            # Error isolation: retry per submission, in arrival order
+            # (preserves duplicate-uuid sequencing). A request that
+            # fails ALONE owns its error; co-batched requests are
+            # still served. Single-submission batches skip the retry
+            # — the batched attempt WAS the isolated attempt.
+            if len(batch) == 1:
+                raise
+            with self._stats_lock:
+                self.stats["isolated_retries"] += 1
+            for s in batch:
+                try:
+                    s.results = self.app._process_validated(s.pairs)
+                except Exception as exc:
+                    s.error = exc
 
     # ---- shape-bucket padding -------------------------------------------
 

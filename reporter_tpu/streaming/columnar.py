@@ -44,6 +44,7 @@ from reporter_tpu.service.datastore import DatastorePublisher, Transport
 from reporter_tpu.streaming.histogram import SpeedHistogram
 from reporter_tpu.streaming.queue import partition_of
 from reporter_tpu.tiles.tileset import TileSet
+from reporter_tpu.utils import tracing
 
 
 # ---------------------------------------------------------------------------
@@ -593,7 +594,8 @@ class _InflightWave:
     it — at-least-once, never lost."""
 
     __slots__ = ("id", "future", "uuids", "merged", "codes", "holds",
-                 "arrive", "n_points", "published")
+                 "arrive", "n_points", "published",
+                 "t_prep0", "t_submit", "t_result")
 
     def __init__(self, wid: int, codes: np.ndarray,
                  holds: "list[tuple[int, int]]", arrive: np.ndarray,
@@ -607,6 +609,15 @@ class _InflightWave:
         self.arrive = arrive
         self.n_points = int(n_points)
         self.published = False      # set by the publisher's on_done
+        # latency-attribution timestamps (pipeline clock base): prepare
+        # entered / match submitted / match result in hand. Always
+        # stamped (three clock() calls per wave); only ACCUMULATED into
+        # stage samples when the tracer is enabled. None = not yet
+        # stamped — an injected clock may legitimately read 0.0, so the
+        # unset sentinel must not be a falsy float.
+        self.t_prep0: "float | None" = None
+        self.t_submit = 0.0
+        self.t_result = 0.0
 
 
 class ColumnarStreamPipeline:
@@ -735,6 +746,38 @@ class ColumnarStreamPipeline:
         # (_LAT_SAMPLES_CAP) so a reader-less worker stays flat-RSS.
         self.last_flush_latency: "np.ndarray | None" = None
 
+        # span tracing / latency attribution (utils/tracing.py): the
+        # PROCESS-GLOBAL recorder, optionally switched on by this
+        # pipeline's ServiceConfig. When enabled, each completed wave
+        # records its stage spans (broker_dwell → prepare →
+        # device_match → report_build (+ publish)) wave-tagged into the
+        # flight recorder, and per-probe stage components accumulate for
+        # ``take_stage_samples()`` (same take-and-reset + newest-N
+        # discipline as last_flush_latency) — the components TELESCOPE:
+        # per probe,
+        # dwell + prepare + match + build == the last_flush_latency
+        # sample exactly, which is what lets the bench assert the
+        # attribution reconciles with the measured end-to-end p50.
+        tracing.configure_from_service(svc)
+        self._tracer = tracing.tracer()
+        # per-WAVE chunk list, concatenated once in take_stage_samples():
+        # re-concatenating the accumulated history every completed wave
+        # would be O(total^2/wave) memcpy charged to the traced soak arm
+        # — inflating exactly the overhead number the bench A/B records
+        self._stage_chunks: "list[dict[str, np.ndarray]]" = []
+        self._stage_count = 0
+        self._publish_durs: "list[float]" = []   # per-wave publish
+        #                                          enqueue→completion
+        #                                          seconds (async leg:
+        #                                          INCLUDES publisher
+        #                                          queue dwell and
+        #                                          retry/backoff — time
+        #                                          to durable publish,
+        #                                          not one POST's wire
+        #                                          time; lands after the
+        #                                          e2e cut, reported as
+        #                                          its own stage)
+
     # ---- one poll/flush cycle -------------------------------------------
 
     def step(self, force_flush: bool = False) -> int:
@@ -809,12 +852,13 @@ class ColumnarStreamPipeline:
 
     def _poll_all(self, max_records: int) -> None:
         from reporter_tpu.streaming.state import poll_with_overrun_skip
-        for p in self.partitions:
-            batches = poll_with_overrun_skip(self, self._poll_batches, p,
-                                             max_records)
-            for offs, cols in batches:
-                self._consume_columns(p, offs, cols)
-                self._consumed[p] = int(offs[-1]) + 1
+        with self._tracer.span("consume"):
+            for p in self.partitions:
+                batches = poll_with_overrun_skip(self, self._poll_batches,
+                                                 p, max_records)
+                for offs, cols in batches:
+                    self._consume_columns(p, offs, cols)
+                    self._consumed[p] = int(offs[-1]) + 1
 
     def _without_busy(self, ripe: np.ndarray) -> np.ndarray:
         """Codes already in an unharvested wave must wait: their cache
@@ -948,6 +992,7 @@ class ColumnarStreamPipeline:
         """Select the ripe rows, merge cache tails, and build the matcher
         traces (the host leg, caller's thread). The rows stay in the log
         marked held=wave-id until the result is processed."""
+        t_prep0 = self.clock()
         L = self._log
         mask = np.isin(L.code[:L.n], ripe_codes) & (L.held[:L.n] == 0)
         rows = np.nonzero(mask)[0]
@@ -1005,6 +1050,8 @@ class ColumnarStreamPipeline:
                              n_points=int(lens.sum()))
         wave.uuids = uuids
         wave.merged = merged
+        wave.t_prep0 = t_prep0
+        wave.t_submit = self.clock()
         L.held[rows] = wave.id
         self._count[ripe_codes] = 0
         return wave, traces
@@ -1043,6 +1090,7 @@ class ColumnarStreamPipeline:
             wave = self._inflight.pop(0)
             try:
                 result, match_dt = wave.future.result()
+                wave.t_result = self.clock()
                 n += self._complete_wave(wave, result, match_dt)
             except DispatchTimeout:
                 # graceful degradation, not death: the watchdog bounded a
@@ -1100,6 +1148,7 @@ class ColumnarStreamPipeline:
         wave, traces = prep
         try:
             result, match_dt = self._timed_match(traces)
+            wave.t_result = self.clock()
             return self._complete_wave(wave, result, match_dt)
         except DispatchTimeout:
             self._release_failed(wave)
@@ -1128,7 +1177,10 @@ class ColumnarStreamPipeline:
 
         # flushed rows leave the buffer; retained tails live in the cache
         L = self._log
-        lat = self.clock() - wave.arrive
+        t_done = self.clock()
+        lat = t_done - wave.arrive
+        if self._tracer.enabled:
+            self._record_wave_stages(wave, t_done, lat)
         # ACCUMULATE between reads: drain() completes many waves in one
         # call, and overwriting would silently discard every wave's
         # samples but the last — biasing p50/p99 low exactly for the
@@ -1145,6 +1197,64 @@ class ColumnarStreamPipeline:
         L.compact(L.held[:L.n] != wave.id)
         self.waves_completed += 1
         return n
+
+    def _record_wave_stages(self, wave: _InflightWave, t_done: float,
+                            lat: np.ndarray) -> None:
+        """Tracing-enabled wave bookkeeping: emit the wave's stage spans
+        into the flight recorder and accumulate the per-probe stage
+        components. The components partition each probe's timeline at
+        the wave's recorded boundaries, so per probe they sum EXACTLY to
+        its last_flush_latency sample — the reconciliation the bench leg
+        asserts is arithmetic, not coincidence."""
+        tr = self._tracer
+        n = len(wave.arrive)
+        if n and wave.t_prep0 is not None:
+            tr.add("broker_dwell", float(wave.arrive.min()), wave.t_prep0,
+                   wave=wave.id, points=wave.n_points)
+            tr.add("prepare", wave.t_prep0, wave.t_submit, wave=wave.id)
+            tr.add("device_match", wave.t_submit, wave.t_result,
+                   wave=wave.id, traces=len(wave.uuids))
+            tr.add("report_build", wave.t_result, t_done, wave=wave.id)
+            comp = {
+                "broker_dwell": wave.t_prep0 - wave.arrive,
+                "prepare": np.full(n, wave.t_submit - wave.t_prep0),
+                "device_match": np.full(n, wave.t_result - wave.t_submit),
+                "report_build": np.full(n, t_done - wave.t_result),
+                "e2e": lat,
+            }
+            self._stage_chunks.append(comp)
+            self._stage_count += n
+            # newest-N bound at whole-wave granularity (take trims to
+            # the exact cap): a reader-less traced worker stays flat-RSS
+            while (len(self._stage_chunks) > 1
+                   and self._stage_count - len(self._stage_chunks[0]["e2e"])
+                   >= self._LAT_SAMPLES_CAP):
+                dropped = self._stage_chunks.pop(0)
+                self._stage_count -= len(dropped["e2e"])
+
+    def take_stage_samples(self) -> "dict[str, np.ndarray] | None":
+        """Take-and-reset the accumulated per-probe stage components
+        (None when tracing was off or nothing flushed). The arrays are
+        parallel: row i of every stage belongs to the same probe, and
+        the non-'e2e' stages sum to 'e2e' row-wise. 'publish' rides
+        separately (per-wave POST attempt seconds — it completes after
+        the probe→report cut on the async publisher)."""
+        chunks, self._stage_chunks = self._stage_chunks, []
+        self._stage_count = 0
+        out = None
+        if chunks:
+            out = {k: np.concatenate([c[k] for c in chunks])
+                   for k in chunks[0]}
+            if len(out["e2e"]) > self._LAT_SAMPLES_CAP:
+                out = {k: v[-self._LAT_SAMPLES_CAP:]
+                       for k, v in out.items()}
+        if out is not None and self._publish_durs:
+            # swap FIRST, convert after: copy-then-reset would drop any
+            # duration the async publisher thread appends between the
+            # two statements
+            durs, self._publish_durs = self._publish_durs, []
+            out = dict(out, publish=np.asarray(durs))
+        return out
 
     def _reports_from_columns(self, batch: MatchBatch,
                               wave: _InflightWave) -> int:
@@ -1185,9 +1295,16 @@ class ColumnarStreamPipeline:
         socket wait; the sync publisher calls it before returning — one
         code path, two latencies."""
         self._pending.append(wave)
+        traced = self._tracer.enabled
+        t_pub0 = self.clock() if traced else 0.0
 
         def _done(ok: bool, w=wave) -> None:
             w.published = True      # plain attribute flip: GIL-atomic
+            if traced:
+                t1 = self.clock()
+                self._tracer.add("publish", t_pub0, t1, wave=w.id, ok=ok)
+                if len(self._publish_durs) < 65536:   # reader-less bound
+                    self._publish_durs.append(t1 - t_pub0)
 
         getattr(self.publisher, method)(*args, on_done=_done)
 

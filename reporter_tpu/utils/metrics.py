@@ -14,9 +14,22 @@ are threaded), and snapshot() renders a plain-dict view for /stats or logs.
 
 from __future__ import annotations
 
+import bisect
+import re
 import threading
 import time
 from typing import Any
+
+# Fixed histogram bucket upper bounds (seconds-scale, matching the
+# stage-timer series this registry mostly holds). FIXED, not adaptive:
+# Prometheus histogram_quantile aggregates across workers only when every
+# exposition shares the same ``le`` grid, and a capture's buckets must
+# mean the same thing run over run. Dimensionless series (occupancies,
+# ratios) land in the low buckets — still monotone, still aggregable.
+HISTOGRAM_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 class _Reservoir:
@@ -41,8 +54,13 @@ class _Reservoir:
         if not self._buf:
             return float("nan")
         s = sorted(self._buf)
-        i = min(len(s) - 1, max(0, int(q * (len(s) - 1) + 0.5)))
-        return s[i]
+        return _pick(s, q)
+
+
+def _pick(sorted_buf: "list[float]", q: float) -> float:
+    i = min(len(sorted_buf) - 1,
+            max(0, int(q * (len(sorted_buf) - 1) + 0.5)))
+    return sorted_buf[i]
 
 
 class StageTimer:
@@ -76,6 +94,10 @@ class MetricsRegistry:
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._series: dict[str, _Reservoir] = {}
+        # per-series fixed-bucket cumulative counts (len(BUCKETS)+1, the
+        # last slot is +Inf) for the Prometheus histogram exposition —
+        # reservoirs forget history by design, histograms must not
+        self._hist: dict[str, list[int]] = {}
         self._born = time.time()
 
     # ---- write side ------------------------------------------------------
@@ -97,7 +119,10 @@ class MetricsRegistry:
             r = self._series.get(name)
             if r is None:
                 r = self._series[name] = _Reservoir()
+                self._hist[name] = [0] * (len(HISTOGRAM_BUCKETS) + 1)
             r.add(value)
+            self._hist[name][bisect.bisect_left(HISTOGRAM_BUCKETS,
+                                                value)] += 1
             self._counters[name + "_total"] = (
                 self._counters.get(name + "_total", 0.0) + value)
             self._counters[name + "_count"] = (
@@ -118,18 +143,77 @@ class MetricsRegistry:
             return self._gauges.get(name, 0.0)
 
     def snapshot(self) -> dict[str, Any]:
-        """Plain-dict view: counters + gauges verbatim + p50/p95 per series
-        + derived rates for the north-star metrics when their inputs
-        exist."""
+        """Plain-dict view: counters + gauges verbatim + p50/p95/p99 per
+        series + derived rates for the north-star metrics when their
+        inputs exist. The sample buffers are COPIED out under the lock
+        and sorted outside it — a snapshot with many fat series must not
+        stall every concurrent count()/observe() on its O(n log n)."""
         with self._lock:
             out: dict[str, Any] = dict(self._counters)
             out.update(self._gauges)
-            for name, r in self._series.items():
-                out[name + "_p50"] = r.quantile(0.50)
-                out[name + "_p95"] = r.quantile(0.95)
-            probes = out.get("probes", 0.0)
-            busy = out.get("match_seconds_total", 0.0)
-            if probes and busy:
-                out["probes_per_sec_busy"] = probes / busy
-            out["uptime_seconds"] = time.time() - self._born
-            return out
+            bufs = {name: list(r._buf) for name, r in self._series.items()}
+        for name, buf in bufs.items():
+            if buf:
+                buf.sort()
+                out[name + "_p50"] = _pick(buf, 0.50)
+                out[name + "_p95"] = _pick(buf, 0.95)
+                out[name + "_p99"] = _pick(buf, 0.99)
+            else:
+                nan = float("nan")
+                out[name + "_p50"] = nan
+                out[name + "_p95"] = nan
+                out[name + "_p99"] = nan
+        probes = out.get("probes", 0.0)
+        busy = out.get("match_seconds_total", 0.0)
+        if probes and busy:
+            out["probes_per_sec_busy"] = probes / busy
+        out["uptime_seconds"] = time.time() - self._born
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of the whole
+        registry: counters and gauges verbatim, each observation series
+        as a histogram over the FIXED ``HISTOGRAM_BUCKETS`` grid (the
+        reservoir percentiles stay a /stats affordance; scrapers get
+        aggregable cumulative buckets). Names are prefixed ``rtpu_`` and
+        sanitized to the exposition charset."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {name: (list(h),
+                            self._counters.get(name + "_total", 0.0),
+                            int(self._counters.get(name + "_count", 0.0)))
+                     for name, h in self._hist.items()}
+        lines: list[str] = []
+
+        def _name(raw: str) -> str:
+            return "rtpu_" + _PROM_NAME.sub("_", raw)
+
+        # series aggregates re-emit as the histogram's _sum/_count below
+        shadow = {k + suffix for k in hists for suffix in
+                  ("_total", "_count")}
+        for key, value in sorted(counters.items()):
+            if key in shadow:
+                continue
+            n = _name(key)
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {float(value)}")
+        for key, value in sorted(gauges.items()):
+            n = _name(key)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {float(value)}")
+        n = _name("uptime_seconds")
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {time.time() - self._born}")
+        for key, (buckets, total, count) in sorted(hists.items()):
+            n = _name(key)
+            lines.append(f"# TYPE {n} histogram")
+            cum = 0
+            for le, c in zip(HISTOGRAM_BUCKETS, buckets):
+                cum += c
+                lines.append(f'{n}_bucket{{le="{le}"}} {cum}')
+            cum += buckets[-1]
+            lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{n}_sum {float(total)}")
+            lines.append(f"{n}_count {count}")
+        return "\n".join(lines) + "\n"

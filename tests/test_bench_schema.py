@@ -184,6 +184,90 @@ def test_recovery_leg_schema_keys():
         assert f'"{key}"' in src_o, key
 
 
+ATTRIBUTION_STAGES = ("broker_dwell", "prepare", "device_match",
+                      "report_build")
+
+ATTRIBUTION_KEYS = {
+    "samples", "stages", "e2e_p50_ms", "e2e_p99_ms", "stage_sum_p50_ms",
+    "stage_sum_over_e2e_p50", "reconciles_within_15pct",
+}
+
+
+def test_latency_attribution_schema_and_reconciliation():
+    """Pin the detail.latency_attribution stage decomposition (ISSUE 5):
+    stage names, the reconciliation field, and the telescoping invariant
+    — per-probe stage components that sum exactly to e2e must reconcile
+    at the p50 level within the acceptance bound."""
+    bench = _load_bench()
+    rng = np.random.default_rng(8)
+    n = 500
+    parts = {
+        "broker_dwell": rng.uniform(0.05, 0.8, n),
+        "prepare": rng.uniform(0.001, 0.01, n),
+        "device_match": rng.uniform(0.02, 0.3, n),
+        "report_build": rng.uniform(0.001, 0.02, n),
+    }
+    samples = dict(parts, e2e=sum(parts.values()),
+                   publish=rng.uniform(0.01, 0.1, 40))
+    out = bench._attribution_from_samples(samples)
+    assert ATTRIBUTION_KEYS <= set(out)
+    assert set(out["stages"]) == set(ATTRIBUTION_STAGES) | {"publish"}
+    for name in ATTRIBUTION_STAGES:
+        st = out["stages"][name]
+        assert st["p50_ms"] >= 0 and st["p99_ms"] >= 0
+    assert out["samples"] == n
+    # the components are CONDITIONAL on the e2e quantile window (what
+    # the median probe's time was spent on), so the telescoping
+    # partition makes their sum track the e2e p50 with only
+    # window-mean-vs-percentile slack — reconciliation is structural,
+    # not a property of these particular magnitudes
+    assert out["reconciles_within_15pct"] is True
+    assert abs(out["stage_sum_over_e2e_p50"] - 1.0) <= 0.05
+    # the p99 decomposition tracks the e2e p99 the same way
+    sum_p99 = sum(out["stages"][k]["p99_ms"] for k in ATTRIBUTION_STAGES)
+    assert abs(sum_p99 / out["e2e_p99_ms"] - 1.0) <= 0.05
+    # publish is reported but EXCLUDED from the reconciling sum (it
+    # completes after the probe→report cut)
+    s = sum(out["stages"][k]["p50_ms"] for k in ATTRIBUTION_STAGES)
+    assert abs(s - out["stage_sum_p50_ms"]) < 0.02
+
+    empty = bench._attribution_from_samples(None)
+    assert ATTRIBUTION_KEYS <= set(empty)
+    assert empty["samples"] == 0
+    assert empty["reconciles_within_15pct"] is None
+
+
+def test_latency_attribution_leg_records_overhead_ab():
+    """The tracing-overhead A/B (traced vs untraced soak at the same
+    offer) must stay a recorded field in every capture — regressions in
+    the off-path cost must be visible run over run."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._latency_attribution)
+    for key in ("sustained_pps_traced", "sustained_pps_untraced",
+                "tracing_overhead_pct", "offered_pps", "service_face"):
+        assert f'"{key}"' in src, key
+
+
+def test_summary_line_carries_lattr_token():
+    """lattr = [e2e p50 ms, stage-sum/e2e ratio, tracing overhead %]."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "latency_attribution": {
+                   "e2e_p50_ms": 2481.5,
+                   "stage_sum_over_e2e_p50": 1.0312,
+                   "tracing_overhead_pct": 1.27},
+           }}
+    line = bench._summary_line(doc)
+    assert line["lattr"] == [2481.5, 1.0312, 1.27]
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["lattr"] == [None] * 3
+
+
 def test_service_overload_boundary_rules():
     bench = _load_bench()
 

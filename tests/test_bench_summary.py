@@ -92,6 +92,9 @@ def _synthetic_doc():
                      "lost_reports": 0},
         "publish_outage": {"dead_letter_pending_end": 0},
         "streaming_soak_mp": {"speedup_2v1": 0.912},
+        "latency_attribution": {"e2e_p50_ms": 12481.57,
+                                "stage_sum_over_e2e_p50": 1.0312,
+                                "tracing_overhead_pct": -1.27},
         "total_seconds": 801.5,
     }
     return {"metric": "probes_per_sec_e2e", "value": 2280000.1,
