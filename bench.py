@@ -2096,6 +2096,213 @@ def _run_chaos_legs(ts, traces, detail: dict, split: dict) -> None:
         shutil.rmtree(chaos_dir, ignore_errors=True)
 
 
+def _fleet_bench(tpu_ok: bool, n_metros: int = 8) -> dict:
+    """ISSUE 6 tentpole evidence: N>=8 generated metros served
+    concurrently from ONE process through the fleet residency layer
+    (reporter_tpu/fleet/). Three phases: (1) steady-state mixed traffic
+    with the whole fleet resident (unbounded budget) — submitter threads
+    round-robin every metro, each dispatch under a residency lease;
+    (2) a cold-metro promotion storm — the budget shrinks to ~half the
+    fleet's staged bytes, then cyclic touches make every request a miss
+    (LRU's worst case), so each one pays a counted, traced promotion and
+    an eviction; (3) a per-metro fidelity audit AFTER the storm's
+    evict→promote cycles: harvested wire bytes must equal both a
+    dedicated single-metro SegmentMatcher's and the metro's own
+    pre-paging harvest, byte for byte. Metros get DISTINCT topologies
+    (per-metro seeds) and disjoint bboxes (shifted centers) — clones
+    would share compiled shapes and understate the fleet's real cost.
+    CPU-forced runs validate the full leg at tiny scale (the r7
+    BENCH_DETAIL_CPU.json convention)."""
+    import threading as _threading
+
+    import numpy as np
+
+    from reporter_tpu.config import CompilerParams, Config
+    from reporter_tpu.fleet import FleetResidency
+    from reporter_tpu.matcher.api import SegmentMatcher, Trace
+    from reporter_tpu.netgen.synthetic import generate_city
+    from reporter_tpu.netgen.traces import synthesize_fleet
+    from reporter_tpu.tiles.compiler import compile_network
+
+    nx = ny = 8 if tpu_ok else 6
+    n_tr = 24 if tpu_ok else 6          # traces per metro
+    n_pt = 60 if tpu_ok else 40         # points per trace
+    workers = 4 if tpu_ok else 2
+    rounds = 3 if tpu_ok else 2
+    storm_cycles = 2 if tpu_ok else 1
+
+    cfg = Config(matcher_backend="jax")
+    t0 = time.perf_counter()
+    tilesets = []
+    fleets: dict = {}
+    for i in range(n_metros):
+        net = generate_city("tiny", nx=nx, ny=ny, seed=60 + i,
+                            center=(-125.0 + i * 0.7, 38.0))
+        net.name = f"fleet{i:02d}"
+        ts = compile_network(net, CompilerParams(reach_radius=500.0))
+        tilesets.append(ts)
+        probes = synthesize_fleet(ts, n_tr, num_points=n_pt, seed=9 + i)
+        fleets[ts.name] = [Trace(uuid=f"f{i}-{j}", xy=p.xy, times=p.times)
+                           for j, p in enumerate(probes)]
+    build_s = time.perf_counter() - t0
+    names = [ts.name for ts in tilesets]
+
+    def _wire(m, traces) -> bytes:
+        """Raw device wire bytes in submission order — the byte-level
+        artifact the bit-identity contract pins (same harvest as
+        tests/test_fleet.py)."""
+        _, inflight = m._submit_many(traces)
+        return b"".join(np.asarray(a).tobytes() for _, a in inflight)
+
+    fr = FleetResidency(tilesets, cfg)      # unbounded: no paging yet
+    # warm: promote every metro + compile its batch shape (untimed),
+    # then harvest the pre-paging reference wires
+    pre_wires = {}
+    for n in names:
+        with fr.lease(n) as m:
+            m.match_many(fleets[n])
+            pre_wires[n] = _wire(m, fleets[n])
+    total_bytes = fr.resident_bytes
+
+    # -- phase 1: steady-state mixed traffic, whole fleet resident ------
+    jobs = [n for _ in range(rounds) for n in names]
+    cursor = {"i": 0}
+    lock = _threading.Lock()
+    busy = {n: 0.0 for n in names}
+    probes_done = {n: 0 for n in names}
+    errors: list = []
+
+    def _submitter():
+        while True:
+            with lock:
+                if cursor["i"] >= len(jobs):
+                    return
+                name = jobs[cursor["i"]]
+                cursor["i"] += 1
+            try:
+                t1 = time.perf_counter()
+                with fr.lease(name) as m:
+                    m.match_many(fleets[name])
+                dt = time.perf_counter() - t1
+                with lock:
+                    busy[name] += dt
+                    probes_done[name] += sum(len(t.xy)
+                                             for t in fleets[name])
+            except Exception as exc:    # recorded, not raised: the leg
+                # must finish and report — and the worker moves on to
+                # the next job (exiting would silently degrade measured
+                # concurrency for the rest of the phase while the
+                # artifact still records the nominal worker count)
+                with lock:
+                    if len(errors) < 32:
+                        errors.append(repr(exc))
+
+    t0 = time.perf_counter()
+    threads = [_threading.Thread(target=_submitter)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mixed_wall = time.perf_counter() - t0
+
+    # -- phase 2: cold-metro promotion storm through a half-size budget -
+    cap = max(1, int(total_bytes * 0.5))
+    fr.set_capacity(cap)
+    storm_lat: list = []
+    storm_promote: list = []
+    t0 = time.perf_counter()
+    for _ in range(storm_cycles):
+        for name in names:              # cyclic touches: every one a miss
+            t1 = time.perf_counter()
+            # page-in timed apart from the dispatch: the run-wide
+            # fleet_promote_seconds histogram also holds the warm-phase
+            # and audit promotions (first HBM placements — systematically
+            # different), so the storm's paging quantiles must come from
+            # the storm's own samples
+            fr.promote(name)
+            t2 = time.perf_counter()
+            with fr.lease(name) as m:
+                m.match_many(fleets[name])
+            storm_promote.append(t2 - t1)
+            storm_lat.append(time.perf_counter() - t1)
+    storm_wall = time.perf_counter() - t0
+
+    # -- phase 3: per-metro fidelity audit (post evict→promote cycles) --
+    fidelity: dict = {}
+    for ts in tilesets:
+        name = ts.name
+        dedicated = SegmentMatcher(ts, cfg)
+        want = _wire(dedicated, fleets[name])
+        with fr.lease(name) as m:
+            got = _wire(m, fleets[name])
+        occ_m = fr.occupancy()["metros"][name]
+        fidelity[name] = {
+            "wire_identical_to_dedicated": got == want,
+            "wire_identical_after_paging": got == pre_wires[name],
+            "promotions": occ_m["promotions"],
+            "demotions": occ_m["demotions"],
+        }
+        del dedicated
+    occ = fr.occupancy()
+
+    def _pq(q, xs=storm_lat):
+        # np.percentile, like every other quantile in the artifact —
+        # mixing estimators across legs would skew cross-leg reads
+        return round(float(np.percentile(xs, q * 100)) * 1e3, 2)
+
+    return {
+        "config": (f"{n_metros} metros ({nx}x{ny} grid, distinct "
+                   f"topologies), {n_tr}x{n_pt}pt traces/metro, "
+                   f"storm budget = 50% of staged bytes"),
+        "n_metros": n_metros,
+        "build_seconds": round(build_s, 1),
+        "staged_bytes_total": int(total_bytes),
+        "mixed": {
+            "workers": workers,
+            "rounds": rounds,
+            "wall_seconds": round(mixed_wall, 2),
+            # numerator = probes actually matched (an errored worker
+            # leaves jobs unexecuted; the nominal count would inflate
+            # the recorded rate)
+            "probes_per_sec": round(
+                sum(probes_done.values()) / mixed_wall, 1),
+            # per-metro service rate over that metro's own busy time
+            # (wall is shared by the round-robin); exact per-metro probe
+            # counts ride along for reconstruction
+            "per_metro_kpps": {
+                n: (round(probes_done[n] / busy[n] / 1e3, 1)
+                    if busy[n] else None) for n in names},
+            **({"errors": errors[:4]} if errors else {}),
+        },
+        "storm": {
+            "capacity_bytes": cap,
+            "touches": n_metros * storm_cycles,
+            "wall_seconds": round(storm_wall, 2),
+            "promote_p50_ms": _pq(0.50, storm_promote),
+            "promote_p99_ms": _pq(0.99, storm_promote),
+            "promote_to_first_report_p50_ms": _pq(0.50),
+            "promote_to_first_report_p99_ms": _pq(0.99),
+        },
+        "occupancy": occ,
+        "fidelity": {
+            # the acceptance bit: every metro's post-storm wires equal
+            # BOTH its dedicated matcher's and its own pre-paging harvest
+            "wires_bit_identical": all(
+                f["wire_identical_to_dedicated"]
+                and f["wire_identical_after_paging"]
+                for f in fidelity.values()),
+            "wires_identical_to_dedicated": all(
+                f["wire_identical_to_dedicated"]
+                for f in fidelity.values()),
+            "wires_identical_after_paging": all(
+                f["wire_identical_after_paging"]
+                for f in fidelity.values()),
+            "per_metro": fidelity,
+        },
+    }
+
+
 def _provenance(tpu_ok: bool) -> dict:
     """Self-describing capture stamp (ISSUE-4 satellite): git sha + an
     optional round label, so a stale BENCH_DETAIL.json can never again
@@ -2756,6 +2963,19 @@ def main() -> None:
             offered_pps=(50_000 if tpu_ok else 2_000), seconds=5.0)
         split["latency_attribution_s"] = round(time.perf_counter() - t0, 1)
 
+    # Metro fleet residency (ISSUE 6) runs on EVERY composite: N>=8
+    # generated metros served from this one process — steady-state mixed
+    # traffic, a cold-metro promotion storm through a half-size budget,
+    # and the per-metro wire-byte fidelity audit. Chip runs size it up;
+    # manual/CPU runs validate the full leg at tiny scale (the r7
+    # BENCH_DETAIL_CPU.json convention).
+    t0 = time.perf_counter()
+    detail["fleet"] = _fleet_bench(tpu_ok)
+    # NOT split["fleet_s"] — that key is the trace-FLEET synthesis
+    # timing in setup_seconds' sum; clobbering it would silently change
+    # what setup_seconds measures run over run
+    split["fleet_residency_s"] = round(time.perf_counter() - t0, 1)
+
     detail["setup_split"] = split
     detail["setup_seconds"] = round(
         split["device_probe_s"] + split["tile_s"] + split["fleet_s"], 1)
@@ -2811,6 +3031,8 @@ def _summary_line(doc: dict) -> dict:
     if all(v is None for v in tiles_kpps[1:]):
         tiles_kpps = tiles_kpps[:1]     # sparse runs: just the headline
     per_tile = _g("audit", "per_tile", default={})
+    fleet_pps = _g("fleet", "mixed", "probes_per_sec")
+    fleet_bit = _g("fleet", "fidelity", "wires_bit_identical")
     summary = {
         "metric": doc["metric"],
         "value": doc["value"],
@@ -2895,6 +3117,17 @@ def _summary_line(doc: dict) -> dict:
         "lattr": [_g("latency_attribution", "e2e_p50_ms"),
                   _g("latency_attribution", "stage_sum_over_e2e_p50"),
                   _g("latency_attribution", "tracing_overhead_pct")],
+        # fleet residency headline (full leg in detail.fleet): [metros
+        # served from one process, mixed-traffic kpps, storm promotion
+        # p50 ms, total promotions, total demotions, fleet wires
+        # byte-identical through paging (must be 1)]
+        "fleet": [
+            _g("fleet", "n_metros"),
+            None if fleet_pps is None else int(fleet_pps / 1e3),
+            _g("fleet", "storm", "promote_p50_ms"),
+            _g("fleet", "occupancy", "promotions"),
+            _g("fleet", "occupancy", "demotions"),
+            None if fleet_bit is None else int(bool(fleet_bit))],
         # first overloaded client level (None = survived the whole curve)
         "svc_edge": _g("service_overload_boundary", "clients"),
         # serving-face A/B headline (full curves + open loop in detail):

@@ -40,6 +40,10 @@ def __getattr__(name):  # lazy top-level API (avoids importing jax on
         "parse_osm_xml": ("reporter_tpu.netgen.osm_xml", "parse_osm_xml"),
         "make_app": ("reporter_tpu.service.app", "make_app"),
         "make_router": ("reporter_tpu.service.router", "make_router"),
+        "make_fleet_router": ("reporter_tpu.fleet.router",
+                              "make_fleet_router"),
+        "FleetConfig": ("reporter_tpu.fleet.residency", "FleetConfig"),
+        "MetroSLO": ("reporter_tpu.fleet.router", "MetroSLO"),
         "KafkaProbeConsumer": ("reporter_tpu.streaming.kafka_adapter",
                                "KafkaProbeConsumer"),
     }

@@ -28,6 +28,10 @@ Injection SITES (each consults the active plan at one seam):
               sleeps like the axon tunnel does (it hangs, it does not
               error: CLAUDE.md), which is what the dispatch watchdog
               exists to bound
+  fleet_promote  fleet residency page-in (fleet/residency.py) — fires
+              inside the guarded device_put body, so an injected hang
+              stalls a promotion exactly where a dead tunnel would
+              (bounded by ``FleetConfig.promote_timeout_s``)
 
 Rules are windows over a per-site CALL COUNTER (0-based), so a plan is
 deterministic run to run regardless of wall clock; the optional ``p``
@@ -54,7 +58,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-SITES = ("publish", "checkpoint", "broker", "dispatch")
+SITES = ("publish", "checkpoint", "broker", "dispatch", "fleet_promote")
 KINDS = ("fail", "crash", "hang", "torn")
 
 

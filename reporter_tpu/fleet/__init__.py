@@ -1,0 +1,24 @@
+"""Metro fleet residency: serve many metros per chip (ROADMAP item 1).
+
+  residency.py   FleetResidency — registry of compiled metro tables,
+                 HBM occupancy ledger, hot/cold tiers, LRU paging with
+                 watermark + pin policy, traced/counted promotion
+  router.py      FleetRouter — MetroRouter's geo dispatch over the
+                 paged fleet, per-metro SLOs, lease-guarded dispatch
+"""
+
+from reporter_tpu.fleet.residency import (
+    FleetCapacityError,
+    FleetConfig,
+    FleetResidency,
+)
+from reporter_tpu.fleet.router import FleetRouter, MetroSLO, make_fleet_router
+
+__all__ = [
+    "FleetCapacityError",
+    "FleetConfig",
+    "FleetResidency",
+    "FleetRouter",
+    "MetroSLO",
+    "make_fleet_router",
+]

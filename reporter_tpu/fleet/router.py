@@ -1,0 +1,185 @@
+"""FleetRouter — geo-sharded serving over a paged metro fleet.
+
+Extends MetroRouter's bbox EP dispatch (service/router.py) from
+"every metro's app and tables eagerly resident" to the fleet shape:
+
+  - metros register COLD; an app (scheduler, cache, publisher) is
+    constructed on first traffic and persists across paging — only the
+    matcher's device tables page in and out (fleet/residency.py);
+  - every dispatch runs under a residency LEASE, so promotion→dispatch
+    is atomic against eviction;
+  - per-metro SLO configs (``MetroSLO``): batch-close deadline, shed
+    policy (the r7 scheduler's bounded admission queue), in-flight
+    depth, and a residency pin for metros whose SLO cannot absorb a
+    promotion stall;
+  - unroutable traces get MetroRouter's counted 404-with-known-metros;
+    fleet capacity exhaustion (all pinned/leased) sheds as 503 via
+    FleetCapacityError ⊂ ServiceOverloaded;
+  - ``/health`` adds the residency occupancy/paging report, ``/stats``
+    a fleet section, and ``/metrics`` exposes the shared router+fleet
+    registry (``rtpu_fleet_*`` per-metro labeled series).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from dataclasses import dataclass
+from typing import Sequence
+
+from reporter_tpu.config import Config
+from reporter_tpu.fleet.residency import FleetConfig, FleetResidency
+from reporter_tpu.service.app import ReporterApp
+from reporter_tpu.service.datastore import Transport
+from reporter_tpu.service.router import MetroRouter
+from reporter_tpu.tiles.tileset import TileSet
+
+
+@dataclass(frozen=True)
+class MetroSLO:
+    """Per-metro serving policy, mapped onto the r7 scheduler's knobs.
+    None keeps the fleet-wide default from the base Config."""
+
+    deadline_ms: "float | None" = None   # scheduler batch-close SLO
+    #                                      (ServiceConfig.batch_close_ms)
+    queue_limit: "int | None" = None     # shed policy: admitted traces
+    #                                      before 503
+    #                                      (admission_queue_limit)
+    max_inflight: "int | None" = None    # overlapped device batches
+    pinned: bool = False                 # residency pin: this metro's
+    #                                      tables are never LRU-evicted
+    #                                      (its SLO cannot absorb a
+    #                                      promotion stall)
+
+    def apply(self, base: Config) -> Config:
+        kw: dict = {}
+        if self.deadline_ms is not None:
+            kw["batch_close_ms"] = float(self.deadline_ms)
+        if self.queue_limit is not None:
+            kw["admission_queue_limit"] = int(self.queue_limit)
+        if self.max_inflight is not None:
+            kw["max_inflight_batches"] = int(self.max_inflight)
+        if not kw:
+            return base
+        return dataclasses.replace(
+            base, service=dataclasses.replace(base.service, **kw)
+        ).validate()
+
+
+class FleetRouter(MetroRouter):
+    """One serving face over N≥ hundreds of metros on one chip.
+
+    Apps are constructed lazily (first traffic) and kept; matchers'
+    device tables page through the residency manager. The router's
+    geo dispatch, WSGI surface, and error taxonomy are MetroRouter's —
+    this class only changes WHERE apps/matchers come from and wraps
+    dispatches in leases."""
+
+    def __init__(self, tilesets: Sequence[TileSet],
+                 config: "Config | None" = None,
+                 transport: "Transport | None" = None,
+                 fleet: "FleetConfig | None" = None,
+                 slos: "dict[str, MetroSLO] | None" = None):
+        names = self._init_routing(tilesets)
+        if "fleet" in names:
+            raise ValueError('metro name "fleet" is reserved (it keys '
+                             "the residency section in /stats)")
+        base = (config or Config()).validate()
+        slos = dict(slos or {})
+        unknown = set(slos) - set(names)
+        if unknown:
+            raise ValueError(f"SLOs for unknown metros: {sorted(unknown)}")
+        self._slos = slos
+        self._transport = transport
+        self._configs = {n: s.apply(base) for n, s in slos.items()}
+        fleet = (fleet or FleetConfig())
+        pins = tuple(dict.fromkeys(
+            fleet.pins + tuple(n for n, s in slos.items() if s.pinned)))
+        # ONE registry for router + residency series: two registries
+        # would each render their own exposition (duplicate
+        # rtpu_uptime_seconds TYPE lines in a concatenation)
+        self.residency = FleetResidency(
+            tilesets, config=base,
+            fleet=dataclasses.replace(fleet, pins=pins),
+            configs=self._configs, metrics=self.metrics)
+        self.apps: "dict[str, ReporterApp]" = {}
+        self._apps_lock = threading.Lock()      # guards the dict only
+        # construction is serialized PER METRO: building an app promotes
+        # the metro (staging build + device_put + possibly a lease
+        # wait), and doing that under one global lock would stall every
+        # OTHER metro's traffic — including pinned-SLO metros — behind
+        # one cold metro's first touch
+        self._app_build_locks = {n: threading.Lock() for n in names}
+
+    # ---- app/matcher access ---------------------------------------------
+
+    def app(self, name: str) -> ReporterApp:
+        with self._apps_lock:
+            a = self.apps.get(name)
+        if a is not None:
+            return a
+        with self._app_build_locks[name]:   # KeyError = unknown metro
+            with self._apps_lock:
+                a = self.apps.get(name)
+            if a is not None:
+                return a
+            # residency.matcher promotes if cold; the app wraps the
+            # metro's LONG-LIVED matcher, so cache/scheduler state
+            # and compiled executables survive later paging
+            a = ReporterApp(
+                self.residency.tileset(name),
+                self._configs.get(name, self.residency.config),
+                transport=self._transport,
+                matcher=self.residency.matcher(name))
+            with self._apps_lock:
+                self.apps[name] = a
+            return a
+
+    @contextlib.contextmanager
+    def _serving(self, metro: str):
+        """The report bodies are MetroRouter's; only the dispatch
+        context differs — a residency lease (promote-if-cold + hold
+        resident), so eviction can never drop tables under an in-flight
+        dispatch."""
+        with self.residency.lease(metro):
+            yield
+
+    # ---- observability ---------------------------------------------------
+
+    def health(self) -> dict:
+        with self._apps_lock:
+            apps = dict(self.apps)
+        return {
+            "status": "ok",
+            "unroutable": int(self.metrics.value("router_unroutable")),
+            "fleet": self.residency.occupancy(),
+            # only metros that have seen traffic have an app to report;
+            # the fleet block above covers every REGISTERED metro
+            "metros": {n: a.health() for n, a in apps.items()},
+        }
+
+    def stats(self) -> dict:
+        with self._apps_lock:
+            apps = dict(self.apps)
+        out = {n: a.matcher.metrics.snapshot() for n, a in apps.items()}
+        out["fleet"] = {
+            "occupancy": self.residency.occupancy(),
+            "series": self.metrics.snapshot(),
+        }
+        return out
+
+    def close(self) -> None:
+        with self._apps_lock:
+            apps = dict(self.apps)
+        for a in apps.values():
+            a.close()
+
+
+def make_fleet_router(tilesets: Sequence[TileSet],
+                      config: "Config | None" = None,
+                      transport: "Transport | None" = None,
+                      fleet: "FleetConfig | None" = None,
+                      slos: "dict[str, MetroSLO] | None" = None,
+                      ) -> FleetRouter:
+    return FleetRouter(tilesets, config, transport, fleet=fleet, slos=slos)

@@ -35,7 +35,9 @@ from reporter_tpu.matcher.segments import (
 )
 from reporter_tpu.tiles.tileset import TileSet
 from reporter_tpu.utils import tracing
+from reporter_tpu.utils import watchdog as watchdog_mod
 from reporter_tpu.utils.metrics import MetricsRegistry
+from reporter_tpu.utils.watchdog import AbandonedThreadWatchdog
 
 _BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
 
@@ -214,7 +216,7 @@ class SegmentMatcher:
 
     def __init__(self, tileset: TileSet, config: Config | None = None,
                  metrics: MetricsRegistry | None = None,
-                 mesh=None):
+                 mesh=None, staged_tables=None):
         import dataclasses as _dc
 
         self.ts = tileset
@@ -238,20 +240,21 @@ class SegmentMatcher:
         self._fallback: "SegmentMatcher | None" = None
         # TWO locks on purpose: _fallback_lock serializes the oracle
         # (DijkstraCache is not thread-safe) and is held for a whole —
-        # slow — fallback match; _watchdog_lock guards only the breaker
-        # bookkeeping below and is held for nanoseconds. One lock for
+        # slow — fallback match; the watchdog's lock guards only the
+        # breaker bookkeeping and is held for nanoseconds. One lock for
         # both would let a single in-progress oracle batch block every
         # concurrent healthy dispatch at its breaker check until it
         # spuriously timed out too.
         self._fallback_lock = threading.Lock()
-        self._watchdog_lock = threading.Lock()
         # circuit breaker: count of watchdog threads abandoned and still
         # stuck inside a dispatch. Each pins its wave's traces until the
         # wedge clears, so the count must be BOUNDED — past the cap the
         # matcher degrades immediately instead of feeding more threads
-        # (and more memory) to a dead link.
-        self._abandoned_dispatches = 0
-        self._abandoned_cap = 4
+        # (and more memory) to a dead link. (The watchdog's own lock
+        # guards only that bookkeeping, held for nanoseconds — see the
+        # _fallback_lock note above.)
+        self._watchdog = AbandonedThreadWatchdog(
+            cap=4, thread_name="dispatch-watchdog")
         if mesh is not None and backend != "jax":
             raise ValueError("mesh sharding requires matcher_backend='jax'")
         if backend == "jax":
@@ -271,12 +274,22 @@ class SegmentMatcher:
             if mesh is None:
                 # stage only the layout the resolved candidate backend
                 # sweeps (the unused one is the largest table at metro
-                # scale)
-                self._tables = tileset.device_tables(
-                    self.params.candidate_backend)
+                # scale). ``staged_tables`` injects pre-placed device
+                # arrays instead (the fleet residency manager stages —
+                # and meters — the device_put itself; passing the same
+                # values through the same wire programs is what makes
+                # fleet-resident wire bytes identical to a dedicated
+                # matcher's by construction).
+                self._tables = (staged_tables if staged_tables is not None
+                                else tileset.device_tables(
+                                    self.params.candidate_backend))
                 self._wire = _LocalWire(self._tables, self.ts.meta,
                                         wire_params, self._wire_spec)
             else:
+                if staged_tables is not None:
+                    raise ValueError(
+                        "staged_tables injection is single-device only; "
+                        "the mesh path shards its own tables")
                 from reporter_tpu.parallel.dp_e2e import DpWireMatcher
                 self._wire = DpWireMatcher(mesh, tileset, wire_params,
                                            self._wire_spec)
@@ -288,6 +301,9 @@ class SegmentMatcher:
             from reporter_tpu.matcher.native_walk import make_native_walker
             self._native_walker = make_native_walker(tileset)
         elif backend == "reference_cpu":
+            if staged_tables is not None:
+                raise ValueError(
+                    "staged_tables requires matcher_backend='jax'")
             self._tables = None
             # One bound-aware Dijkstra memo shared by the Viterbi pass and
             # segment-build routing, across every trace this matcher sees.
@@ -301,6 +317,56 @@ class SegmentMatcher:
         else:  # pragma: no cover - Config.validate rejects earlier
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
+
+    # ---- fleet residency (device-table paging) ---------------------------
+
+    @property
+    def tables_staged(self) -> bool:
+        """False while this matcher's device tables are paged out
+        (fleet cold tier). reference_cpu has no device tables and always
+        reads False."""
+        return self._tables is not None
+
+    def unstage_tables(self) -> None:
+        """Drop this matcher's device-table references (fleet demotion:
+        HBM frees once any in-flight dispatch that captured them
+        completes). The matcher object — walker, route tables, compiled
+        executables — survives; a later ``restage_tables`` makes it
+        serve again without recompiling, because the wire entries take
+        tables as call ARGUMENTS, not closures, so same-shape restaged
+        tables reuse the existing executables. jax single-device only
+        (the mesh path owns sharded placement)."""
+        if self.backend != "jax" or not isinstance(self._wire, _LocalWire):
+            raise ValueError(
+                "table paging requires the single-device jax backend")
+        self._tables = None
+        self._wire.tables = None
+
+    def restage_tables(self, tables: dict) -> None:
+        """Re-point the wire dispatch at freshly placed device tables
+        (fleet promotion). ``tables`` must be the same pytree the
+        matcher was built with — the residency manager re-device_puts
+        the host-pinned copy, so values (and therefore result wire
+        bytes) are identical across any number of evict→promote
+        cycles."""
+        if self.backend != "jax" or not isinstance(self._wire, _LocalWire):
+            raise ValueError(
+                "table paging requires the single-device jax backend")
+        self._tables = tables
+        self._wire.tables = tables
+
+    def _require_staged(self) -> None:
+        """A paged-out matcher must fail loudly, not with a shape error
+        three layers down — the fleet router promotes (and leases)
+        before dispatch, so reaching this unstaged means a caller
+        bypassed the residency manager. Guards EVERY device entry:
+        the watchdog path, the submit choke point (match_many /
+        matched_points / the walk path all funnel through
+        _submit_many), and match_topk's separate candidate build."""
+        if self._tables is None:
+            raise RuntimeError(
+                f"matcher for {self.ts.name!r} has its device tables "
+                "unstaged (fleet cold tier); promote before dispatching")
 
     # ---- single-trace API (reference parity) ----------------------------
 
@@ -354,13 +420,12 @@ class SegmentMatcher:
 
         The ``dispatch`` fault site fires here (inside the guarded body)
         so an injected hang stalls exactly where a dead tunnel would."""
+        self._require_staged()
         timeout = float(self.params.dispatch_timeout_s)
         if timeout <= 0:
             faults.fire("dispatch")
             return self._match_jax_many(traces)
-        with self._watchdog_lock:
-            tripped = self._abandoned_dispatches >= self._abandoned_cap
-        if tripped:
+        if self._watchdog.tripped:
             # circuit open: enough abandoned dispatches are already stuck
             # on the dead link — degrade IMMEDIATELY rather than pin yet
             # another thread + trace batch (a permanently hung tunnel
@@ -372,50 +437,17 @@ class SegmentMatcher:
             self.metrics.count("dispatch_timeout")
             tracing.post_mortem("breaker_open", failing="device_dispatch",
                                 traces=len(traces),
-                                abandoned=self._abandoned_dispatches)
+                                abandoned=self._watchdog.abandoned)
             return self._degrade(traces, timeout)
-        box: dict = {}
-        done = threading.Event()
-        state = {"abandoned": False, "finished": False}
-
         tracing.tracer().instant("device_dispatch",
                                  traces=len(traces))
         # (recorded BEFORE the guarded body: a dispatch that hangs
         # forever still shows up in the post-mortem as the last thing
         # the matcher started)
-
-        def _run():
-            try:
-                faults.fire("dispatch")     # injected stall lands HERE
-                with self._watchdog_lock:
-                    gave_up = state["abandoned"]
-                if gave_up:
-                    return    # the watchdog gave up while we stalled: a
-                    #           zombie dispatch must not race the retry
-                box["out"] = self._match_jax_many(traces)
-            except BaseException as exc:    # noqa: BLE001 — relayed below
-                box["exc"] = exc
-            finally:
-                with self._watchdog_lock:
-                    state["finished"] = True
-                    if state["abandoned"]:      # wedge cleared: un-count
-                        self._abandoned_dispatches -= 1
-                done.set()
-
-        threading.Thread(target=_run, daemon=True,
-                         name="dispatch-watchdog").start()
-        finished = done.wait(timeout)
-        if not finished:
-            with self._watchdog_lock:
-                if not state["finished"]:       # really stuck: abandon it
-                    state["abandoned"] = True
-                    self._abandoned_dispatches += 1
-                else:
-                    finished = True   # landed in the timeout race window
-        if finished:
-            if "exc" in box:
-                raise box["exc"]
-            return box["out"]
+        out = self._watchdog.run(lambda: self._match_jax_many(traces),
+                                 timeout, fault_site="dispatch")
+        if out is not watchdog_mod.TIMED_OUT:
+            return out
         self.metrics.count("dispatch_timeout")
         tracing.post_mortem("dispatch_timeout", failing="device_dispatch",
                             traces=len(traces), timeout_s=timeout)
@@ -487,6 +519,7 @@ class SegmentMatcher:
                 f"(got {len(trace.xy)}); ranked alternates do not compose "
                 "across chunks — split or decimate the trace, or use "
                 "match_many for the best-path decode")
+        self._require_staged()
         import jax.numpy as jnp
 
         from reporter_tpu.ops.hmm import (viterbi_kbest_paths,
@@ -556,6 +589,7 @@ class SegmentMatcher:
         """
         from reporter_tpu.ops.match import OFFSET_QUANTUM
 
+        self._require_staged()
         max_b = _BUCKETS[-1]
         # Traces beyond the largest bucket are decoded in consecutive chunks
         # (each chunk is an independent HMM; at most the segment spanning a

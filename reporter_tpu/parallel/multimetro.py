@@ -73,6 +73,10 @@ def stack_tilesets(tilesets: Sequence[TileSet]) -> StackedTiles:
     parameters); grid origin/dims vary per metro and ride along as traced
     scalars.
     """
+    # NOTE: stacking is POSITIONAL — duplicate names are legal here (the
+    # mesh suites stack two differently-seeded "tiny" metros), but any
+    # name-keyed consumer (dispatch_traces, MetroRouter, the fleet
+    # registry) requires unique names and checks its own.
     cell_sizes = {ts.meta.cell_size for ts in tilesets}
     if len(cell_sizes) != 1:
         raise ValueError(f"metros compiled with differing cell_size: {cell_sizes}")
@@ -96,7 +100,11 @@ def stack_tilesets(tilesets: Sequence[TileSet]) -> StackedTiles:
 
     host_tables = []
     for ts in tilesets:
-        t = {k: np.asarray(v) for k, v in ts.device_tables().items()}
+        # host_tables, not device_tables: the pad-and-stack below is host
+        # numpy, so staging per-metro jnp arrays first would round-trip
+        # every table through the device (and on a remote-attached chip,
+        # through the link) just to pull it straight back
+        t = dict(ts.host_tables())
         t["grid_ox"] = np.float32(ts.meta.grid_origin[0])
         t["grid_oy"] = np.float32(ts.meta.grid_origin[1])
         t["grid_gw"] = np.int32(ts.meta.grid_dims[0])
@@ -207,6 +215,10 @@ def dispatch_traces(names: Sequence[str],
     dp × next-power-of-two so repeat dispatches reuse a small set of compiled
     shapes instead of recompiling per load level; T pads to ``bucket``.
     """
+    if len(set(names)) != len(names):
+        # the slot map below is name-keyed: duplicates would merge two
+        # stack rows' traffic into whichever row iterates last
+        raise ValueError(f"duplicate metro names: {list(names)}")
     by_metro: dict[str, list[tuple[int, int, int]]] = {n: [] for n in names}
     for j, (metro, xy) in enumerate(jobs):
         if metro not in by_metro:

@@ -124,11 +124,21 @@ class ReporterApp:
     in flight, lock held through the dispatch) for A/B comparison."""
 
     def __init__(self, tileset: TileSet, config: Config | None = None,
-                 transport: Transport | None = None, mesh=None):
+                 transport: Transport | None = None, mesh=None,
+                 matcher: "SegmentMatcher | None" = None):
         self.config = (config or Config()).validate()
         svc = self.config.service
         tracing.configure_from_service(svc)   # span recorder (global)
-        self.matcher = SegmentMatcher(tileset, self.config, mesh=mesh)
+        if matcher is not None and (matcher.ts is not tileset
+                                    or mesh is not None):
+            # injection exists for the fleet residency manager, which
+            # owns table paging for ITS matchers — a mismatched tileset
+            # would silently serve the wrong metro's map
+            raise ValueError("injected matcher must wrap the same "
+                             "tileset, without a mesh")
+        self.matcher = (matcher if matcher is not None
+                        else SegmentMatcher(tileset, self.config,
+                                            mesh=mesh))
         self.cache = PartialTraceCache(ttl=svc.cache_ttl,
                                        max_uuids=svc.cache_max_uuids)
         from reporter_tpu.service.datastore import publisher_kwargs

@@ -16,6 +16,7 @@ aggregated over metros.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 from typing import Callable, Sequence
@@ -33,8 +34,23 @@ from reporter_tpu.service.app import (
 from reporter_tpu.service.scheduler import ServiceOverloaded
 from reporter_tpu.service.datastore import Transport
 from reporter_tpu.tiles.tileset import TileSet
+from reporter_tpu.utils.metrics import MetricsRegistry
 
 _MARGIN_M = 2000.0    # bbox dilation: probes just outside the grid still route
+
+
+class UnroutableTrace(BadRequest):
+    """A trace outside every metro's dilated bbox with no explicit
+    ``"metro"`` field. Routing, not validation, failed — the WSGI face
+    answers 404 with the known metros (a client can re-aim) instead of
+    a generic 400, and the router counts it (``router_unroutable``) so
+    a geo-misconfigured producer shows up in /metrics instead of as an
+    unlabeled error-rate bump. Subclasses BadRequest so programmatic
+    callers' existing handling still catches it."""
+
+    def __init__(self, msg: str, known_metros: "list[str]"):
+        super().__init__(msg)
+        self.known_metros = known_metros
 
 
 class MetroRouter:
@@ -50,11 +66,7 @@ class MetroRouter:
                  config: Config | None = None,
                  transport: Transport | None = None,
                  meshes: "dict | None" = None):
-        if not tilesets:
-            raise ValueError("need at least one tileset")
-        names = [ts.name for ts in tilesets]
-        if len(set(names)) != len(names):
-            raise ValueError(f"duplicate metro names: {names}")
+        names = self._init_routing(tilesets)
         meshes = meshes or {}
         unknown = set(meshes) - set(names)
         if unknown:
@@ -62,6 +74,19 @@ class MetroRouter:
         self.apps = {ts.name: ReporterApp(ts, config, transport=transport,
                                           mesh=meshes.get(ts.name))
                      for ts in tilesets}
+
+    def _init_routing(self, tilesets: Sequence[TileSet]) -> "list[str]":
+        """Shared routing state (bbox table + router-level metrics) —
+        split out so FleetRouter can reuse the geo dispatch while
+        constructing its per-metro apps lazily through the residency
+        manager instead of eagerly here."""
+        if not tilesets:
+            raise ValueError("need at least one tileset")
+        names = [ts.name for ts in tilesets]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate metro names: {names}")
+        self.metrics = MetricsRegistry()   # router-level (per-metro app
+        #                                    registries stay per-matcher)
         self._bounds = {ts.name: self._lonlat_bounds(ts) for ts in tilesets}
         # overlapping/nested metros: route to the SMALLEST containing bbox
         # (most specific), not list order — deterministic regardless of
@@ -70,6 +95,15 @@ class MetroRouter:
             self._bounds.items(),
             key=lambda kv: ((kv[1][1][0] - kv[1][0][0])
                             * (kv[1][1][1] - kv[1][0][1])))
+        return names
+
+    def known_metros(self) -> "list[str]":
+        return sorted(self._bounds)
+
+    def app(self, name: str) -> ReporterApp:
+        """The metro's app — THE access point subclasses override
+        (FleetRouter promotes through the residency manager here)."""
+        return self.apps[name]
 
     @staticmethod
     def _lonlat_bounds(ts: TileSet):
@@ -87,9 +121,9 @@ class MetroRouter:
             raise BadRequest("payload must be a JSON object")
         metro = payload.get("metro")
         if metro is not None:
-            if metro not in self.apps:
+            if metro not in self._bounds:
                 raise BadRequest(
-                    f"unknown metro {metro!r}; have {sorted(self.apps)}")
+                    f"unknown metro {metro!r}; have {self.known_metros()}")
             return str(metro)
         pts = payload.get("trace")
         if not isinstance(pts, list) or not pts or not isinstance(pts[0], dict):
@@ -102,13 +136,25 @@ class MetroRouter:
         for name, (lo, hi) in self._by_area:
             if lo[0] <= lon <= hi[0] and lo[1] <= lat <= hi[1]:
                 return name
-        raise BadRequest(
+        self.metrics.count("router_unroutable")
+        raise UnroutableTrace(
             f"point ({lat:.4f}, {lon:.4f}) is outside every metro "
-            f"({sorted(self.apps)})")
+            f"({self.known_metros()})", self.known_metros())
+
+    @contextlib.contextmanager
+    def _serving(self, metro: str):
+        """Dispatch context for one metro's batch — the second seam
+        subclasses override (FleetRouter holds a residency lease here,
+        so tables cannot page out under an in-flight dispatch). Base
+        router: nothing is paged, nothing to hold. Entered AFTER
+        ``app()`` (construction may itself promote/stage)."""
+        yield
 
     def report_one(self, payload: dict) -> dict:
         metro = self.route(payload)
-        out = self.apps[metro].report_one(payload)
+        app = self.app(metro)
+        with self._serving(metro):
+            out = app.report_one(payload)
         out["metro"] = metro
         return out
 
@@ -119,7 +165,9 @@ class MetroRouter:
             by_metro.setdefault(m, []).append(i)
         results: list = [None] * len(payloads)
         for m, idxs in by_metro.items():
-            outs = self.apps[m].report_many([payloads[i] for i in idxs])
+            app = self.app(m)
+            with self._serving(m):
+                outs = app.report_many([payloads[i] for i in idxs])
             for i, out in zip(idxs, outs):
                 out["metro"] = m
                 results[i] = out
@@ -130,8 +178,20 @@ class MetroRouter:
     def health(self) -> dict:
         return {
             "status": "ok",
+            "unroutable": int(self.metrics.value("router_unroutable")),
             "metros": {n: a.health() for n, a in self.apps.items()},
         }
+
+    def stats(self) -> dict:
+        return {n: a.matcher.metrics.snapshot()
+                for n, a in self.apps.items()}
+
+    def render_prometheus(self) -> str:
+        """Router-level series only (per-metro matcher registries stay
+        on each app's own /metrics face; FleetRouter's fleet series ride
+        along because it passes this same registry into FleetResidency —
+        registry sharing, not an override)."""
+        return self.metrics.render_prometheus()
 
     def close(self) -> None:
         """Graceful drain of every metro's scheduler + publisher (each
@@ -146,9 +206,12 @@ class MetroRouter:
             if path == "/health" and method == "GET":
                 return _respond(start_response, 200, self.health())
             if path == "/stats" and method == "GET":
-                return _respond(start_response, 200, {
-                    n: a.matcher.metrics.snapshot()
-                    for n, a in self.apps.items()})
+                return _respond(start_response, 200, self.stats())
+            if path == "/metrics" and method == "GET":
+                from reporter_tpu.service.app import _respond_text
+
+                return _respond_text(start_response, 200,
+                                     self.render_prometheus())
             if path == "/report" and method == "POST":
                 return _respond(start_response, 200,
                                 self.report_one(_read_json(environ)))
@@ -163,6 +226,12 @@ class MetroRouter:
                 return _respond(start_response, 405,
                                 {"error": f"{method} not allowed"})
             return _respond(start_response, 404, {"error": "not found"})
+        except UnroutableTrace as exc:
+            # not-found, not bad-request: the trace was well-formed, the
+            # fleet just doesn't serve that patch of planet — name what
+            # it DOES serve so the caller can re-aim or provision
+            return _respond(start_response, 404, {
+                "error": str(exc), "known_metros": exc.known_metros})
         except BadRequest as exc:
             return _respond(start_response, 400, {"error": str(exc)})
         except ServiceOverloaded as exc:

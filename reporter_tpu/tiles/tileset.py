@@ -205,10 +205,17 @@ class TileSet:
 
     # ---- device staging --------------------------------------------------
 
-    def device_tables(self, candidate_backend: str = "both",
-                      ) -> dict[str, Any]:
-        """The subset of arrays the on-device matcher kernels consume, as a
-        plain dict pytree of jnp arrays (HBM-resident after first use).
+    def host_tables(self, candidate_backend: str = "both",
+                    ) -> dict[str, np.ndarray]:
+        """The staged device layouts as plain HOST numpy arrays — the
+        shared builder behind ``device_tables`` (jnp view of the same
+        dict), the multimetro NaN-pad stack (parallel/multimetro.py,
+        which pads these before any device placement), and the fleet
+        residency manager's cold tier (fleet/residency.py pins this
+        dict in host RAM so an evicted metro re-promotes with one
+        ``jax.device_put`` instead of rebuilding cell_pack/seg_pack —
+        the build, not the transfer, dominates staging cost at metro
+        scale).
 
         ``candidate_backend`` prunes the candidate-search layout staged:
         "dense" skips cell_pack (the grid backend's [C, 8*cap] f32 fusion
@@ -217,14 +224,13 @@ class TileSet:
         "auto" resolves like ops.match.batch_candidates (grid on CPU,
         dense on accelerators), "both" stages everything (multimetro
         stacking and tests that flip backends per matcher)."""
-        import jax
-        import jax.numpy as jnp
-
         import logging
 
         from reporter_tpu.ops.dense_candidates import build_seg_pack
 
         if candidate_backend == "auto":
+            import jax
+
             candidate_backend = ("grid" if jax.default_backend() == "cpu"
                                  else "dense")
         if candidate_backend not in ("dense", "grid", "both"):
@@ -251,26 +257,35 @@ class TileSet:
         # component rows swept by the pallas kernel with bbox culling, no
         # gathers at all; ops/dense_candidates.py). The id-only grid and
         # per-segment SoA arrays stay host-side.
-        out: dict[str, Any] = {
-            "edge_len": jnp.asarray(self.edge_len),
-            "reach_row": jnp.asarray(self.edge_reach_row),
-            "edge_osmlr": jnp.asarray(self.edge_osmlr),
-            "reach_to": jnp.asarray(self.reach_to),
-            "reach_dist": jnp.asarray(self.reach_dist),
+        out: dict[str, np.ndarray] = {
+            "edge_len": np.asarray(self.edge_len),
+            "reach_row": np.asarray(self.edge_reach_row),
+            "edge_osmlr": np.asarray(self.edge_osmlr),
+            "reach_to": np.asarray(self.reach_to),
+            "reach_dist": np.asarray(self.reach_dist),
         }
         if candidate_backend != "dense":
-            out["cell_pack"] = jnp.asarray(build_cell_pack(
+            out["cell_pack"] = build_cell_pack(
                 self.grid, self.seg_a, self.seg_b, self.seg_edge,
-                self.seg_off, self.seg_len))
+                self.seg_off, self.seg_len)
         if candidate_backend != "grid":
             sp = build_seg_pack(self.seg_a, self.seg_b, self.seg_edge,
                                 self.seg_off, self.seg_len)
-            out["seg_pack"] = jnp.asarray(sp.pack)
-            out["seg_bbox"] = jnp.asarray(sp.bbox)
+            out["seg_pack"] = np.asarray(sp.pack)
+            out["seg_bbox"] = np.asarray(sp.bbox)
             # per-sub-block bbox quads: the kernel's in-block second
             # culling level (round 8) — tiny next to seg_pack
-            out["seg_sub"] = jnp.asarray(sp.sub)
+            out["seg_sub"] = np.asarray(sp.sub)
         return out
+
+    def device_tables(self, candidate_backend: str = "both",
+                      ) -> dict[str, Any]:
+        """``host_tables`` as a plain dict pytree of jnp arrays
+        (HBM-resident after first use) — what SegmentMatcher stages."""
+        import jax.numpy as jnp
+
+        return {k: jnp.asarray(v)
+                for k, v in self.host_tables(candidate_backend).items()}
 
     def hbm_bytes(self) -> int:
         return int(sum(getattr(self, f).nbytes for f in _ARRAY_FIELDS))

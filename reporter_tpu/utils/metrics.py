@@ -30,6 +30,42 @@ HISTOGRAM_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                      0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
 _PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_VALUE = re.compile(r"[\"\\\n]")
+
+
+def labeled(name: str, **labels) -> str:
+    """Canonical label-suffixed series key: ``labeled("fleet_hits",
+    metro="sf")`` → ``fleet_hits{metro="sf"}``. THE spelling for
+    per-metro (and any future per-partition/per-worker) series — the
+    registry stores the full string as the key, snapshot() reports it
+    verbatim, and render_prometheus() splits it back into a metric name
+    plus a real Prometheus label block (grouped under one # TYPE line
+    per base name). Label order is sorted so the same logical series
+    can never fork into two keys."""
+    if not labels:
+        return name
+    inner = ",".join(
+        f'{k}="{_LABEL_VALUE.sub("_", str(v))}"'
+        for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def _split_labels(key: str) -> tuple[str, str]:
+    """``name{a="b"}`` → (``name``, ``{a="b"}``); plain names pass
+    through with an empty label block."""
+    if key.endswith("}") and "{" in key:
+        base, _, rest = key.partition("{")
+        return base, "{" + rest
+    return key, ""
+
+
+def _with_suffix(key: str, suffix: str) -> str:
+    """Append a derived-series suffix BEFORE any label block, so
+    ``fleet_promote_seconds{metro="a"}`` derives
+    ``fleet_promote_seconds_total{metro="a"}`` (a valid labeled series)
+    rather than a name with trailing braces in the middle."""
+    base, lab = _split_labels(key)
+    return base + suffix + lab
 
 
 class _Reservoir:
@@ -82,7 +118,10 @@ class StageTimer:
         return self
 
     def __exit__(self, *exc: Any) -> None:
-        self._registry.observe(self._name + "_seconds",
+        # _with_suffix, not concatenation: a labeled stage name
+        # (stage(labeled("x", metro="sf"))) must derive
+        # x_seconds{metro="sf"}, not a key with braces mid-name
+        self._registry.observe(_with_suffix(self._name, "_seconds"),
                                time.perf_counter() - self._t0)
 
 
@@ -123,10 +162,10 @@ class MetricsRegistry:
             r.add(value)
             self._hist[name][bisect.bisect_left(HISTOGRAM_BUCKETS,
                                                 value)] += 1
-            self._counters[name + "_total"] = (
-                self._counters.get(name + "_total", 0.0) + value)
-            self._counters[name + "_count"] = (
-                self._counters.get(name + "_count", 0.0) + 1)
+            total = _with_suffix(name, "_total")
+            count = _with_suffix(name, "_count")
+            self._counters[total] = self._counters.get(total, 0.0) + value
+            self._counters[count] = self._counters.get(count, 0.0) + 1
 
     def stage(self, name: str) -> StageTimer:
         return StageTimer(self, name)
@@ -155,14 +194,14 @@ class MetricsRegistry:
         for name, buf in bufs.items():
             if buf:
                 buf.sort()
-                out[name + "_p50"] = _pick(buf, 0.50)
-                out[name + "_p95"] = _pick(buf, 0.95)
-                out[name + "_p99"] = _pick(buf, 0.99)
+                out[_with_suffix(name, "_p50")] = _pick(buf, 0.50)
+                out[_with_suffix(name, "_p95")] = _pick(buf, 0.95)
+                out[_with_suffix(name, "_p99")] = _pick(buf, 0.99)
             else:
                 nan = float("nan")
-                out[name + "_p50"] = nan
-                out[name + "_p95"] = nan
-                out[name + "_p99"] = nan
+                out[_with_suffix(name, "_p50")] = nan
+                out[_with_suffix(name, "_p95")] = nan
+                out[_with_suffix(name, "_p99")] = nan
         probes = out.get("probes", 0.0)
         busy = out.get("match_seconds_total", 0.0)
         if probes and busy:
@@ -181,39 +220,56 @@ class MetricsRegistry:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             hists = {name: (list(h),
-                            self._counters.get(name + "_total", 0.0),
-                            int(self._counters.get(name + "_count", 0.0)))
+                            self._counters.get(
+                                _with_suffix(name, "_total"), 0.0),
+                            int(self._counters.get(
+                                _with_suffix(name, "_count"), 0.0)))
                      for name, h in self._hist.items()}
         lines: list[str] = []
 
         def _name(raw: str) -> str:
             return "rtpu_" + _PROM_NAME.sub("_", raw)
 
+        def _grouped(items: dict) -> dict:
+            """base name → [(label block, value)], insertion-sorted:
+            labeled series (the per-metro fleet counters/gauges) share
+            ONE ``# TYPE`` line per base, as the exposition format
+            requires — a TYPE line per labeled sample would be a
+            duplicate-metadata parse error for strict scrapers."""
+            groups: dict[str, list] = {}
+            for key, value in sorted(items.items()):
+                base, lab = _split_labels(key)
+                groups.setdefault(base, []).append((lab, value))
+            return groups
+
         # series aggregates re-emit as the histogram's _sum/_count below
-        shadow = {k + suffix for k in hists for suffix in
+        shadow = {_with_suffix(k, suffix) for k in hists for suffix in
                   ("_total", "_count")}
-        for key, value in sorted(counters.items()):
-            if key in shadow:
-                continue
-            n = _name(key)
+        for base, samples in _grouped({k: v for k, v in counters.items()
+                                       if k not in shadow}).items():
+            n = _name(base)
             lines.append(f"# TYPE {n} counter")
-            lines.append(f"{n} {float(value)}")
-        for key, value in sorted(gauges.items()):
-            n = _name(key)
+            for lab, value in samples:
+                lines.append(f"{n}{lab} {float(value)}")
+        gauges["uptime_seconds"] = time.time() - self._born
+        for base, samples in _grouped(gauges).items():
+            n = _name(base)
             lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{n} {float(value)}")
-        n = _name("uptime_seconds")
-        lines.append(f"# TYPE {n} gauge")
-        lines.append(f"{n} {time.time() - self._born}")
-        for key, (buckets, total, count) in sorted(hists.items()):
-            n = _name(key)
+            for lab, value in samples:
+                lines.append(f"{n}{lab} {float(value)}")
+        for base, series in _grouped(hists).items():
+            n = _name(base)
             lines.append(f"# TYPE {n} histogram")
-            cum = 0
-            for le, c in zip(HISTOGRAM_BUCKETS, buckets):
-                cum += c
-                lines.append(f'{n}_bucket{{le="{le}"}} {cum}')
-            cum += buckets[-1]
-            lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"{n}_sum {float(total)}")
-            lines.append(f"{n}_count {count}")
+            for lab, (buckets, total, count) in series:
+                # merge ``le`` into any existing label block: a labeled
+                # histogram's buckets are {metro="a",le="0.5"}, one series
+                inner = lab[1:-1] + "," if lab else ""
+                cum = 0
+                for le, c in zip(HISTOGRAM_BUCKETS, buckets):
+                    cum += c
+                    lines.append(f'{n}_bucket{{{inner}le="{le}"}} {cum}')
+                cum += buckets[-1]
+                lines.append(f'{n}_bucket{{{inner}le="+Inf"}} {cum}')
+                lines.append(f"{n}_sum{lab} {float(total)}")
+                lines.append(f"{n}_count{lab} {count}")
         return "\n".join(lines) + "\n"

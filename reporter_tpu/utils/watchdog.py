@@ -1,0 +1,93 @@
+"""Bounded execution of work that can HANG forever.
+
+The axon tunnel's failure mode is an infinite stall inside a host
+transfer — no try/except can catch it (CLAUDE.md). The r9 answer, shared
+by the matcher's device dispatch and the fleet's promotion ``device_put``
+(one copy: a race-window or un-count fix must not land in one path and
+silently miss the other): run the body on a fresh daemon thread and
+bound the wait. On timeout the stuck thread is ABANDONED (daemon — it
+can never block exit); abandoned-and-still-stuck threads are counted so
+callers can open a circuit breaker at ``cap`` and degrade immediately
+instead of pinning one more thread + payload per retry — a permanently
+dead link must cost bounded memory. A body that lands AFTER abandonment
+un-counts itself and its result is discarded (the ``gave_up`` check): a
+zombie completion must not race the caller's retry.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from reporter_tpu import faults
+
+TIMED_OUT = object()    # sentinel: the body was abandoned (a body may
+#                         legally return None)
+
+
+class AbandonedThreadWatchdog:
+    """Abandoned-thread ledger + the guarded-call primitive.
+
+    ``lock`` guards only the counter and the per-call abandoned/finished
+    handshake — callers must NEVER hold their own data locks around
+    ``run()`` (the whole point is that the body may stall for minutes).
+    """
+
+    def __init__(self, cap: int = 4, thread_name: str = "watchdog"):
+        self.lock = threading.Lock()
+        self.abandoned = 0
+        self.cap = cap
+        self.thread_name = thread_name
+
+    @property
+    def tripped(self) -> bool:
+        """True while the breaker is open: ``cap`` abandoned bodies are
+        already stuck — degrade without spawning another."""
+        with self.lock:
+            return self.abandoned >= self.cap
+
+    def run(self, fn: Callable, timeout: float, fault_site: str = ""):
+        """Run ``fn`` on a daemon thread, waiting at most ``timeout``
+        seconds. Returns ``fn``'s result (re-raising its exception) when
+        it lands in time; returns the module sentinel ``TIMED_OUT`` when
+        the body was abandoned. ``fault_site`` fires inside the guarded
+        body, so an injected hang stalls exactly where a dead tunnel
+        would."""
+        box: dict = {}
+        done = threading.Event()
+        state = {"abandoned": False, "finished": False}
+
+        def _run():
+            try:
+                if fault_site:
+                    faults.fire(fault_site)     # injected stall lands HERE
+                with self.lock:
+                    gave_up = state["abandoned"]
+                if gave_up:
+                    return    # the watchdog gave up while we stalled: a
+                    #           zombie body must not race the retry
+                box["out"] = fn()
+            except BaseException as exc:    # noqa: BLE001 — relayed below
+                box["exc"] = exc
+            finally:
+                with self.lock:
+                    state["finished"] = True
+                    if state["abandoned"]:      # wedge cleared: un-count
+                        self.abandoned -= 1
+                done.set()
+
+        threading.Thread(target=_run, daemon=True,
+                         name=self.thread_name).start()
+        finished = done.wait(timeout)
+        if not finished:
+            with self.lock:
+                if not state["finished"]:       # really stuck: abandon it
+                    state["abandoned"] = True
+                    self.abandoned += 1
+                else:
+                    finished = True   # landed in the timeout race window
+        if not finished:
+            return TIMED_OUT
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]

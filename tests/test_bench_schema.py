@@ -268,6 +268,55 @@ def test_summary_line_carries_lattr_token():
     assert empty["lattr"] == [None] * 3
 
 
+def test_fleet_leg_schema_keys():
+    """Pin detail.fleet's occupancy/paging block (ISSUE 6): the
+    capture's fleet story — metros served, mixed kpps, promotion
+    latency, paging counts, the bit-identity bit — must survive future
+    refactors. Extend these key sets, never drop from them."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._fleet_bench)
+    for key in ("n_metros", "build_seconds", "staged_bytes_total",
+                "probes_per_sec", "per_metro_kpps", "capacity_bytes",
+                "touches", "promote_p50_ms", "promote_p99_ms",
+                "promote_to_first_report_p50_ms", "occupancy",
+                "wires_bit_identical", "wires_identical_to_dedicated",
+                "wires_identical_after_paging", "per_metro"):
+        assert f'"{key}"' in src, key
+    # the occupancy report itself (fleet/residency.py) feeds /health and
+    # the bench artifact — same extend-don't-drop discipline
+    from reporter_tpu.fleet.residency import FleetResidency
+
+    src_o = inspect.getsource(FleetResidency.occupancy)
+    for key in ("capacity_bytes", "evict_watermark", "resident_bytes",
+                "occupancy_frac", "resident_metros", "registered_metros",
+                "promotions", "demotions", "metros"):
+        assert f'"{key}"' in src_o, key
+
+
+def test_summary_line_carries_fleet_token():
+    """fleet = [metros served, mixed-traffic kpps, storm promotion p50
+    ms, promotions, demotions, wires bit-identical through paging]."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "fleet": {
+                   "n_metros": 8,
+                   "mixed": {"probes_per_sec": 456789.1},
+                   "storm": {"promote_p50_ms": 42.51},
+                   "occupancy": {"promotions": 24, "demotions": 20},
+                   "fidelity": {"wires_bit_identical": True},
+               },
+           }}
+    line = bench._summary_line(doc)
+    assert line["fleet"] == [8, 456, 42.51, 24, 20, 1]
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["fleet"] == [None] * 6
+
+
 def test_service_overload_boundary_rules():
     bench = _load_bench()
 

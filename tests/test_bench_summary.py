@@ -95,6 +95,11 @@ def _synthetic_doc():
         "latency_attribution": {"e2e_p50_ms": 12481.57,
                                 "stage_sum_over_e2e_p50": 1.0312,
                                 "tracing_overhead_pct": -1.27},
+        "fleet": {"n_metros": 128,
+                  "mixed": {"probes_per_sec": 1234567.8},
+                  "storm": {"promote_p50_ms": 1234.56},
+                  "occupancy": {"promotions": 12345, "demotions": 12321},
+                  "fidelity": {"wires_bit_identical": True}},
         "total_seconds": 801.5,
     }
     return {"metric": "probes_per_sec_e2e", "value": 2280000.1,

@@ -39,6 +39,14 @@ def test_streaming_demo_runs():
     assert "replay" in out.lower() or "restore" in out.lower(), out
 
 
+def test_fleet_demo_runs():
+    out = _run("fleet.py")
+    # the paging actually happened (eviction + re-promotion printed)
+    assert "routed" in out and "demotions=" in out
+    assert "occupancy report" in out
+    assert "[pinned]" in out
+
+
 @pytest.mark.slow
 def test_multichip_demo_runs():
     # slow: with the shard_map compat shim (parallel/compat.py) this demo

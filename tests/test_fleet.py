@@ -1,0 +1,788 @@
+"""Metro fleet residency (reporter_tpu/fleet/ — ISSUE 6).
+
+The contract under test: many compiled metros share one chip through an
+HBM occupancy ledger with LRU paging, and a fleet-resident metro's wire
+bytes are IDENTICAL to a dedicated single-metro SegmentMatcher's for the
+same traces — including immediately after an evict→promote cycle.
+Everything runs on the CPU jax backend (grid candidate path), same as
+the rest of tier-1; the paging machinery is backend-agnostic host code
+around ``jax.device_put``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import CompilerParams, Config
+from reporter_tpu.fleet import (
+    FleetCapacityError,
+    FleetConfig,
+    FleetResidency,
+    FleetRouter,
+    MetroSLO,
+)
+from reporter_tpu.matcher.api import SegmentMatcher, Trace
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_probe
+from reporter_tpu.service.scheduler import ServiceOverloaded
+from reporter_tpu.tiles.compiler import compile_network
+
+CFG = Config(matcher_backend="jax")
+
+
+def _make_metro(i: int, nx: int = 6, ny: int = 6):
+    """Tiny metros at DISTINCT centers: geo routing needs disjoint
+    bboxes (every unknown city name shares one default center)."""
+    net = generate_city("tiny", nx=nx, ny=ny, seed=20 + i,
+                        center=(-120.0 + i * 0.5, 37.0))
+    net.name = f"m{i}"
+    return compile_network(net, CompilerParams(reach_radius=500.0))
+
+
+@pytest.fixture(scope="module")
+def metros():
+    return [_make_metro(i) for i in range(3)]
+
+
+@pytest.fixture(scope="module")
+def staged_bytes(metros):
+    """Per-metro staged size under the CPU-resolved (grid) backend."""
+    return [sum(v.nbytes for v in ts.host_tables("auto").values())
+            for ts in metros]
+
+
+def _payload(ts, seed=5, n=40):
+    return synthesize_probe(ts, seed=seed, num_points=n,
+                            gps_sigma=3.0).to_report_json()
+
+
+def _wire_bytes(m, traces) -> bytes:
+    """Harvest the raw device wire for these traces, in submission
+    order — the byte-level artifact the bit-identity contract pins."""
+    _, inflight = m._submit_many(traces)
+    return b"".join(np.asarray(arr).tobytes() for _, arr in inflight)
+
+
+class TestResidencyLedger:
+    def test_registers_cold(self, metros):
+        fr = FleetResidency(metros, CFG)
+        assert fr.resident_bytes == 0
+        assert fr.resident_names == []
+        occ = fr.occupancy()
+        assert occ["registered_metros"] == 3
+        assert occ["resident_metros"] == 0
+        assert occ["capacity_bytes"] == 0          # unbounded default
+
+    def test_promote_on_touch_ledger_exact(self, metros, staged_bytes):
+        fr = FleetResidency(metros, CFG)
+        with fr.lease("m0"):
+            pass
+        assert fr.resident_names == ["m0"]
+        assert fr.resident_bytes == staged_bytes[0]
+        with fr.lease("m1"):
+            pass
+        assert fr.resident_bytes == staged_bytes[0] + staged_bytes[1]
+        occ = fr.occupancy()
+        assert occ["promotions"] == 2 and occ["demotions"] == 0
+        assert occ["metros"]["m0"]["staged_bytes"] == staged_bytes[0]
+        # hit vs miss counters: the second touch of m0 is a hit
+        fr.promote("m0")
+        assert fr.metrics.value('fleet_hits{metro="m0"}') == 1
+        assert fr.metrics.value('fleet_misses{metro="m0"}') == 1
+
+    def test_lru_eviction_respects_recency(self, metros, staged_bytes):
+        budget = staged_bytes[0] + staged_bytes[1] + staged_bytes[2] // 2
+        fr = FleetResidency(metros, CFG, FleetConfig(
+            max_resident_bytes=budget, evict_watermark=1.0))
+        fr.promote("m0")
+        fr.promote("m1")
+        fr.promote("m0")              # m1 is now LRU
+        fr.promote("m2")              # needs room → evicts m1, not m0
+        assert fr.resident_names == ["m0", "m2"]
+        occ = fr.occupancy()
+        assert occ["metros"]["m1"]["demotions"] == 1
+        assert fr.metrics.value('fleet_evictions{metro="m1"}') == 1
+
+    def test_watermark_drains_below_budget(self, metros, staged_bytes):
+        """Eviction drains to watermark×budget (hysteresis), not to
+        barely-fits: after the paging event there is headroom."""
+        budget = sum(staged_bytes)      # all three fit exactly
+        fr = FleetResidency(metros, CFG, FleetConfig(
+            max_resident_bytes=budget, evict_watermark=0.5))
+        for n in ("m0", "m1", "m2"):
+            fr.promote(n)
+        # all resident (no eviction was ever needed)
+        assert len(fr.resident_names) == 3
+        # shrink: now the watermark drives occupancy below 50% of cap
+        fr.set_capacity(budget - 1)
+        assert fr.resident_bytes <= (budget - 1) * 0.5
+        assert fr.resident_names == ["m2"]          # LRU drained first
+
+    def test_pinned_never_lru_evicted(self, metros, staged_bytes):
+        budget = staged_bytes[0] + staged_bytes[1] // 2
+        fr = FleetResidency(metros, CFG, FleetConfig(
+            max_resident_bytes=budget, evict_watermark=1.0,
+            pins=("m0",)))
+        fr.promote("m0")
+        with pytest.raises(FleetCapacityError):
+            fr.promote("m1")           # only evictable candidate is pinned
+        assert fr.resident_names == ["m0"]
+        assert fr.metrics.value('fleet_promote_failures{metro="m1"}') == 1
+        # a capacity failure sheds as a retryable 503, like overload
+        assert issubclass(FleetCapacityError, ServiceOverloaded)
+        # explicit demote is still allowed (the pin only shields LRU)
+        fr.demote("m0")
+        assert fr.resident_names == []
+        fr.promote("m1")
+        assert fr.resident_names == ["m1"]
+
+    def test_lease_blocks_eviction(self, metros, staged_bytes):
+        budget = staged_bytes[0] + staged_bytes[1] // 2
+        fr = FleetResidency(metros, CFG, FleetConfig(
+            max_resident_bytes=budget, evict_watermark=1.0,
+            promote_wait_s=0.0))       # shed immediately (no lease wait)
+        with fr.lease("m0"):
+            # m0 is mid-dispatch: eviction must not drop its tables
+            with pytest.raises(FleetCapacityError):
+                fr.promote("m1")
+            assert fr.resident_names == ["m0"]
+        fr.promote("m1")               # lease released → m0 evictable
+        assert fr.resident_names == ["m1"]
+
+    def test_promote_waits_for_lease_release(self, metros, staged_bytes):
+        """A promotion blocked ONLY by an in-flight lease waits (a
+        lease is one dispatch, not a pin) and proceeds when the lease
+        releases — this is what keeps mixed traffic through a tight
+        budget shedding-free."""
+        budget = staged_bytes[0] + staged_bytes[1] // 2
+        fr = FleetResidency(metros, CFG, FleetConfig(
+            max_resident_bytes=budget, evict_watermark=1.0,
+            promote_wait_s=30.0))
+        release = threading.Event()
+
+        def hold():
+            with fr.lease("m0"):
+                release.wait(60)
+
+        t = threading.Thread(target=hold)
+        t.start()
+        while fr.occupancy()["metros"]["m0"]["leases"] == 0:
+            pass                       # lease is up
+        timer = threading.Timer(0.2, release.set)
+        timer.start()
+        fr.promote("m1")               # blocks ~0.2 s, then evicts m0
+        t.join(60)
+        assert fr.resident_names == ["m1"]
+        assert fr.metrics.value('fleet_promote_waits{metro="m1"}') >= 1
+        # blocked by a PIN instead: no wait can help — shed immediately
+        fr2 = FleetResidency(metros, CFG, FleetConfig(
+            max_resident_bytes=budget, evict_watermark=1.0,
+            pins=("m0",), promote_wait_s=30.0))
+        fr2.promote("m0")
+        t0 = time.perf_counter()
+        with pytest.raises(FleetCapacityError):
+            fr2.promote("m1")
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_promotion_does_not_stall_other_metros(self, metros,
+                                                   monkeypatch):
+        """The fleet lock guards the LEDGER only: one cold metro's
+        expensive page-in (staging build / device_put) must not block a
+        hot metro's lease behind the global lock."""
+        fr = FleetResidency(metros, CFG)
+        fr.promote("m0")                   # m0 hot
+        orig = type(metros[1]).host_tables
+
+        def slow(ts_self, backend="both"):
+            time.sleep(1.0)
+            return orig(ts_self, backend)
+
+        monkeypatch.setattr(type(metros[1]), "host_tables", slow)
+        t = threading.Thread(target=fr.promote, args=("m1",))
+        t.start()
+        time.sleep(0.2)                    # m1's staging build in flight
+        t0 = time.perf_counter()
+        with fr.lease("m0"):
+            pass
+        hot_lease_s = time.perf_counter() - t0
+        t.join(30)
+        assert "m1" in fr.resident_names
+        # generous bound: the hot lease ran DURING m1's 1 s build
+        assert hot_lease_s < 0.5, hot_lease_s
+
+    def test_concurrent_touches_promote_once(self, metros, monkeypatch):
+        """Two threads racing a cold metro: one promotes, the other
+        waits on the condvar for the SAME tables — never a double
+        promotion (which would double-count ledger bytes)."""
+        fr = FleetResidency(metros, CFG)
+        orig = type(metros[2]).host_tables
+
+        def slow(ts_self, backend="both"):
+            time.sleep(0.4)
+            return orig(ts_self, backend)
+
+        monkeypatch.setattr(type(metros[2]), "host_tables", slow)
+        got: list = []
+
+        def touch():
+            with fr.lease("m2") as m:
+                got.append(m)
+
+        threads = [threading.Thread(target=touch) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len(got) == 4 and all(m is got[0] for m in got)
+        occ = fr.occupancy()["metros"]["m2"]
+        assert occ["promotions"] == 1
+        assert fr.resident_bytes == occ["staged_bytes"]
+
+    def test_demote_under_lease_refused(self, metros):
+        fr = FleetResidency(metros, CFG)
+        with fr.lease("m0"):
+            with pytest.raises(RuntimeError, match="in.*flight"):
+                fr.demote("m0")
+        fr.demote("m0")                # lease released → allowed
+        assert fr.resident_names == []
+
+    def test_unbounded_budget_never_pages(self, metros):
+        fr = FleetResidency(metros, CFG)       # max_resident_bytes=0
+        for n in ("m0", "m1", "m2"):
+            fr.promote(n)
+        assert len(fr.resident_names) == 3
+        assert fr.occupancy()["demotions"] == 0
+        assert fr.occupancy()["occupancy_frac"] is None
+
+    def test_validation_errors(self, metros):
+        with pytest.raises(ValueError, match="duplicate"):
+            FleetResidency([metros[0], metros[0]], CFG)
+        with pytest.raises(ValueError, match="pins for unknown"):
+            FleetResidency(metros, CFG, FleetConfig(pins=("atlantis",)))
+        with pytest.raises(ValueError, match="configs for unknown"):
+            FleetResidency(metros, CFG, configs={"atlantis": CFG})
+        with pytest.raises(ValueError, match="watermark"):
+            FleetConfig(evict_watermark=0.0).validate()
+        with pytest.raises(ValueError, match="max_resident_bytes"):
+            FleetConfig(max_resident_bytes=-1).validate()
+        with pytest.raises(ValueError, match="promote_wait_s"):
+            FleetConfig(promote_wait_s=-1.0).validate()
+        with pytest.raises(ValueError, match="promote_timeout_s"):
+            FleetConfig(promote_timeout_s=-1.0).validate()
+        with pytest.raises(ValueError, match="jax"):
+            FleetResidency(metros, Config(matcher_backend="reference_cpu"))
+        # a divergent per-metro backend fails at CONSTRUCTION, not on
+        # the metro's first touch (it would 503 forever)
+        with pytest.raises(ValueError, match="matcher_backend='jax'"):
+            FleetResidency(metros, CFG, configs={
+                "m0": Config(matcher_backend="reference_cpu")})
+        with pytest.raises(KeyError, match="unknown metro"):
+            FleetResidency(metros, CFG).promote("atlantis")
+
+    def test_per_metro_config_stages_its_own_layout(self, metros,
+                                                    staged_bytes):
+        """A per-metro candidate_backend override must stage the table
+        set ITS matcher sweeps, not the fleet default's."""
+        import dataclasses
+
+        from reporter_tpu.config import MatcherParams
+
+        cfg_dense = dataclasses.replace(
+            CFG, matcher=dataclasses.replace(MatcherParams(),
+                                             candidate_backend="dense"))
+        fr = FleetResidency(metros, CFG, configs={"m0": cfg_dense})
+        fr.promote("m0")
+        fr.promote("m1")
+        occ = fr.occupancy()["metros"]
+        # m0 staged the DENSE layout (seg_pack, no cell_pack); m1 the
+        # fleet default's (auto→grid on CPU)
+        want_dense = sum(v.nbytes
+                         for v in metros[0].host_tables("dense").values())
+        assert occ["m0"]["staged_bytes"] == want_dense
+        assert occ["m0"]["staged_bytes"] != staged_bytes[0]
+        assert occ["m1"]["staged_bytes"] == staged_bytes[1]
+
+    def test_env_overrides(self, metros):
+        fc = FleetConfig().with_env_overrides({
+            "RTPU_FLEET_MAX_BYTES": "1e6",
+            "RTPU_FLEET_WATERMARK": "0.7",
+            "RTPU_FLEET_PINS": "m0, m2",
+            "RTPU_FLEET_PROMOTE_WAIT": "1.5",
+            "RTPU_FLEET_PROMOTE_TIMEOUT": "2.5"})
+        assert fc.max_resident_bytes == 1_000_000
+        assert fc.evict_watermark == 0.7
+        assert fc.pins == ("m0", "m2")
+        assert fc.promote_wait_s == 1.5
+        assert fc.promote_timeout_s == 2.5
+        # env pins MERGE with constructor pins, deduplicated
+        fc2 = FleetConfig(pins=("m1",)).with_env_overrides(
+            {"RTPU_FLEET_PINS": "m1,m0"})
+        assert fc2.pins == ("m1", "m0")
+
+
+class TestCapacityEdges:
+    """Budget geometries where naive eviction strips the fleet cold."""
+
+    @pytest.fixture(scope="class")
+    def sized(self):
+        small = [_make_metro(20), _make_metro(21)]
+        big = _make_metro(22, nx=9, ny=9)
+        sizes = [sum(v.nbytes for v in ts.host_tables("auto").values())
+                 for ts in (*small, big)]
+        return small, big, sizes
+
+    def test_oversized_metro_sheds_without_mass_eviction(self, sized):
+        """A metro whose tables exceed the whole budget must shed
+        BEFORE the LRU scan — a hopeless promotion (retried on every
+        503) must not strip the resident fleet cold each attempt."""
+        small, big, (s0, s1, sb) = sized
+        assert sb > s0 + s1            # precondition: big alone over cap
+        fr = FleetResidency([*small, big], CFG, FleetConfig(
+            max_resident_bytes=s0 + s1, evict_watermark=1.0))
+        for ts in small:
+            fr.promote(ts.name)
+        with pytest.raises(FleetCapacityError,
+                           match="exceed the fleet budget"):
+            fr.promote(big.name)
+        # the resident fleet was NOT touched
+        assert fr.resident_names == sorted(ts.name for ts in small)
+        assert fr.occupancy()["demotions"] == 0
+
+    def test_watermark_unreachable_evicts_minimally(self, sized):
+        """staged_bytes in (watermark*cap, cap]: the evict target
+        clamps to the hard cap, so eviction stops as soon as the
+        promotion fits instead of draining the whole fleet toward an
+        unreachable watermark target."""
+        small, big, (s0, s1, sb) = sized
+        cap = sb + max(s0, s1)          # big + one small can co-reside
+        assert cap * 0.5 < sb <= cap    # watermark slice unreachable
+        fr = FleetResidency([*small, big], CFG, FleetConfig(
+            max_resident_bytes=cap, evict_watermark=0.5))
+        for ts in small:
+            fr.promote(ts.name)
+        fr.promote(big.name)
+        occ = fr.occupancy()
+        # exactly ONE small (the LRU one) was evicted; pre-clamp this
+        # drained both toward the unreachable 0.5*cap target
+        assert occ["demotions"] == 1
+        assert big.name in fr.resident_names
+        assert len(fr.resident_names) == 2
+
+
+class TestBitIdentity:
+    def test_wire_bytes_match_dedicated_through_paging(self, metros,
+                                                       staged_bytes):
+        """THE acceptance contract: fleet-resident wire bytes equal a
+        dedicated matcher's — before paging, and immediately after an
+        evict→promote cycle of the same metro."""
+        ts = metros[0]
+        traces = [Trace.from_json(_payload(ts, seed=s), ts)
+                  for s in (5, 6, 7)]
+        want = _wire_bytes(SegmentMatcher(ts, CFG), traces)
+
+        budget = staged_bytes[0] + staged_bytes[1] // 2
+        fr = FleetResidency(metros, CFG, FleetConfig(
+            max_resident_bytes=budget, evict_watermark=1.0))
+        with fr.lease("m0") as m:
+            assert _wire_bytes(m, traces) == want
+        fr.promote("m1")               # evicts m0 (LRU, budget of one)
+        assert fr.resident_names == ["m1"]
+        assert fr.occupancy()["metros"]["m0"]["demotions"] == 1
+        with fr.lease("m0") as m:      # promote back in
+            assert m.tables_staged
+            assert _wire_bytes(m, traces) == want
+        # the matcher OBJECT survived paging (compiled executables kept)
+        assert fr.matcher("m0") is m
+
+    def test_unstaged_dispatch_fails_loudly(self, metros):
+        ts = metros[0]
+        m = SegmentMatcher(ts, CFG)
+        m.unstage_tables()
+        assert not m.tables_staged
+        with pytest.raises(RuntimeError, match="unstaged"):
+            m.match_many([Trace.from_json(_payload(ts), ts)])
+
+    def test_paging_guards_non_jax_paths(self, metros):
+        ref = SegmentMatcher(metros[0], Config(
+            matcher_backend="reference_cpu"))
+        assert not ref.tables_staged
+        with pytest.raises(ValueError, match="single-device jax"):
+            ref.unstage_tables()
+        with pytest.raises(ValueError, match="matcher_backend='jax'"):
+            SegmentMatcher(metros[0], Config(
+                matcher_backend="reference_cpu"), staged_tables={})
+
+    def test_unstaged_guard_covers_every_device_entry(self, metros):
+        """The loud guard must fire on ALL dispatch entries, not just
+        match_many's watchdog path — matched_points and match_topk reach
+        the tables through different seams and used to die with a shape
+        error three layers down."""
+        ts = metros[0]
+        m = SegmentMatcher(ts, CFG)
+        trace = Trace.from_json(_payload(ts), ts)
+        m.unstage_tables()
+        with pytest.raises(RuntimeError, match="unstaged"):
+            m.matched_points(trace)
+        with pytest.raises(RuntimeError, match="unstaged"):
+            m.match_topk(trace)
+        with pytest.raises(RuntimeError, match="unstaged"):
+            m._submit_many([trace])
+
+
+class TestPromoteWatchdog:
+    """promote_timeout_s: the page-in device_put is a device interaction
+    on the serving path, and the tunnel dies by HANGING — unbounded, one
+    dead-tunnel promotion would hold ``promoting`` forever and park
+    every later toucher of that metro on the condvar."""
+
+    def test_timeout_sheds_rolls_back_and_recovers(self, metros):
+        from reporter_tpu import faults
+
+        fr = FleetResidency(metros, CFG, FleetConfig(
+            promote_timeout_s=0.2))
+        plan = faults.FaultPlan.parse("fleet_promote:hang(1.5)@0")
+        with faults.use(plan):
+            with pytest.raises(ServiceOverloaded, match="exceeded"):
+                fr.promote("m0")
+            # ledger fully rolled back; the metro is retryable
+            assert fr.resident_bytes == 0
+            assert fr.resident_names == []
+            occ = fr.occupancy()["metros"]["m0"]
+            assert occ["promotions"] == 0
+            assert fr.metrics.value(
+                'fleet_promote_timeouts{metro="m0"}') == 1
+            # the link "recovers" (rule window was call 0 only): the
+            # next touch re-promotes and serves
+            with fr.lease("m0") as m:
+                assert m.tables_staged
+        assert fr.resident_names == ["m0"]
+
+    def test_waiters_unblock_when_promotion_sheds(self, metros):
+        """A thread parked on the condvar behind a hanging promotion
+        must wake when the promoter sheds, then re-promote ITSELF."""
+        from reporter_tpu import faults
+
+        fr = FleetResidency(metros, CFG, FleetConfig(
+            promote_timeout_s=0.2))
+        plan = faults.FaultPlan.parse("fleet_promote:hang(1.5)@0")
+        results: dict = {}
+
+        def promoter():
+            try:
+                fr.promote("m1")
+            except ServiceOverloaded as exc:
+                results["promoter"] = exc
+
+        def waiter():
+            with fr.lease("m1") as m:
+                results["waiter"] = m.tables_staged
+
+        with faults.use(plan):
+            a = threading.Thread(target=promoter)
+            a.start()
+            time.sleep(0.05)            # a is inside the hung transfer
+            b = threading.Thread(target=waiter)
+            b.start()                   # b parks on the condvar
+            a.join(30)
+            b.join(30)
+        assert isinstance(results["promoter"], ServiceOverloaded)
+        assert results["waiter"] is True     # b re-promoted (call 1: no
+        assert fr.resident_names == ["m1"]   # rule) and served
+
+    def test_breaker_opens_at_abandoned_cap(self, metros):
+        fr = FleetResidency(metros, CFG, FleetConfig(
+            promote_timeout_s=0.2))
+        with fr._watchdog.lock:
+            fr._watchdog.abandoned = fr._watchdog.cap
+        with pytest.raises(ServiceOverloaded, match="breaker open"):
+            fr.promote("m0")
+        assert fr.metrics.value("fleet_promote_breaker_open") == 1
+        # timeout series keeps moving while the breaker is open
+        assert fr.metrics.value(
+            'fleet_promote_timeouts{metro="m0"}') == 1
+        with fr._watchdog.lock:
+            fr._watchdog.abandoned = 0
+        fr.promote("m0")                     # breaker closed → serves
+        assert fr.resident_names == ["m0"]
+
+    def test_doomed_promotion_sheds_immediately(self):
+        """Finding-4 regression: a promoter parked in ITS capacity wait
+        holds nothing in the ledger yet — a second promotion that could
+        never fit even after every transient frees must shed NOW, not
+        after burning the whole promote_wait_s."""
+        a, b = _make_metro(10), _make_metro(11)
+        big = _make_metro(12, nx=9, ny=9)
+        sizes = {ts.name: sum(v.nbytes
+                              for v in ts.host_tables("auto").values())
+                 for ts in (a, b, big)}
+        sa, sb, sc = sizes[a.name], sizes[b.name], sizes[big.name]
+        cap = sa + (3 * sb) // 4        # b does NOT fit beside a → its
+        #                                 promoter parks while a is leased
+        # precondition for the regression to bite: pre-fix, counting the
+        # parked promoter's unreserved bytes made `big` LOOK servable
+        # (sc - sb <= cap) while post-fix freeable (just `a`) says it
+        # never fits (sc > cap)
+        assert cap < sc <= cap + sb, (sa, sb, sc)
+        fr = FleetResidency([a, b, big], CFG, FleetConfig(
+            max_resident_bytes=cap, evict_watermark=1.0,
+            promote_wait_s=3.0))
+        fr.promote(a.name)
+        shed_s: dict = {}
+
+        def promote_b():
+            fr.promote(b.name)          # parks: `a` is leased
+
+        with fr.lease(a.name):
+            t = threading.Thread(target=promote_b)
+            t.start()
+            time.sleep(0.2)             # b's promoter is in its wait
+            t0 = time.perf_counter()
+            with pytest.raises(FleetCapacityError):
+                fr.promote(big.name)
+            shed_s["big"] = time.perf_counter() - t0
+        t.join(30)
+        # pre-fix this waited the full promote_wait_s (3 s)
+        assert shed_s["big"] < 1.0, shed_s
+        # b's parked promoter woke on the lease release and landed
+        # (evicting now-unleased a — LRU)
+        assert fr.resident_names == [b.name]
+
+
+class TestFleetRouter:
+    @pytest.fixture(scope="class")
+    def router(self, metros, staged_bytes):
+        r = FleetRouter(
+            metros, CFG, transport=lambda u, b: 200,
+            fleet=FleetConfig(
+                max_resident_bytes=(staged_bytes[0] + staged_bytes[1]
+                                    + staged_bytes[2] // 2),
+                evict_watermark=1.0),
+            slos={"m0": MetroSLO(deadline_ms=2.0, queue_limit=64),
+                  "m1": MetroSLO(pinned=True)})
+        yield r
+        r.close()
+
+    def test_geo_routing_with_paging(self, router, metros):
+        for ts in metros:               # 3 metros through a 2-metro budget
+            out = router.report_one(_payload(ts))
+            assert out["metro"] == ts.name
+        occ = router.residency.occupancy()
+        assert occ["promotions"] >= 3
+        assert occ["demotions"] >= 1            # the budget forced paging
+        assert occ["resident_metros"] == 2
+        # m1 is SLO-pinned: it survived the whole rotation
+        assert "m1" in router.residency.resident_names
+
+    def test_slo_maps_to_scheduler_config(self, router):
+        c0 = router._configs["m0"]
+        assert c0.service.batch_close_ms == 2.0
+        assert c0.service.admission_queue_limit == 64
+        assert "m1" in router.residency.fleet.pins
+        # unknown-metro SLO rejected at construction
+        with pytest.raises(ValueError, match="SLOs for unknown"):
+            FleetRouter([_make_metro(9)], CFG,
+                        slos={"nope": MetroSLO()})
+        # "fleet" keys the residency section in /stats — reserved
+        reserved = _make_metro(9)
+        reserved.name = "fleet"
+        with pytest.raises(ValueError, match="reserved"):
+            FleetRouter([reserved], CFG)
+
+    def test_batch_groups_by_metro(self, router, metros):
+        payloads = [_payload(metros[2], seed=8), _payload(metros[0], seed=9),
+                    _payload(metros[2], seed=10)]
+        outs = router.report_many(payloads)
+        assert [o["metro"] for o in outs] == ["m2", "m0", "m2"]
+
+    def test_results_match_dedicated_app(self, router, metros):
+        """Per-metro fidelity through the full router+paging stack: the
+        decoded segments equal a dedicated single-metro app's."""
+        from reporter_tpu.service.app import ReporterApp
+
+        for ts in metros:
+            p = _payload(ts, seed=11)
+            want_app = ReporterApp(ts, CFG, transport=lambda u, b: 200)
+            want = want_app.report_one(p)
+            got = router.report_one(p)
+            assert ([s["segment_id"] for s in got["segments"]]
+                    == [s["segment_id"] for s in want["segments"]])
+            want_app.close()
+
+    def test_health_stats_metrics_surfaces(self, router):
+        from tests.test_service import wsgi_call
+
+        status, h = wsgi_call(router, "GET", "/health")
+        assert status == 200
+        assert h["fleet"]["registered_metros"] == 3
+        assert h["fleet"]["resident_metros"] == 2
+        assert set(h["fleet"]["metros"]) == {"m0", "m1", "m2"}
+        status, s = wsgi_call(router, "GET", "/stats")
+        assert status == 200 and "fleet" in s
+        assert s["fleet"]["occupancy"]["promotions"] >= 3
+        txt = router.render_prometheus()
+        assert 'rtpu_fleet_promotions{metro="m0"}' in txt
+        assert "rtpu_fleet_resident_bytes_total" in txt
+        assert "# TYPE rtpu_fleet_promote_seconds histogram" in txt
+        # labeled series share ONE TYPE line per base metric name
+        assert txt.count("# TYPE rtpu_fleet_promotions counter") == 1
+
+    def test_unroutable_404_names_known_metros(self, router):
+        from tests.test_service import wsgi_call
+
+        before = router.metrics.value("router_unroutable")
+        status, body = wsgi_call(router, "POST", "/report", {
+            "uuid": "x", "trace": [{"lat": -45.0, "lon": 100.0}]})
+        assert status == 404
+        assert body["known_metros"] == ["m0", "m1", "m2"]
+        assert router.metrics.value("router_unroutable") == before + 1
+        # explicit-but-unknown metro stays a 400 (client named it wrong)
+        status, body = wsgi_call(router, "POST", "/report", {
+            "uuid": "x", "metro": "atlantis",
+            "trace": [{"lat": 37.0, "lon": -120.0}]})
+        assert status == 400
+
+    def test_concurrent_mixed_traffic_with_paging(self, router, metros):
+        """Leases make promote→dispatch atomic against eviction: hammer
+        all three metros from threads through a budget that only fits
+        two, and every response must be correct and complete."""
+        errors: list = []
+
+        def worker(i):
+            ts = metros[i % 3]
+            try:
+                out = router.report_one(_payload(ts, seed=30 + i))
+                assert out["metro"] == ts.name
+            except Exception as e:     # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(9)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors
+
+
+class TestStackAndDispatchEdges:
+    """stack_tilesets / dispatch_traces edge coverage (ISSUE 6
+    satellite): heterogeneous metro sizes, duplicates, degenerate
+    single-metro stacks. Host-side only — no mesh compile, so these
+    stay inside tier-1 (the mesh product-path suites are slow-marked)."""
+
+    def test_heterogeneous_sizes_nan_pad_exact(self, metros):
+        """Max-disparity stack (6×6 vs 16×16): every metro's REAL rows
+        survive verbatim, padding is the documented invalid encoding."""
+        big = _make_metro(7, nx=16, ny=16)
+        from reporter_tpu.parallel.multimetro import stack_tilesets
+
+        small = metros[0]
+        stacked = stack_tilesets([small, big])
+        assert stacked.names == (small.name, big.name)
+        assert stacked.osmlr_pad == max(stacked.num_osmlr)
+        inval = np.int32(-1).view(np.float32)
+        for m, ts in enumerate((small, big)):
+            host = ts.host_tables("both")
+            for key in ("seg_pack", "seg_bbox", "reach_to", "reach_dist",
+                        "edge_len", "edge_osmlr"):
+                got = np.asarray(stacked.tables[key][m])
+                want = host[key]
+                sl = tuple(slice(0, s) for s in want.shape)
+                np.testing.assert_array_equal(
+                    got[sl], want, err_msg=f"{ts.name}:{key}")
+            # the small metro's PADDED seg_bbox rows can never overlap
+            # a query bbox (NaN compares false)
+            n_real = host["seg_bbox"].shape[0]
+            pad = np.asarray(stacked.tables["seg_bbox"][m][n_real:])
+            assert pad.size == 0 or np.isnan(pad).all()
+            # padded seg_pack edge components decode as invalid (-1)
+            n_rows = host["seg_pack"].shape[0]
+            pad_pack = np.asarray(stacked.tables["seg_pack"][m][n_rows:])
+            assert pad_pack.size == 0 or (
+                pad_pack.view(np.int32) == inval.view(np.int32)).all()
+
+    def test_duplicate_names(self, metros):
+        """Stacking is POSITIONAL (duplicate names legal — the mesh
+        suites stack two differently-seeded "tiny" metros); the
+        name-keyed dispatch map is where duplicates would silently
+        merge two metros' traffic, so THAT rejects them."""
+        from reporter_tpu.parallel.multimetro import (dispatch_traces,
+                                                      stack_tilesets)
+
+        big = _make_metro(7, nx=16, ny=16)
+        clone = _make_metro(8, nx=6, ny=6)
+        clone.name = big.name              # duplicate name, distinct tiles
+        stacked = stack_tilesets([big, clone])
+        assert stacked.names == (big.name, big.name)
+        for m, ts in enumerate((big, clone)):     # rows stay positional
+            np.testing.assert_array_equal(
+                np.asarray(stacked.tables["edge_len"][m])[:ts.num_edges],
+                ts.host_tables("both")["edge_len"])
+        with pytest.raises(ValueError, match="duplicate"):
+            dispatch_traces(("a", "a"),
+                            [("a", np.ones((2, 2), np.float32))],
+                            dp=1, bucket=8)
+
+    def test_single_metro_degenerate_stack(self, metros):
+        from reporter_tpu.parallel.multimetro import (dispatch_traces,
+                                                      stack_tilesets)
+
+        ts = metros[0]
+        stacked = stack_tilesets([ts])
+        assert stacked.names == (ts.name,)
+        host = ts.host_tables("both")
+        for key in ("seg_pack", "edge_len", "reach_to"):
+            got = np.asarray(stacked.tables[key][0])
+            np.testing.assert_array_equal(got, host[key])
+        mb = dispatch_traces((ts.name,),
+                             [(ts.name, np.ones((4, 2), np.float32))],
+                             dp=1, bucket=8)
+        assert mb.points.shape[0] == 1
+        assert mb.index[0] == [(0, 0, 4)]
+
+
+class TestLabeledMetrics:
+    """utils.metrics.labeled — the per-metro series spelling."""
+
+    def test_key_grammar_and_sorting(self):
+        from reporter_tpu.utils.metrics import labeled
+
+        assert labeled("fleet_hits") == "fleet_hits"
+        assert labeled("fleet_hits", metro="sf") == 'fleet_hits{metro="sf"}'
+        # label order is sorted → one logical series, one key
+        assert (labeled("x", b="2", a="1")
+                == labeled("x", a="1", b="2") == 'x{a="1",b="2"}')
+        # values are sanitized (no quote/backslash/newline injection)
+        assert labeled("x", m='a"b\\c\nd') == 'x{m="a_b_c_d"}'
+
+    def test_labeled_stage_timer_derives_suffixed_series(self):
+        """stage(labeled(...)) must put the _seconds suffix BEFORE the
+        label block — concatenation would fork a braces-mid-name key
+        that render_prometheus mangles."""
+        from reporter_tpu.utils.metrics import MetricsRegistry, labeled
+
+        reg = MetricsRegistry()
+        with reg.stage(labeled("fleet_stage", metro="sf")):
+            pass
+        snap = reg.snapshot()
+        assert 'fleet_stage_seconds_count{metro="sf"}' in snap
+        assert 'rtpu_fleet_stage_seconds_bucket{metro="sf",le=' \
+            in reg.render_prometheus()
+
+    def test_labeled_histogram_exposition(self):
+        from reporter_tpu.utils.metrics import MetricsRegistry, labeled
+
+        reg = MetricsRegistry()
+        reg.observe(labeled("promote_seconds", metro="sf"), 0.002)
+        reg.observe(labeled("promote_seconds", metro="nyc"), 0.2)
+        snap = reg.snapshot()
+        # derived series keep the label block OUTSIDE the suffix
+        assert 'promote_seconds_count{metro="sf"}' in snap
+        assert snap['promote_seconds_p50{metro="nyc"}'] == 0.2
+        txt = reg.render_prometheus()
+        assert txt.count("# TYPE rtpu_promote_seconds histogram") == 1
+        assert 'rtpu_promote_seconds_bucket{metro="sf",le="0.0025"} 1' in txt
+        assert 'rtpu_promote_seconds_sum{metro="nyc"}' in txt
+        assert 'rtpu_promote_seconds_count{metro="sf"} 1' in txt
