@@ -948,6 +948,60 @@ def _latency_attribution(ts, traces, n_stream: int, offered_pps: int,
     }
 
 
+def _prepare_bench(ts, traces, n: int = 2048, reps: int = 5) -> dict:
+    """detail.prepare_bench (ISSUE 7 satellite): standalone host-prepare
+    throughput — the submit leg's pad → i16 quantize → i8 delta pack on
+    a real trace slice — as a native-vs-Python A/B, in krows/s of probe
+    points through the prepare. Also re-proves the byte-identity
+    contract on EVERY composite (the sweep_ab discipline): same wire
+    mode, same buffer bytes, Morton keys included — so a native drift
+    shows up as ``bytes_identical: false`` in the capture, not as a
+    silent result fork in production."""
+    import numpy as np
+
+    from reporter_tpu.matcher import native_prepare
+    from reporter_tpu.matcher.api import _bucket_len
+
+    xys = [t.xy for t in traces[:n]]
+    b = max(_bucket_len(len(xy)) for xy in xys)
+    total = sum(len(xy) for xy in xys)
+    t_py = _time_best(lambda: native_prepare.prepare_slice_python(xys, b),
+                      reps)
+    out = {
+        "config": f"{len(xys)} traces x bucket {b}, tile={ts.name}",
+        "rows": int(total),
+        "bucket": int(b),
+        "python_krows_per_s": round(total / t_py / 1e3, 1),
+        "native_available": bool(native_prepare.available()),
+    }
+    if not native_prepare.available():
+        out.update({"native_krows_per_s": None, "speedup": None,
+                    "bytes_identical": None})
+        return out
+    t_nat = _time_best(lambda: native_prepare.prepare_slice(xys, b), reps)
+    pm, ppts, plens, porg, ppay = native_prepare.prepare_slice_python(xys, b)
+    nm, npts, nlens, norg, npay = native_prepare.prepare_slice(xys, b)
+    same = (pm == nm and ppts.tobytes() == npts.tobytes()
+            and plens.tobytes() == nlens.tobytes()
+            and porg.tobytes() == norg.tobytes()
+            and ((ppay is None and npay is None)
+                 or ppay.tobytes() == npay.tobytes()))
+    first = np.zeros((len(xys), 2), np.float64)
+    for w, xy in enumerate(xys):
+        if len(xy):
+            first[w] = xy[0]
+    same = same and bool(np.array_equal(
+        native_prepare.morton_keys(first),
+        native_prepare.morton_keys_python(first)))
+    out.update({
+        "native_krows_per_s": round(total / t_nat / 1e3, 1),
+        "speedup": round(t_py / t_nat, 2),
+        "wire_mode": int(nm),
+        "bytes_identical": bool(same),
+    })
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Chaos legs (ISSUE 4): kill-and-recover at soak scale, live multi-process
 # consumer group, fault-injected publisher outage. The worker under test
@@ -2963,6 +3017,13 @@ def main() -> None:
             offered_pps=(50_000 if tpu_ok else 2_000), seconds=5.0)
         split["latency_attribution_s"] = round(time.perf_counter() - t0, 1)
 
+    # Host-prepare micro A/B (ISSUE 7): runs on EVERY composite —
+    # native-vs-Python prepare throughput plus the wire byte-identity
+    # re-proof (the sweep_ab discipline applied to the submit leg).
+    t0 = time.perf_counter()
+    detail["prepare_bench"] = _prepare_bench(ts, traces)
+    split["prepare_bench_s"] = round(time.perf_counter() - t0, 1)
+
     # Metro fleet residency (ISSUE 6) runs on EVERY composite: N>=8
     # generated metros served from this one process — steady-state mixed
     # traffic, a cold-metro promotion storm through a half-size budget,
@@ -3024,6 +3085,12 @@ def _summary_line(doc: dict) -> dict:
     # pin had no room for six names twice) — order is always [sf,
     # bayarea, sf+r, bayarea-xl, organic, organic-xl]; exact values keep
     # their names in the detail file
+    # device string truncated at its parenthetical (the "(remote axon
+    # tunnel, 1 device)" tail is constant provenance — the full string
+    # stays in the detail file); the r12 prep token needed the bytes
+    dev = d.get("device")
+    if isinstance(dev, str):
+        dev = dev.split(" (", 1)[0]
     tiles_kpps: list = [int(doc["value"] / 1e3)]
     for key in ("metro", "restricted", "xl", "organic", "organic_xl"):
         v = _g(key, "probes_per_sec_e2e")
@@ -3038,7 +3105,7 @@ def _summary_line(doc: dict) -> dict:
         "value": doc["value"],
         "unit": doc["unit"],
         "vs_baseline": doc["vs_baseline"],
-        "device": d.get("device"),
+        "device": dev,
         "tiles_kpps": tiles_kpps,
         "e2e_over_decode": d.get("e2e_over_decode"),
         "p50_trace_ms": d.get("p50_single_trace_latency_ms"),
@@ -3117,6 +3184,17 @@ def _summary_line(doc: dict) -> dict:
         "lattr": [_g("latency_attribution", "e2e_p50_ms"),
                   _g("latency_attribution", "stage_sum_over_e2e_p50"),
                   _g("latency_attribution", "tracing_overhead_pct")],
+        # host-prepare A/B headline (full leg in detail.prepare_bench):
+        # [native krows/s through the submit-leg prepare (int), speedup
+        # vs the numpy reference (1 decimal), wire bytes identical
+        # native-vs-Python (must be 1)] — exact values in the detail
+        "prep": [
+            (None if _g("prepare_bench", "native_krows_per_s") is None
+             else int(_g("prepare_bench", "native_krows_per_s"))),
+            (None if _g("prepare_bench", "speedup") is None
+             else round(_g("prepare_bench", "speedup"), 1)),
+            (None if _g("prepare_bench", "bytes_identical") is None
+             else int(bool(_g("prepare_bench", "bytes_identical"))))],
         # fleet residency headline (full leg in detail.fleet): [metros
         # served from one process, mixed-traffic kpps, storm promotion
         # p50 ms, total promotions, total demotions, fleet wires

@@ -586,8 +586,16 @@ class SegmentMatcher:
         xy); inflight = [(slice work indices, wire device array)] in
         submission order. Harvesting an inflight wire (np.asarray) blocks
         on the link; callers decide what to overlap with that wait.
+
+        The per-slice prepare — pad → i16 quantize → i8 delta pack with
+        the exact overflow fallbacks — is ONE implementation in two
+        forms (matcher/native_prepare): the C entry when the library is
+        up, the byte-identical numpy reference otherwise. Which form
+        served is counted (prepare_native_total / prepare_python_total)
+        so a silent native-build failure degrading to Python shows at
+        /stats and /metrics.
         """
-        from reporter_tpu.ops.match import OFFSET_QUANTUM
+        from reporter_tpu.matcher import native_prepare
 
         self._require_staged()
         max_b = _BUCKETS[-1]
@@ -624,25 +632,22 @@ class SegmentMatcher:
         inflight = []
         for b, ws in sliced:
             B = len(ws)
-            pts = np.zeros((B, b, 2), np.float32)
-            lens = np.zeros(B, np.int32)
             xys = [work[w][2] for w in ws]
-            L = len(xys[0]) if xys else 0
-            if L and all(len(xy) == L for xy in xys):
-                # uniform-length slice (the fleet/bench shape): one C-level
-                # stack instead of B row assignments
-                pts[:, :L] = np.stack(xys)
-                pts[:, L:] = pts[:, :1]        # pad at origin: keeps the
-                lens[:] = L                    # quantized form in i16 range
-            else:
-                for r, xy in enumerate(xys):
-                    pts[r, :len(xy)] = xy
-                    if len(xy):
-                        pts[r, len(xy):] = xy[0]
-                        lens[r] = len(xy)
             # Quantized infeed (half the host→device bytes): i16 0.25 m
             # offsets from per-trace origins, unless some trace spans
-            # beyond the i16 range (±8.19 km from its first point).
+            # beyond the i16 range (±8.19 km from its first point);
+            # preferred form is i8 per-step DELTAS of the i16 quanta —
+            # integer diffs cumsum back to the exact same absolutes on
+            # device, so it is bit-identical to the i16 path at half the
+            # bytes. The mode decision + buffer fill is the prepare
+            # entry (native C pass, or the byte-identical numpy form).
+            prep = native_prepare.prepare_slice(xys, b)
+            if prep is None:
+                prep = native_prepare.prepare_slice_python(xys, b)
+                self.metrics.count("prepare_python_total")
+            else:
+                self.metrics.count("prepare_native_total")
+            mode, pts, lens, origins, payload = prep
             # Per-point GPS accuracy → emission distance scaling (see
             # ops/match.match_traces). None for accuracy-less slices: the
             # scale-free executable is traced separately, so the common
@@ -656,27 +661,10 @@ class SegmentMatcher:
                     if a is not None:
                         scale[r] = _accuracy_scale(
                             a[lo:lo + len(xy)], self.params.sigma_z, b)
-            origins = pts[:, 0, :].copy()
-            dq = np.round((pts - origins[:, None, :])
-                          * np.float32(1.0 / OFFSET_QUANTUM))
-            if np.abs(dq).max(initial=0.0) < 32767:
-                # Preferred infeed: i8 per-step DELTAS of the i16 quanta.
-                # Integer diffs cumsum back to the exact same absolutes on
-                # device, so this is bit-identical to the i16 path at half
-                # the bytes — and bytes through the link are the e2e
-                # bottleneck. 1 Hz probes move ≪ the ±31.75 m an i8 step
-                # holds; pad-region deltas are zeroed (padded positions
-                # then sit at the last valid point — mask-excluded either
-                # way). Fallback: i16 absolutes when any step overflows.
-                dqi = dq.astype(np.int32)
-                d8 = np.diff(dqi, axis=1, prepend=dqi[:, :1] * 0)
-                d8[np.arange(b)[None, :] >= lens[:, None]] = 0
-                if np.abs(d8).max(initial=0) < 128:
-                    wire = self._wire.q8(d8.astype(np.int8), origins, lens,
-                                         scale)
-                else:
-                    wire = self._wire.q16(dqi.astype(np.int16), origins,
-                                          lens, scale)
+            if mode == 2:
+                wire = self._wire.q8(payload, origins, lens, scale)
+            elif mode == 1:
+                wire = self._wire.q16(payload, origins, lens, scale)
             else:
                 wire = self._wire.f32(pts, lens, scale)
             inflight.append((ws, wire))
@@ -862,16 +850,19 @@ def _morton_keys(work) -> np.ndarray:
     layout it exploits. One numpy pass + one _morton call: the earlier
     per-trace Python version cost ~0.5 s on a 16k-trace batch — a third
     of the host submit leg, ON the e2e critical path (submit precedes
-    the first device dispatch)."""
-    from reporter_tpu.ops.dense_candidates import _morton
+    the first device dispatch). The key computation rides native_prepare
+    (bit-equal C form when the library is up; the numpy reference
+    otherwise)."""
+    from reporter_tpu.matcher import native_prepare
 
     first = np.zeros((len(work), 2), np.float64)
     for w, (_, _, xy) in enumerate(work):
         if len(xy):
             first[w] = xy[0]
-    q = np.floor(first / 64.0).astype(np.int64) + 0x8000
-    return _morton((q[:, 0] & 0xFFFF).astype(np.uint32),
-                   (q[:, 1] & 0xFFFF).astype(np.uint32))
+    keys = native_prepare.morton_keys(first)
+    if keys is None:
+        keys = native_prepare.morton_keys_python(first)
+    return keys
 
 
 def _to_chains(pts: list[tuple[int, float, bool]], times: np.ndarray,

@@ -1,0 +1,256 @@
+"""ctypes wrapper for the native host-prepare entries (native/prepare.cc).
+
+THE ONE prepare implementation, twice (CLAUDE.md round-12 rule): every
+function here has a native form and a `_python` reference form with a
+BYTE-IDENTICAL output contract — same wire mode, same buffer bytes —
+fuzz-asserted by tests/test_native_prepare.py and re-proven on every
+bench composite (detail.prepare_bench, the sweep_ab discipline). The
+Python forms are not a compatibility shim to drift from: they ARE the
+spec the C entries implement, and the fallback the matcher serves when
+the library is unavailable or disabled.
+
+Knobs: ``REPORTER_TPU_NO_NATIVE`` (the global native kill switch, shared
+with the walker) and ``RTPU_NATIVE_PREPARE=0`` (prepare-only, so the
+walker can stay native while A/B-ing the prepare leg). Callers count
+``prepare_native_total`` / ``prepare_python_total`` so a silent build
+failure degrading to Python is visible at /stats and /metrics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Sequence
+
+import numpy as np
+
+# i16 quantization step in meters (ops.match.OFFSET_QUANTUM — re-imported
+# lazily where jax may not be up; test_native_prepare pins the equality)
+_QUANTUM = 0.25
+
+_lib_cache: "list | None" = None
+
+
+def _env_disabled() -> bool:
+    if os.environ.get("REPORTER_TPU_NO_NATIVE"):
+        return True
+    return os.environ.get("RTPU_NATIVE_PREPARE", "1").strip().lower() in (
+        "0", "off", "false")
+
+
+def _lib():
+    """The loaded library, or None (build failure / env-disabled). The
+    CDLL is cached; the env gate is re-read per call so tests (and
+    operators) can flip RTPU_NATIVE_PREPARE without rebuilding state."""
+    global _lib_cache
+    if _env_disabled():
+        return None
+    if _lib_cache is None:
+        from reporter_tpu.native.build import load_native_lib
+
+        lib = load_native_lib()
+        ok = lib is not None and hasattr(lib, "reporter_prepare_slice")
+        _lib_cache = [lib if ok else None]
+    return _lib_cache[0]
+
+
+def available() -> bool:
+    """True when the native prepare path will serve the next call."""
+    return _lib() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------------------
+# Submit-slice prepare: pad → i16 quantize → i8 delta pack
+
+
+def prepare_slice_python(xys: Sequence[np.ndarray], b: int):
+    """Reference implementation (the numpy body formerly inline in
+    matcher/api._submit_many). Returns (mode, pts, lens, origins,
+    payload): mode 2 ⇒ payload is the i8 delta wire, 1 ⇒ the i16
+    absolute wire (a step overflowed ±127 quanta), 0 ⇒ f32 points (a
+    trace spans past the i16 range — or poison NaN/inf coordinates,
+    which fail the float gate by NaN propagation) and payload is None."""
+    B = len(xys)
+    pts = np.zeros((B, b, 2), np.float32)
+    lens = np.zeros(B, np.int32)
+    L = len(xys[0]) if xys else 0
+    if L and all(len(xy) == L for xy in xys):
+        # uniform-length slice (the fleet/bench shape): one C-level
+        # stack instead of B row assignments
+        pts[:, :L] = np.stack(xys)
+        pts[:, L:] = pts[:, :1]        # pad at origin: keeps the
+        lens[:] = L                    # quantized form in i16 range
+    else:
+        for r, xy in enumerate(xys):
+            pts[r, :len(xy)] = xy
+            if len(xy):
+                pts[r, len(xy):] = xy[0]
+                lens[r] = len(xy)
+    origins = pts[:, 0, :].copy()
+    dq = np.round((pts - origins[:, None, :]) * np.float32(1.0 / _QUANTUM))
+    if np.abs(dq).max(initial=0.0) < 32767:
+        dqi = dq.astype(np.int32)
+        d8 = np.diff(dqi, axis=1, prepend=dqi[:, :1] * 0)
+        d8[np.arange(b)[None, :] >= lens[:, None]] = 0
+        if np.abs(d8).max(initial=0) < 128:
+            return 2, pts, lens, origins, d8.astype(np.int8)
+        return 1, pts, lens, origins, dqi.astype(np.int16)
+    return 0, pts, lens, origins, None
+
+
+def prepare_slice(xys: Sequence[np.ndarray], b: int,
+                  n_threads: "int | None" = None):
+    """Native prepare_slice_python (one C pass over a flat buffer,
+    threaded across rows). None when the library is unavailable — the
+    caller falls back to the Python form and counts it."""
+    lib = _lib()
+    if lib is None:
+        return None
+    B = len(xys)
+    sizes = np.fromiter((len(xy) for xy in xys), np.int64, count=B)
+    if B and int(sizes.max()) > b:
+        # the Python twin fails loudly (broadcast ValueError) on a
+        # violated bucket contract; the C memcpy must never get the
+        # chance to run off the end of a pts row instead
+        raise ValueError(
+            f"trace of {int(sizes.max())} points exceeds bucket {b}")
+    offs = np.zeros(B + 1, np.int64)
+    np.cumsum(sizes, out=offs[1:])
+    if int(offs[-1]):
+        flat = np.ascontiguousarray(np.concatenate(xys), np.float32)
+    else:
+        flat = np.zeros((1, 2), np.float32)     # nonnull base pointer
+    pts = np.empty((B, b, 2), np.float32)
+    lens = np.empty(B, np.int32)
+    origins = np.empty((B, 2), np.float32)
+    dq16 = np.empty((B, b, 2), np.int16)
+    d8 = np.empty((B, b, 2), np.int8)
+    if n_threads is None:
+        n_threads = 1 if B * b < 65536 else min(8, os.cpu_count() or 1)
+    mode = lib.reporter_prepare_slice(
+        _ptr(flat, ctypes.c_float), _ptr(offs, ctypes.c_int64), B, int(b),
+        int(n_threads), _ptr(pts, ctypes.c_float),
+        _ptr(lens, ctypes.c_int32), _ptr(origins, ctypes.c_float),
+        _ptr(dq16, ctypes.c_int16), _ptr(d8, ctypes.c_int8))
+    payload = d8 if mode == 2 else dq16 if mode == 1 else None
+    return int(mode), pts, lens, origins, payload
+
+
+# ---------------------------------------------------------------------------
+# Morton bucket ordering
+
+
+def morton_keys_python(first: np.ndarray) -> np.ndarray:
+    """Reference keys for [W, 2] f64 first points — the numpy body
+    formerly inline in matcher/api._morton_keys (64 m quantization,
+    +0x8000 bias, ops.dense_candidates._morton bit spread)."""
+    from reporter_tpu.ops.dense_candidates import _morton
+
+    q = np.floor(first / 64.0).astype(np.int64) + 0x8000
+    return _morton((q[:, 0] & 0xFFFF).astype(np.uint32),
+                   (q[:, 1] & 0xFFFF).astype(np.uint32))
+
+
+def morton_keys(first: np.ndarray) -> "np.ndarray | None":
+    lib = _lib()
+    if lib is None:
+        return None
+    first = np.ascontiguousarray(first, np.float64)
+    keys = np.empty(len(first), np.uint64)
+    lib.reporter_morton_keys(_ptr(first, ctypes.c_double), len(first),
+                             _ptr(keys, ctypes.c_uint64))
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# Columnar report build (streaming/columnar.build_report_columns's
+# group-id chaining as one C pass)
+
+
+def build_reports(cols, n_traces: "int | None", min_length: float):
+    """Native streaming/columnar.build_report_columns — same return
+    tuple (seg, nxt, t0, t1, length, queue, per_trace). None when the
+    library is unavailable (caller falls back to the numpy builder)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    n = cols.n_records
+    if not n:
+        z = np.empty(0, np.int64)
+        zf = np.empty(0)
+        return z, z, zf, zf, zf, zf, (
+            None if n_traces is None else np.zeros(n_traces, np.int64))
+    trace = np.ascontiguousarray(cols.trace, np.int32)
+    seg = np.ascontiguousarray(cols.segment_id, np.int64)
+    t0 = np.ascontiguousarray(cols.start_time, np.float64)
+    t1 = np.ascontiguousarray(cols.end_time, np.float64)
+    length = np.ascontiguousarray(cols.length, np.float64)
+    queue = np.ascontiguousarray(cols.queue_length, np.float64)
+    internal = np.ascontiguousarray(cols.internal).view(np.uint8)
+    out_seg = np.empty(n, np.int64)
+    out_nxt = np.empty(n, np.int64)
+    out_t0 = np.empty(n, np.float64)
+    out_t1 = np.empty(n, np.float64)
+    out_len = np.empty(n, np.float64)
+    out_queue = np.empty(n, np.float64)
+    # np.bincount(minlength=n_traces) GROWS past minlength when trace
+    # ids exceed it — size the C buffer the same way so an undersized
+    # n_traces reproduces the numpy result instead of writing past the
+    # allocation
+    nt = -1 if n_traces is None else max(int(n_traces),
+                                         int(trace.max()) + 1)
+    per_trace = np.empty(max(nt, 1), np.int64)
+    R = int(lib.reporter_build_reports(
+        _ptr(trace, ctypes.c_int32), _ptr(seg, ctypes.c_int64),
+        _ptr(t0, ctypes.c_double), _ptr(t1, ctypes.c_double),
+        _ptr(length, ctypes.c_double), _ptr(queue, ctypes.c_double),
+        _ptr(internal, ctypes.c_uint8), n, float(min_length), nt,
+        _ptr(out_seg, ctypes.c_int64), _ptr(out_nxt, ctypes.c_int64),
+        _ptr(out_t0, ctypes.c_double), _ptr(out_t1, ctypes.c_double),
+        _ptr(out_len, ctypes.c_double), _ptr(out_queue, ctypes.c_double),
+        _ptr(per_trace, ctypes.c_int64)))
+    return (out_seg[:R], out_nxt[:R], out_t0[:R], out_t1[:R],
+            out_len[:R], out_queue[:R],
+            None if n_traces is None else per_trace[:nt])
+
+
+# ---------------------------------------------------------------------------
+# Batched tail-retention cuts (ColumnarTraceCache.retain's nonzero+max
+# chain, one call per wave instead of per vehicle)
+
+
+def tail_cuts_python(time_flat: np.ndarray, bounds: np.ndarray,
+                     from_time: np.ndarray, max_points: int) -> np.ndarray:
+    """Reference cuts: per vehicle v (times sorted ascending),
+    lo = max(max(0, first_at_or_after(from_time) − 1), n − max_points);
+    lo >= n ⇒ retain nothing (exactly ColumnarTraceCache.retain)."""
+    V = len(bounds) - 1
+    lo = np.empty(V, np.int64)
+    for v in range(V):
+        t = time_flat[bounds[v]:bounds[v + 1]]
+        at = np.nonzero(t >= from_time[v])[0]
+        cut = max(0, int(at[0]) - 1) if len(at) else max(0, len(t) - 1)
+        lo[v] = max(cut, len(t) - max_points)
+    return lo
+
+
+def tail_cuts(time_flat: np.ndarray, bounds: np.ndarray,
+              from_time: np.ndarray,
+              max_points: int) -> "np.ndarray | None":
+    lib = _lib()
+    if lib is None:
+        return None
+    time_flat = np.ascontiguousarray(time_flat, np.float64)
+    bounds = np.ascontiguousarray(bounds, np.int64)
+    from_time = np.ascontiguousarray(from_time, np.float64)
+    V = len(bounds) - 1
+    lo = np.empty(max(V, 1), np.int64)
+    lib.reporter_tail_cuts(
+        _ptr(time_flat, ctypes.c_double), _ptr(bounds, ctypes.c_int64), V,
+        _ptr(from_time, ctypes.c_double), int(max_points),
+        _ptr(lo, ctypes.c_int64))
+    return lo[:V]

@@ -10,6 +10,7 @@ Docker-image build of Valhalla (SURVEY.md §2.1 "Packaging").
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
 import os
 import subprocess
@@ -18,7 +19,7 @@ import tempfile
 log = logging.getLogger("reporter_tpu.native")
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ("reach.cc", "walker.cc")
+_SOURCES = ("reach.cc", "walker.cc", "prepare.cc")
 _LIB_NAME = "_libreporter.so"
 
 
@@ -38,13 +39,36 @@ def _lib_name(sanitize: "str | None") -> str:
     return _LIB_NAME if sanitize is None else f"_libreporter_{sanitize}.so"
 
 
-def _needs_build(lib_path: str) -> bool:
+def _source_digest(sanitize: "str | None") -> str:
+    """Content hash of every source file + the flags that compile them.
+
+    The old mtime comparison (source newer than the committed .so) served
+    a STALE library after any operation that rewinds source mtimes — a
+    branch switch, a ``git checkout`` of older sources, a revert — because
+    the .so's mtime stayed newest. Content addressing can't be fooled by
+    clock order: the digest is stored next to the .so and a mismatch (or
+    a missing sidecar) forces a rebuild."""
+    h = hashlib.sha256()
+    for s in _SOURCES:
+        h.update(s.encode())
+        with open(os.path.join(_SRC_DIR, s), "rb") as f:
+            h.update(f.read())
+    h.update(repr(_SANITIZE_FLAGS[sanitize]).encode())
+    return h.hexdigest()
+
+
+def _hash_path(lib_path: str) -> str:
+    return lib_path + ".hash"
+
+
+def _needs_build(lib_path: str, digest: str) -> bool:
     if not os.path.exists(lib_path):
         return True
-    lib_mtime = os.path.getmtime(lib_path)
-    return any(
-        os.path.getmtime(os.path.join(_SRC_DIR, s)) > lib_mtime
-        for s in _SOURCES)
+    try:
+        with open(_hash_path(lib_path)) as f:
+            return f.read().strip() != digest
+    except OSError:
+        return True     # no sidecar (pre-hash build, or deleted) ⇒ rebuild
 
 
 def build_native_lib(force: bool = False,
@@ -60,7 +84,8 @@ def build_native_lib(force: bool = False,
     import shutil
 
     lib_path = os.path.join(_SRC_DIR, _lib_name(sanitize))
-    if not force and not _needs_build(lib_path):
+    digest = _source_digest(sanitize)
+    if not force and not _needs_build(lib_path, digest):
         return lib_path
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
     tmpdir = tempfile.mkdtemp(prefix="tmpbuild_", dir=_SRC_DIR)
@@ -73,7 +98,14 @@ def build_native_lib(force: bool = False,
             log.warning("native build failed (falling back to Python):\n%s",
                         proc.stderr[-2000:])
             return None
+        # .so first, sidecar after: a crash between the two leaves a
+        # missing/stale sidecar, which _needs_build reads as "rebuild" —
+        # never the reverse (fresh sidecar blessing a stale .so)
         os.replace(tmp, lib_path)
+        tmp_hash = os.path.join(tmpdir, "digest")
+        with open(tmp_hash, "w") as f:
+            f.write(digest)
+        os.replace(tmp_hash, _hash_path(lib_path))
         return lib_path
     except (OSError, subprocess.SubprocessError) as exc:
         log.warning("native build unavailable: %s", exc)
@@ -133,5 +165,31 @@ def load_native_lib(sanitize: "str | None" = None) -> "ctypes.CDLL | None":
         ctypes.c_int64,                              # rec_cap
         i32p, i64p, ctypes.c_int64,                  # way_off, way_ids, way_cap
         i64p,                                        # n_ways_out
+    ]
+    i16p = ctypes.POINTER(ctypes.c_int16)
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.reporter_prepare_slice.restype = ctypes.c_int32
+    lib.reporter_prepare_slice.argtypes = [
+        f32p, i64p,                                  # xy flat, offs
+        ctypes.c_int64, ctypes.c_int64,              # B, b
+        ctypes.c_int32,                              # n_threads
+        f32p, i32p, f32p,                            # pts, lens, origins
+        i16p, i8p,                                   # dq16, d8
+    ]
+    lib.reporter_morton_keys.restype = None
+    lib.reporter_morton_keys.argtypes = [f64p, ctypes.c_int64, u64p]
+    lib.reporter_build_reports.restype = ctypes.c_int64
+    lib.reporter_build_reports.argtypes = [
+        i32p, i64p, f64p, f64p, f64p, f64p, u8p,     # record columns
+        ctypes.c_int64, ctypes.c_double,             # n, min_length
+        ctypes.c_int64,                              # n_traces (-1 = skip)
+        i64p, i64p, f64p, f64p, f64p, f64p,          # outputs
+        i64p,                                        # per_trace
+    ]
+    lib.reporter_tail_cuts.restype = None
+    lib.reporter_tail_cuts.argtypes = [
+        f64p, i64p, ctypes.c_int64,                  # time_flat, bounds, V
+        f64p, ctypes.c_int64, i64p,                  # from_time, max_pts, lo
     ]
     return lib

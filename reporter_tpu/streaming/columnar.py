@@ -336,11 +336,15 @@ class ColumnarIngestQueue:
 
 
 class _TailEntry:
-    __slots__ = ("lat", "lon", "time", "acc", "wall")
+    __slots__ = ("lat", "lon", "time", "acc", "wall", "last")
 
-    def __init__(self, lat, lon, time_, acc, wall):
+    def __init__(self, lat, lon, time_, acc, wall, last=None):
         self.lat, self.lon, self.time, self.acc = lat, lon, time_, acc
         self.wall = wall
+        # last timestamp as a PYTHON float: merge_wave's append-vs-dedup
+        # test runs per vehicle per wave, and a numpy scalar read there
+        # costs more than the comparison itself
+        self.last = float(time_[-1]) if last is None else last
 
 
 class ColumnarTraceCache:
@@ -362,23 +366,23 @@ class ColumnarTraceCache:
     def __len__(self) -> int:
         return len(self._entries)
 
-    def merge(self, uuid: str, lat, lon, time_, acc):
-        """(cached tail ⊕ new rows) deduped by timestamp, time-ascending —
-        exactly PartialTraceCache.merge, on arrays. Callers pass new rows
-        time-sorted (the pipeline lexsorts the flush), and entries store
-        sorted tails, so the common streaming case — every new timestamp
-        past the cached tail — is a plain concat with no dedup/sort."""
+    def tail(self, uuid: str,
+             now: "float | None" = None) -> "_TailEntry | None":
+        """The live cached tail (TTL-checked; a stale entry is dropped
+        on read) — THE lookup-and-expire rule, shared by merge() and
+        merge_wave (which hoists one clock read per wave via ``now``)."""
         e = self._entries.get(uuid)
-        if e is not None and self._clock() - e.wall > self.ttl:
-            del self._entries[uuid]
-            e = None
-        if e is None:
-            return lat, lon, time_, acc
-        if len(time_) and e.time[-1] < time_[0]:
-            return (np.concatenate([e.lat, lat]),
-                    np.concatenate([e.lon, lon]),
-                    np.concatenate([e.time, time_]),
-                    np.concatenate([e.acc, acc]))
+        if e is not None:
+            if now is None:
+                now = self._clock()
+            if now - e.wall > self.ttl:
+                del self._entries[uuid]
+                e = None
+        return e
+
+    def _merge_overlap(self, e: _TailEntry, lat, lon, time_, acc):
+        """The dedup branch of merge(): new timestamps overlap the
+        cached tail, so filter duplicates and re-sort time-ascending."""
         fresh = ~np.isin(time_, e.time)
         lat = np.concatenate([e.lat, lat[fresh]])
         lon = np.concatenate([e.lon, lon[fresh]])
@@ -387,6 +391,69 @@ class ColumnarTraceCache:
         order = np.argsort(t, kind="stable")
         return lat[order], lon[order], t[order], acc[order]
 
+    def merge(self, uuid: str, lat, lon, time_, acc):
+        """(cached tail ⊕ new rows) deduped by timestamp, time-ascending —
+        exactly PartialTraceCache.merge, on arrays. Callers pass new rows
+        time-sorted (the pipeline lexsorts the flush), and entries store
+        sorted tails, so the common streaming case — every new timestamp
+        past the cached tail — is a plain concat with no dedup/sort."""
+        e = self.tail(uuid)
+        if e is None:
+            return lat, lon, time_, acc
+        if len(time_) and e.time[-1] < time_[0]:
+            return (np.concatenate([e.lat, lat]),
+                    np.concatenate([e.lon, lon]),
+                    np.concatenate([e.time, time_]),
+                    np.concatenate([e.acc, acc]))
+        return self._merge_overlap(e, lat, lon, time_, acc)
+
+    def merge_wave(self, uuids: "Sequence[str]", lat, lon, time_, acc,
+                   bounds: np.ndarray):
+        """Batched merge() over one flush wave: vehicle v's new rows are
+        the slice [bounds[v], bounds[v+1]) of the flat columns. Returns
+        (lat, lon, time, acc, bounds) of the merged wave — each column
+        concatenated ONCE instead of four np.concatenate calls per
+        vehicle, which was the prepare stage's top host cost at
+        firehose/validation scale. Element-for-element equal to calling
+        merge() per vehicle (the common no-tail / append-tail cases are
+        pure piece gathering; the rare overlap case reuses the same
+        dedup branch)."""
+        V = len(uuids)
+        pl: list = []
+        pn: list = []
+        pt: list = []
+        pa: list = []
+        lens = np.empty(V, np.int64)
+        # bulk scalar extraction (ONE .tolist() runs in C; per-vehicle
+        # int()/float() of numpy scalars was a measured per-wave cost)
+        b_list = bounds.tolist()
+        firsts = time_[bounds[:-1]].tolist() if V else []
+        now = self._clock()
+        for v in range(V):
+            lo, hi = b_list[v], b_list[v + 1]
+            nl, nn = lat[lo:hi], lon[lo:hi]
+            nt, na = time_[lo:hi], acc[lo:hi]
+            e = self.tail(uuids[v], now=now)
+            if e is None:
+                pl.append(nl); pn.append(nn); pt.append(nt); pa.append(na)
+                lens[v] = hi - lo
+            elif e.last < firsts[v]:
+                pl.append(e.lat); pn.append(e.lon)
+                pt.append(e.time); pa.append(e.acc)
+                pl.append(nl); pn.append(nn); pt.append(nt); pa.append(na)
+                lens[v] = len(e.time) + (hi - lo)
+            else:
+                ml, mn, mt, ma = self._merge_overlap(e, nl, nn, nt, na)
+                pl.append(ml); pn.append(mn); pt.append(mt); pa.append(ma)
+                lens[v] = len(mt)
+        out_bounds = np.zeros(V + 1, np.int64)
+        np.cumsum(lens, out=out_bounds[1:])
+        return (np.concatenate(pl) if pl else np.empty(0),
+                np.concatenate(pn) if pn else np.empty(0),
+                np.concatenate(pt) if pt else np.empty(0),
+                np.concatenate(pa) if pa else np.empty(0, np.float32),
+                out_bounds)
+
     def retain(self, uuid: str, lat, lon, time_, acc,
                from_time: float) -> None:
         """Keep rows from one before the first time >= from_time (the
@@ -394,6 +461,15 @@ class ColumnarTraceCache:
         at = np.nonzero(time_ >= from_time)[0]
         cut = max(0, int(at[0]) - 1) if len(at) else max(0, len(time_) - 1)
         lo = max(cut, len(time_) - self.max_points)
+        self.retain_cut(uuid, lat, lon, time_, acc, lo)
+        self._evict()
+
+    def retain_cut(self, uuid: str, lat, lon, time_, acc,
+                   lo: int) -> None:
+        """retain() with the cut precomputed (native_prepare.tail_cuts
+        batches a whole wave's cuts in one call) and the eviction sweep
+        deferred to sweep() — same final cache state, without a
+        TTL/capacity scan per vehicle."""
         if lo >= len(time_):
             self._entries.pop(uuid, None)
             return
@@ -401,6 +477,43 @@ class ColumnarTraceCache:
             lat[lo:].copy(), lon[lo:].copy(), time_[lo:].copy(),
             acc[lo:].copy(), self._clock())
         self._entries.move_to_end(uuid)
+
+    def retain_wave(self, uuids: "Sequence[str]", lat, lon, time_, acc,
+                    bounds: np.ndarray, los: np.ndarray) -> None:
+        """Batched retain_cut over a wave's flat merged columns: vehicle
+        v retains rows [bounds[v] + los[v], bounds[v+1]). The per-wave
+        scalar work (cut arithmetic, last timestamps) is bulk-extracted,
+        then each entry gets OWNED contiguous-slice copies — owned, not
+        views of a shared block, so a straggler vehicle's entry can
+        never pin other vehicles' rows for its TTL lifetime — and ONE
+        eviction sweep runs at the end. Final cache state identical to
+        per-vehicle retain_cut + sweep."""
+        b0, b1 = bounds[:-1], bounds[1:]
+        src0 = b0 + los
+        keep = np.nonzero(src0 < b1)[0]
+        now = self._clock()
+        entries = self._entries
+        src_list = src0[keep].tolist()
+        end_list = b1[keep].tolist()
+        last_list = time_[b1[keep] - 1].tolist() if len(keep) else []
+        kept = set()
+        for k, v in enumerate(keep.tolist()):
+            u = uuids[v]
+            kept.add(v)
+            a, b = src_list[k], end_list[k]
+            entries[u] = _TailEntry(
+                lat[a:b].copy(), lon[a:b].copy(), time_[a:b].copy(),
+                acc[a:b].copy(), now, last=last_list[k])
+            entries.move_to_end(u)
+        if len(kept) < len(uuids):
+            for v, u in enumerate(uuids):
+                if v not in kept:       # nothing retained: entry drops
+                    entries.pop(u, None)
+        self._evict()
+
+    def sweep(self) -> None:
+        """The TTL + capacity eviction retain() runs per call, run once
+        per wave by the batched retention path."""
         self._evict()
 
     def dump(self) -> dict:
@@ -593,8 +706,8 @@ class _InflightWave:
     oldest offset, so a checkpoint taken with the wave in flight replays
     it — at-least-once, never lost."""
 
-    __slots__ = ("id", "future", "uuids", "merged", "codes", "holds",
-                 "arrive", "n_points", "published",
+    __slots__ = ("id", "future", "uuids", "merged", "merged_flat", "codes",
+                 "holds", "arrive", "n_points", "published",
                  "t_prep0", "t_submit", "t_result")
 
     def __init__(self, wid: int, codes: np.ndarray,
@@ -604,6 +717,10 @@ class _InflightWave:
         self.future = None
         self.uuids: "list[str]" = []
         self.merged: "list[tuple]" = []
+        # (lat, lon, time, acc, bounds) flat wave columns — the merged
+        # per-vehicle tuples above are views into these; the batched
+        # tail-retention path reads the flat form directly
+        self.merged_flat: "tuple | None" = None
         self.codes = codes
         self.holds = holds
         self.arrive = arrive
@@ -994,7 +1111,11 @@ class ColumnarStreamPipeline:
         marked held=wave-id until the result is processed."""
         t_prep0 = self.clock()
         L = self._log
-        mask = np.isin(L.code[:L.n], ripe_codes) & (L.held[:L.n] == 0)
+        # direct lookup, not np.isin: codes are dense interned ints, so a
+        # boolean table is one O(n) gather (isin re-sorts per wave)
+        ripe_lut = np.zeros(len(self._count), bool)
+        ripe_lut[ripe_codes] = True
+        mask = ripe_lut[L.code[:L.n]] & (L.held[:L.n] == 0)
         rows = np.nonzero(mask)[0]
         if not len(rows):
             return None
@@ -1009,35 +1130,43 @@ class ColumnarStreamPipeline:
             [[True], codes_sorted[1:] != codes_sorted[:-1]]))[0]
         bounds = np.concatenate([starts, [len(order)]])
 
-        # cache-merge per flushed vehicle (array slices, no per-point work)
-        merged: list[tuple] = []
-        uuids: list[str] = []
-        for gi in range(len(starts)):
-            sl = order[bounds[gi]:bounds[gi + 1]]
-            u = self._uuid_of[int(codes_sorted[starts[gi]])]
-            m = self.cache.merge(u, L.lat[sl], L.lon[sl], L.time[sl],
-                                 L.acc[sl])
-            merged.append(m)
-            uuids.append(u)
+        # ONE gather per column, then per-vehicle contiguous views — the
+        # per-vehicle fancy-index gathers + concats this replaces were
+        # the prepare stage's top host cost at validation scale
+        lat_w = L.lat[order]
+        lon_w = L.lon[order]
+        t_w = L.time[order]
+        acc_w = L.acc[order]
+        uuids = [self._uuid_of[int(codes_sorted[s])] for s in starts]
+        lat_m, lon_m, t_m, acc_m, mb = self.cache.merge_wave(
+            uuids, lat_w, lon_w, t_w, acc_w, bounds)
 
         # one lonlat→xy conversion for every flushed point
-        lens = np.array([len(m[2]) for m in merged], np.int64)
-        splits = np.cumsum(lens)[:-1]
-        lonlat = np.empty((int(lens.sum()), 2))
-        lonlat[:, 0] = np.concatenate([m[1] for m in merged])
-        lonlat[:, 1] = np.concatenate([m[0] for m in merged])
+        n_pts = int(mb[-1])
+        lonlat = np.empty((n_pts, 2))
+        lonlat[:, 0] = lon_m
+        lonlat[:, 1] = lat_m
         xy = lonlat_to_xy(lonlat, np.asarray(
             self.matcher.ts.meta.origin_lonlat)).astype(np.float32)
-        xys = np.split(xy, splits)
 
+        # per-vehicle accuracy presence + cleaning in whole-wave passes
+        finite = np.isfinite(acc_m)
+        if finite.any():
+            has_acc = np.bitwise_or.reduceat(finite, mb[:-1])
+            acc_clean = np.nan_to_num(acc_m, nan=0.0)
+        else:
+            has_acc = np.zeros(len(uuids), bool)
+            acc_clean = acc_m      # unread: every vehicle gets None
+
+        merged: list[tuple] = []
         traces = []
-        for u, m, xy_t in zip(uuids, merged, xys):
-            acc = m[3]
-            has_acc = np.isfinite(acc).any()
+        for v, u in enumerate(uuids):
+            lo, hi = int(mb[v]), int(mb[v + 1])
+            merged.append((lat_m[lo:hi], lon_m[lo:hi], t_m[lo:hi],
+                           acc_m[lo:hi]))
             traces.append(Trace(
-                uuid=u, xy=xy_t, times=m[2],
-                accuracy=(np.nan_to_num(acc, nan=0.0)
-                          if has_acc else None)))
+                uuid=u, xy=xy[lo:hi], times=t_m[lo:hi],
+                accuracy=(acc_clean[lo:hi] if has_acc[v] else None)))
 
         # commit-floor holds + arrival copy, then mark the rows held
         parts = L.part[rows]
@@ -1045,11 +1174,13 @@ class ColumnarStreamPipeline:
         holds = [(int(p), int(offs[parts == p].min()))
                  for p in np.unique(parts)]
         self._wave_serial += 1
-        wave = _InflightWave(self._wave_serial, np.unique(codes_sorted),
+        # codes_sorted is sorted, so its run starts ARE the unique codes
+        wave = _InflightWave(self._wave_serial, codes_sorted[starts],
                              holds, L.arrive[rows].copy(),
-                             n_points=int(lens.sum()))
+                             n_points=n_pts)
         wave.uuids = uuids
         wave.merged = merged
+        wave.merged_flat = (lat_m, lon_m, t_m, acc_m, mb)
         wave.t_prep0 = t_prep0
         wave.t_submit = self.clock()
         L.held[rows] = wave.id
@@ -1258,10 +1389,18 @@ class ColumnarStreamPipeline:
 
     def _reports_from_columns(self, batch: MatchBatch,
                               wave: _InflightWave) -> int:
-        uuids, merged = wave.uuids, wave.merged
+        from reporter_tpu.matcher import native_prepare
+
+        uuids = wave.uuids
         cols = batch.columns
-        seg, nxt, rt0, rt1, rlen, rqueue, _ = build_report_columns(
-            cols, None, self.min_segment_length)
+        # group-id chaining: the native single pass when the library is
+        # up, the numpy builder otherwise — same outputs by contract
+        # (fuzz-asserted in tests/test_native_prepare.py)
+        rep = native_prepare.build_reports(cols, None,
+                                           self.min_segment_length)
+        if rep is None:
+            rep = build_report_columns(cols, None, self.min_segment_length)
+        seg, nxt, rt0, rt1, rlen, rqueue, _ = rep
         self.stats_counters["reports"] += len(seg)
 
         # per-trace latest complete time → tail retention cut
@@ -1270,9 +1409,7 @@ class ColumnarStreamPipeline:
             & ~cols.internal
         if keep.any():
             np.maximum.at(done, cols.trace[keep], cols.end_time[keep])
-        for ti, (u, m) in enumerate(zip(uuids, merged)):
-            from_time = done[ti] if np.isfinite(done[ti]) else float(m[2][0])
-            self.cache.retain(u, m[0], m[1], m[2], m[3], from_time)
+        self._retain_tails(wave, done)
 
         dur = rt1 - rt0
         okd = dur > 0
@@ -1286,6 +1423,30 @@ class ColumnarStreamPipeline:
         self._publish_wave(wave, "publish_columns",
                            (seg, nxt, rt0, rt1, rlen, rqueue))
         return int(len(seg))
+
+    def _retain_tails(self, wave: _InflightWave, done: np.ndarray) -> None:
+        """Cache-tail retention for a completed wave: every vehicle's
+        cut computed in ONE pass over the wave's flat time column
+        (native_prepare.tail_cuts, or its per-vehicle reference), then
+        the stores with a single deferred TTL/capacity sweep — the same
+        final cache state as per-vehicle retain(), without a numpy
+        nonzero/max chain and an eviction scan per vehicle."""
+        from reporter_tpu.matcher import native_prepare
+
+        lat_m, lon_m, t_m, acc_m, mb = wave.merged_flat
+        # from_time: the latest complete report end, else the vehicle's
+        # first timestamp (the straddling-pair rule keeps one row before
+        # that point either way)
+        first_t = (t_m[mb[:-1]] if len(t_m)
+                   else np.zeros(len(wave.uuids)))
+        from_time = np.where(np.isfinite(done), done, first_t)
+        los = native_prepare.tail_cuts(t_m, mb, from_time,
+                                       self.cache.max_points)
+        if los is None:
+            los = native_prepare.tail_cuts_python(t_m, mb, from_time,
+                                                  self.cache.max_points)
+        self.cache.retain_wave(wave.uuids, lat_m, lon_m, t_m, acc_m, mb,
+                               los)
 
     def _publish_wave(self, wave: _InflightWave, method: str,
                       args: tuple) -> None:

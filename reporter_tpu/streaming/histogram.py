@@ -33,6 +33,15 @@ class SpeedHistogram:
         self.num_rows = int(num_rows)
         self._hist = jnp.zeros((self.num_rows, self.num_bins), jnp.int32)
 
+    # ONE batch shape for the jit'd scatter (updates pad to it; bigger
+    # batches chunk through it): the r5 next-power-of-two padding still
+    # left one executable per cap, and jit TRACE+LOWER is per process
+    # per shape (~150 ms on the one-core box, NOT covered by the
+    # persistent compile cache) — a fresh cap ballooning a measured
+    # wave's report_build stage was exactly the r12 attribution noise.
+    # A fixed shape compiles once, in the warm-up wave.
+    _CAP = 4096
+
     def update(self, rows: np.ndarray, speeds: np.ndarray) -> None:
         """Add one observation per (segment row, speed m/s) pair."""
         if len(rows) == 0:
@@ -41,16 +50,17 @@ class SpeedHistogram:
         bins = (np.searchsorted(self.bin_edges, np.asarray(speeds),
                                 side="right") - 1).astype(np.int32)
         ok = (rows >= 0) & (rows < self.num_rows) & (bins >= 0)
-        # Pad to the next power of two so the jit'd scatter compiles for a
-        # handful of lengths, not one executable per batch size.
-        cap = 1 << max(0, len(rows) - 1).bit_length()
-        pad = cap - len(rows)
-        if pad:
-            rows = np.pad(rows, (0, pad))
-            bins = np.pad(bins, (0, pad))
-            ok = np.pad(ok, (0, pad))
-        self._hist = _accumulate(self._hist, jnp.asarray(rows),
-                                 jnp.asarray(bins), jnp.asarray(ok))
+        for lo in range(0, len(rows), self._CAP):
+            r = rows[lo:lo + self._CAP]
+            pad = self._CAP - len(r)
+            b = bins[lo:lo + self._CAP]
+            o = ok[lo:lo + self._CAP]
+            if pad:
+                r = np.pad(r, (0, pad))
+                b = np.pad(b, (0, pad))
+                o = np.pad(o, (0, pad))
+            self._hist = _accumulate(self._hist, jnp.asarray(r),
+                                     jnp.asarray(b), jnp.asarray(o))
 
     def snapshot(self) -> np.ndarray:
         """Host copy [num_rows, num_bins]."""

@@ -250,6 +250,41 @@ def test_latency_attribution_leg_records_overhead_ab():
         assert f'"{key}"' in src, key
 
 
+PREPARE_BENCH_KEYS = (
+    "rows", "bucket", "python_krows_per_s", "native_krows_per_s",
+    "speedup", "bytes_identical", "native_available",
+)
+
+
+def test_prepare_bench_schema_keys():
+    """Pin detail.prepare_bench (ISSUE 7 satellite): the host-prepare
+    native-vs-Python A/B and its byte-identity re-proof must stay
+    recorded fields on every composite — extend, never drop."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._prepare_bench)
+    for key in PREPARE_BENCH_KEYS:
+        assert f'"{key}"' in src, key
+
+
+def test_summary_line_carries_prep_token():
+    """prep = [native krows/s, speedup vs numpy, bytes identical]."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "prepare_bench": {"native_krows_per_s": 54321.0,
+                                 "speedup": 11.5,
+                                 "bytes_identical": True},
+           }}
+    line = bench._summary_line(doc)
+    assert line["prep"] == [54321.0, 11.5, 1]
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["prep"] == [None] * 3
+
+
 def test_summary_line_carries_lattr_token():
     """lattr = [e2e p50 ms, stage-sum/e2e ratio, tracing overhead %]."""
     bench = _load_bench()

@@ -95,6 +95,9 @@ def _synthetic_doc():
         "latency_attribution": {"e2e_p50_ms": 12481.57,
                                 "stage_sum_over_e2e_p50": 1.0312,
                                 "tracing_overhead_pct": -1.27},
+        "prepare_bench": {"native_krows_per_s": 12345678.9,
+                          "python_krows_per_s": 1234567.8,
+                          "speedup": 12.34, "bytes_identical": True},
         "fleet": {"n_metros": 128,
                   "mixed": {"probes_per_sec": 1234567.8},
                   "storm": {"promote_p50_ms": 1234.56},

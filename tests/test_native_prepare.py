@@ -1,0 +1,289 @@
+"""Byte-identity contract of the native host-prepare path (ISSUE 7).
+
+matcher/native_prepare has ONE prepare implementation in two forms — the
+C entries in native/prepare.cc and the numpy reference — and the wire
+buffers they produce must be BYTE-identical: same mode decision (i8
+deltas / i16 absolutes / f32 fallback), same buffer bytes, across NaN/inf
+poison rows, i8 delta overflow, >±8.19 km spans, single-point traces,
+empty traces, and chunked long traces. The fuzz here is the offline half
+of the contract; bench detail.prepare_bench re-proves it on every
+composite (the sweep_ab discipline), and _submit_many's counters make a
+silent fallback to Python visible at /stats and /metrics.
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matcher import native_prepare as npp
+
+pytestmark = pytest.mark.skipif(
+    not npp.available(), reason="native prepare library unavailable")
+
+
+def _rand_xys(rng, case):
+    """One slice's trace list per fuzz case (the ISSUE 7 poison grid)."""
+    if case == "normal":         # 1 Hz-ish walks: steps fit the i8 range
+        return [(np.cumsum(rng.uniform(-10, 10,
+                                       (int(rng.integers(1, 60)), 2)),
+                           axis=0)
+                 + rng.uniform(-400, 400, 2)).astype(np.float32)
+                for _ in range(17)]
+    if case == "uniform":        # the fleet/bench shape (np.stack path)
+        return [(np.cumsum(rng.uniform(-10, 10, (32, 2)), axis=0)
+                 + rng.uniform(-400, 400, 2)).astype(np.float32)
+                for _ in range(8)]
+    if case == "i8_overflow":    # steps past ±127 quanta ⇒ i16 absolutes
+        return [np.cumsum(rng.uniform(-80, 80, (30, 2)), axis=0)
+                .astype(np.float32) for _ in range(5)]
+    if case == "i16_overflow":   # span past ±8.19 km ⇒ f32 fallback
+        xs = [rng.uniform(-500, 500, (20, 2)).astype(np.float32)
+              for _ in range(4)]
+        xs[2][10] = [9000.0, 0.0]
+        return xs
+    if case == "poison":         # NaN/inf coordinates ⇒ f32 fallback
+        xs = [rng.uniform(-500, 500, (10, 2)).astype(np.float32)
+              for _ in range(3)]
+        xs[1][3, 0] = np.nan
+        xs[2][0, 1] = np.inf
+        return xs
+    if case == "degenerate":     # empty + single-point traces
+        return [np.zeros((0, 2), np.float32),
+                rng.uniform(-100, 100, (1, 2)).astype(np.float32),
+                np.zeros((0, 2), np.float32)]
+    raise AssertionError(case)
+
+
+def _assert_prep_equal(py, nat):
+    pm, ppts, plens, porg, ppay = py
+    nm, npts, nlens, norg, npay = nat
+    assert nm == pm
+    assert npts.tobytes() == ppts.tobytes()
+    assert nlens.tobytes() == plens.tobytes()
+    assert norg.tobytes() == porg.tobytes()
+    if pm == 0:
+        assert ppay is None and npay is None
+    else:
+        assert npay.dtype == ppay.dtype
+        assert npay.tobytes() == ppay.tobytes()
+
+
+_EXPECT_MODE = {"normal": 2, "uniform": 2, "i8_overflow": 1,
+                "i16_overflow": 0, "poison": 0, "degenerate": 2}
+
+
+@pytest.mark.parametrize("case", sorted(_EXPECT_MODE))
+def test_prepare_slice_fuzz_parity(case, rng):
+    for trial in range(40):
+        xys = _rand_xys(rng, case)
+        longest = max((len(x) for x in xys), default=1)
+        b = 16
+        while b < longest:
+            b *= 2
+        with np.errstate(invalid="ignore"):
+            py = npp.prepare_slice_python(xys, b)
+        nat = npp.prepare_slice(xys, b)
+        assert nat is not None
+        _assert_prep_equal(py, nat)
+        if trial == 0:
+            assert py[0] == _EXPECT_MODE[case], case
+
+
+def test_prepare_slice_threaded_matches_single(rng):
+    xys = [rng.uniform(-500, 500, (int(rng.integers(1, 120)), 2))
+           .astype(np.float32) for _ in range(64)]
+    one = npp.prepare_slice(xys, 128, n_threads=1)
+    many = npp.prepare_slice(xys, 128, n_threads=8)
+    _assert_prep_equal(one, many)
+
+
+def test_quantum_matches_wire_constant():
+    """native_prepare quantizes at the SAME step the device wire decodes
+    (ops.match.OFFSET_QUANTUM) — a drift here would silently corrupt
+    every quantized infeed."""
+    from reporter_tpu.ops.match import OFFSET_QUANTUM
+
+    assert npp._QUANTUM == OFFSET_QUANTUM
+
+
+def test_morton_keys_parity(rng):
+    first = rng.uniform(-1e5, 1e5, (2000, 2))
+    first[5] = np.nan
+    first[7] = np.inf
+    first[11] = -np.inf
+    with np.errstate(invalid="ignore"):
+        py = npp.morton_keys_python(first)
+    nat = npp.morton_keys(first)
+    assert nat.dtype == py.dtype
+    assert np.array_equal(py, nat)
+
+
+def test_tail_cuts_parity(rng):
+    for _ in range(200):
+        V = int(rng.integers(1, 9))
+        lens = rng.integers(1, 30, V)
+        bounds = np.zeros(V + 1, np.int64)
+        bounds[1:] = np.cumsum(lens)
+        t = np.sort(rng.uniform(0, 100, int(bounds[-1])))
+        from_time = rng.uniform(-10, 120, V)
+        max_points = int(rng.integers(1, 40))
+        py = npp.tail_cuts_python(t, bounds, from_time, max_points)
+        nat = npp.tail_cuts(t, bounds, from_time, max_points)
+        assert np.array_equal(py, nat)
+
+
+def _random_record_columns(rng, n):
+    """Plausible walker output incl. exact adjacency chains, partial
+    (-1) timestamps, and internal connectors — the shapes the group-id
+    chaining must agree on."""
+    from reporter_tpu.matcher.native_walk import RecordColumns
+
+    trace = np.sort(rng.integers(0, 6, n)).astype(np.int32)
+    t0 = rng.uniform(-1, 5, n)
+    t1 = t0 + rng.uniform(-0.5, 2, n)
+    for i in range(1, n):
+        if rng.random() < 0.5 and trace[i] == trace[i - 1]:
+            t0[i] = t1[i - 1] + rng.choice([0.0, 5e-4, 2e-3])
+        if rng.random() < 0.2:
+            t0[i] = -1.0
+        if rng.random() < 0.2:
+            t1[i] = -1.0
+    return RecordColumns(
+        trace, rng.integers(0, 1000, n).astype(np.int64), t0, t1,
+        rng.uniform(0, 50, n), rng.uniform(0, 20, n), rng.random(n) < 0.3,
+        np.zeros(n + 1, np.int64), np.empty(0, np.int64))
+
+
+@pytest.mark.parametrize("n_traces", [None, 6])
+def test_build_reports_parity(n_traces, rng):
+    from reporter_tpu.streaming.columnar import build_report_columns
+
+    for _ in range(120):
+        cols = _random_record_columns(rng, int(rng.integers(0, 60)))
+        py = build_report_columns(cols, n_traces, 10.0)
+        nat = npp.build_reports(cols, n_traces, 10.0)
+        assert nat is not None
+        for a, b in zip(py[:6], nat[:6]):
+            assert np.array_equal(a, b)
+        if n_traces is None:
+            assert py[6] is None and nat[6] is None
+        else:
+            assert np.array_equal(py[6], nat[6])
+
+
+# ---------------------------------------------------------------------------
+# Matcher-level wire identity: the full _submit_many (work build, Morton
+# bucket ordering, slicing, prepare) with the native path on vs forced
+# off must hand the device byte-identical infeed buffers, on both result
+# wire layouts (tiny = u16 2-lane compact, sf > 16384 directed edges =
+# 3-lane). A recording wire stub captures the submit-leg buffers without
+# compiling anything.
+
+
+class _RecordingWire:
+    def __init__(self):
+        self.calls = []
+
+    def _rec(self, kind, *arrays):
+        self.calls.append(
+            (kind, tuple(None if a is None else
+                         np.ascontiguousarray(a).tobytes()
+                         for a in arrays)))
+        return np.zeros(1)
+
+    def f32(self, pts, lens, acc):
+        return self._rec("f32", pts, lens, acc)
+
+    def q16(self, pts_q, origins, lens, acc):
+        return self._rec("q16", pts_q, origins, lens, acc)
+
+    def q8(self, deltas_q, origins, lens, acc):
+        return self._rec("q8", deltas_q, origins, lens, acc)
+
+
+def _submit_traces(ts, rng):
+    from reporter_tpu.matcher.api import Trace
+
+    traces = []
+    for i in range(23):
+        n = int(rng.integers(1, 90))
+        xy = np.cumsum(rng.uniform(-10, 10, (n, 2)), axis=0) \
+            .astype(np.float32) + rng.uniform(-400, 400, 2).astype(np.float32)
+        traces.append(Trace(uuid=f"t{i}", xy=xy,
+                            times=np.arange(n, dtype=np.float64)))
+    # a chunked long trace (>1024 points) + an accuracy-carrying trace
+    n = 2500
+    xy = np.cumsum(rng.uniform(-2, 2, (n, 2)), axis=0).astype(np.float32)
+    traces.append(Trace(uuid="long", xy=xy,
+                        times=np.arange(n, dtype=np.float64)))
+    acc_n = 40
+    traces.append(Trace(
+        uuid="acc",
+        xy=rng.uniform(-200, 200, (acc_n, 2)).astype(np.float32),
+        times=np.arange(acc_n, dtype=np.float64),
+        accuracy=rng.uniform(1, 30, acc_n).astype(np.float32)))
+    return traces
+
+
+def _captured_submit(ts, traces):
+    from reporter_tpu.config import Config
+    from reporter_tpu.matcher.api import SegmentMatcher
+
+    m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    wire = _RecordingWire()
+    m._wire = wire
+    m._submit_many(traces)
+    return wire.calls, m.metrics
+
+
+@pytest.mark.parametrize("tiles", ["tiny_tiles", "sf_tiles"])
+def test_submit_wire_bytes_identical_native_vs_python(
+        tiles, request, rng, monkeypatch):
+    ts = request.getfixturevalue(tiles)
+    traces = _submit_traces(ts, rng)
+    native_calls, native_metrics = _captured_submit(ts, traces)
+    monkeypatch.setenv("RTPU_NATIVE_PREPARE", "0")
+    python_calls, python_metrics = _captured_submit(ts, traces)
+    assert native_calls == python_calls
+    assert len(native_calls) > 1          # several buckets/slices ran
+    # the served-form counters: native on one side, python on the other
+    assert native_metrics.value("prepare_native_total") == len(native_calls)
+    assert native_metrics.value("prepare_python_total") == 0
+    assert python_metrics.value("prepare_python_total") == len(python_calls)
+    assert python_metrics.value("prepare_native_total") == 0
+
+
+def test_fallback_counter_surfaces_at_metrics(tiny_tiles, rng, monkeypatch):
+    """A silent native-build failure degrades to Python — the counter
+    contract makes that visible in the Prometheus exposition and the
+    /stats snapshot (ISSUE 7 observability satellite)."""
+    monkeypatch.setenv("RTPU_NATIVE_PREPARE", "0")
+    _, metrics = _captured_submit(tiny_tiles, _submit_traces(tiny_tiles,
+                                                             rng))
+    assert metrics.value("prepare_python_total") > 0
+    snap = metrics.snapshot()
+    assert snap["prepare_python_total"] > 0
+    prom = metrics.render_prometheus()
+    assert "rtpu_prepare_python_total" in prom
+
+
+def test_match_many_reports_identical_with_native_disabled(
+        tiny_tiles, monkeypatch):
+    """Acceptance: disabling the native prepare via env reproduces
+    IDENTICAL reports through the real device path (tiny tile, CPU
+    jax)."""
+    from reporter_tpu.config import Config
+    from reporter_tpu.matcher.api import SegmentMatcher, Trace
+    from reporter_tpu.netgen.traces import synthesize_fleet
+
+    fleet = synthesize_fleet(tiny_tiles, 6, num_points=25, seed=11)
+    traces = [Trace(uuid=f"v{i}", xy=p.xy.astype(np.float32),
+                    times=p.times) for i, p in enumerate(fleet)]
+
+    def run():
+        m = SegmentMatcher(tiny_tiles, Config(matcher_backend="jax"))
+        return [[r.to_json() for r in recs] for recs in m.match_many(traces)]
+
+    with_native = run()
+    monkeypatch.setenv("RTPU_NATIVE_PREPARE", "0")
+    without = run()
+    assert with_native == without

@@ -62,6 +62,45 @@ for lib in (lib_s, lib_p):
 for a, b in zip(reach_out[0][:3], reach_out[1][:3]):
     np.testing.assert_array_equal(a, b)   # instrumented == plain
 assert reach_out[0][3] == reach_out[1][3]
+
+# --- prepare entries (ISSUE 7): threaded slice prep, driven from several
+# Python threads at once (ctypes releases the GIL), + the single-pass
+# report build / tail cuts — instrumented output must equal plain
+from concurrent.futures import ThreadPoolExecutor
+from reporter_tpu.matcher import native_prepare as npp
+
+xys = [(np.cumsum(rng.uniform(-10, 10, (int(rng.integers(1, 90)), 2)),
+                  axis=0)).astype(np.float32) for _ in range(48)]
+cut_times = np.sort(rng.uniform(0, 50, 96))
+cut_bounds = np.asarray([0, 40, 41, 96], np.int64)
+cut_from = np.asarray([10.0, -1.0, 60.0])
+ml = float(cs.length.max() / 2) if cs.n_records else 1.0
+nt = int(cs.trace.max()) + 1 if cs.n_records else 1
+prep_out = []
+for lib in (lib_s, lib_p):
+    npp._lib_cache = [lib]    # route the wrappers through each flavor
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        runs = [f.result() for f in
+                [pool.submit(npp.prepare_slice, xys, 128, 4)
+                 for _ in range(4)]]
+    for got in runs[1:]:      # concurrent calls agree with each other
+        assert got[0] == runs[0][0]
+        for a, b in zip(runs[0][1:], got[1:]):
+            np.testing.assert_array_equal(a, b)
+    prep_out.append(runs[0])
+    keys = npp.morton_keys(np.asarray([x[0] for x in xys], np.float64))
+    rep = npp.build_reports(cs, nt, ml)
+    cuts = npp.tail_cuts(cut_times, cut_bounds, cut_from, 16)
+    prep_out.append((keys, rep, cuts))
+npp._lib_cache = [lib_p]
+assert prep_out[0][0] == prep_out[2][0]          # slice mode
+for a, b in zip(prep_out[0][1:], prep_out[2][1:]):
+    np.testing.assert_array_equal(a, b)          # slice buffers
+np.testing.assert_array_equal(prep_out[1][0], prep_out[3][0])  # morton
+for a, b in zip(prep_out[1][1], prep_out[3][1]):
+    if a is not None or b is not None:
+        np.testing.assert_array_equal(a, b)      # report build
+np.testing.assert_array_equal(prep_out[1][2], prep_out[3][2])  # cuts
 print("SANITIZED-OK", cs.n_records)
 """
 
