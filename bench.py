@@ -1448,11 +1448,21 @@ def _publish_outage_soak(ts, traces, n_stream: int, workdir: str) -> dict:
 _V5E_HBM_BYTES_PER_S = 819e9    # v5e public peak HBM bandwidth
 _V5E_VPU_F32_PER_S = 3.9e12     # ≈ (8, 128) lanes × 4 ALUs × 940 MHz — the
 #                                 sweep is elementwise VPU work, not MXU
+_V5E_MXU_BF16_PER_S = 197e12    # v5e public peak bf16 matmul throughput —
+#                                 the round-13 mxu arm's coarse pass rides
+#                                 the MXU, so its flops compare against
+#                                 THIS peak, not the VPU's
 _SWEEP_PAIR_FLOPS = 25          # f32 ops per point-segment pair in
 #                                 _block_geometry (clamped projection + d2 +
 #                                 offset); _select_topk adds ~2x more on the
 #                                 blocks that pass the radius test, so the
 #                                 reported utilization is a floor
+_SELECT_FLOPS_PER_COL_PASS = 9  # VPU ops per candidate column per
+#                                 _select_topk pass (min, tie compare,
+#                                 masked edge-min, select mask, masked
+#                                 offset-min, kill) — the "selection
+#                                 roughly doubles the true number" prose
+#                                 note, now a counted work level
 
 
 def _sweep_culling_stats(bbox: "np.ndarray", sub: "np.ndarray | None",
@@ -1543,20 +1553,40 @@ def _sweep_roofline(m, pts: "np.ndarray", per_dispatch_s: float) -> dict:
     sub = (np.asarray(m._tables["seg_sub"])
            if "seg_sub" in m._tables else None)
     subcull = bool(getattr(m.params, "sweep_subcull", True)) and sub is not None
+    mxu = (bool(getattr(m.params, "sweep_mxu", False)) and subcull
+           and "seg_feat" in m._tables)
     stats = _sweep_culling_stats(bbox, sub if subcull else None,
                                  pts.reshape(-1, 2),
                                  float(m.params.search_radius))
     P = dc._P
+    K = m.params.max_candidates
     nvisits = stats["block_visits_per_dispatch"]
     block_bytes = dc.SP_NCOMP * dc._SBLK * 4
+    if mxu:                        # feature rows DMA alongside the pack
+        block_bytes += dc.SF_NCOMP * dc._SBLK * 4
     bytes_swept = nvisits * block_bytes                # DMA is whole blocks
     subw = dc._SBLK // stats["sub_slices_per_block"]
     flops_block = nvisits * P * dc._SBLK * _SWEEP_PAIR_FLOPS
     flops = stats["sub_visits_per_dispatch"] * P * subw * _SWEEP_PAIR_FLOPS
+    # round-13 third work level: the mxu arm's coarse pass is one
+    # [P, 8] x [8, subw] dot per sub-visit — 2*8 flops per output element
+    # on the MXU (vs the VPU pair flops it gates)
+    mxu_flops = (stats["sub_visits_per_dispatch"] * P * subw * 2
+                 * dc.SF_NCOMP if mxu else 0)
+    # selection-reduction ceiling: K passes over [P, subw + K] per
+    # radius-passing slice; the host can't replicate the in-kernel radius
+    # gate, so sub-visits bound it from above ("selection roughly doubles
+    # the true number" — now a recorded field instead of a prose note)
+    select_flops_ceiling = (stats["sub_visits_per_dispatch"] * K
+                            * (subw + K) * P * _SELECT_FLOPS_PER_COL_PASS
+                            if subcull else
+                            nvisits * K * (dc._SBLK + K) * P
+                            * _SELECT_FLOPS_PER_COL_PASS)
     bw = bytes_swept / per_dispatch_s
     fl = flops / per_dispatch_s
     return {
         "kernel": ("subcull" if subcull else "block")
+                  + ("+mxu" if mxu else "")
                   + ("+bf16" if subcull
                      and getattr(m.params, "sweep_lowp", "off") == "bf16"
                      else ""),
@@ -1564,6 +1594,8 @@ def _sweep_roofline(m, pts: "np.ndarray", per_dispatch_s: float) -> dict:
         "hbm_bytes_swept": int(bytes_swept),
         "pair_flops": int(flops),
         "pair_flops_block_level": int(flops_block),
+        "mxu_flops": int(mxu_flops),
+        "select_flops_ceiling": int(select_flops_ceiling),
         "topk_width": (subw if subcull else dc._SBLK)
                       + m.params.max_candidates,
         "achieved_GBps": round(bw / 1e9, 1),
@@ -1572,10 +1604,18 @@ def _sweep_roofline(m, pts: "np.ndarray", per_dispatch_s: float) -> dict:
         "pct_of_v5e_vpu_f32_peak": round(100 * fl / _V5E_VPU_F32_PER_S, 1),
         "pct_vpu_block_level": round(
             100 * (flops_block / per_dispatch_s) / _V5E_VPU_F32_PER_S, 1),
-        "note": ("pair-geometry FLOPs of the ACTIVE kernel (floor); "
-                 "top-K selection adds ~(width+K)/width on radius-passing "
-                 "slices; pair_flops_block_level = what the whole-block "
-                 "kernel would compute for the same dispatch"),
+        "pct_of_v5e_mxu_bf16_peak": (
+            round(100 * (mxu_flops / per_dispatch_s)
+                  / _V5E_MXU_BF16_PER_S, 2) if mxu else None),
+        "note": ("pair-geometry FLOPs of the ACTIVE kernel — a floor for "
+                 "non-mxu kernels, an UPPER bound under +mxu (the matmul "
+                 "gate skips exact geometry on slices the host stats "
+                 "can't see, same caveat as select_flops_ceiling); "
+                 "select_flops_ceiling bounds the top-K reductions at "
+                 "sub-visit granularity (the in-kernel radius gate can "
+                 "only shrink it); pair_flops_block_level = what the "
+                 "whole-block kernel would compute for the same dispatch; "
+                 "mxu_flops = the matmul coarse pass, vs the MXU peak"),
     }
 
 
@@ -1610,15 +1650,20 @@ def _stage_uniform_slice(m, traces):
 
 def _sweep_variants_probe(m, traces, link_rtt: float, K: int = 12,
                           windows: int = 2) -> dict:
-    """Same-mood A/B of the round-8 sweep levers, the ISSUE-3 discipline:
+    """Same-mood A/B of the sweep kernel arms, the ISSUE-3 discipline:
     ONE staged slice, three static param variants of the SAME executable
-    family — "subcull" (two-level culling + fused narrow top-K, the
-    default), "block" (the round-7 whole-block kernel), "subcull_bf16"
-    (coarse low-precision pair filter + exact refinement) — dispatched in
-    interleaved windows so every arm sees the same link mood. Also
-    asserts the three arms' result wires are BYTE-identical on this
-    slice (the exactness contract, proven on-chip every run). Each arm's
-    number is the best window (same best-of-N convention as every tile).
+    family — "subcull" (two-level culling + fused narrow top-K, the r8
+    default), "block" (the round-7 whole-block kernel), "mxu" (round 13:
+    matmul-form coarse pass on the MXU, bf16 operands — the promoted
+    home of the r8 sweep_lowp="bf16" lever, which now gets its chip
+    numbers here instead of a fourth leg) — dispatched in interleaved
+    windows so every arm sees the same link mood. Also asserts the three
+    arms' result wires are BYTE-identical on this slice (the exactness
+    contract, proven on-chip every run), INCLUDING through an
+    evict→promote paging cycle of the matcher's tables (unstage + fresh
+    host_tables device_put — the fleet promotion seam, stale-layout
+    check live). Each arm's number is the best window (same best-of-N
+    convention as every tile).
     """
     import numpy as np
 
@@ -1629,10 +1674,12 @@ def _sweep_variants_probe(m, traces, link_rtt: float, K: int = 12,
     args, _, sub, T = _stage_uniform_slice(m, traces)
     spec = getattr(m, "_wire_spec", None)
     arms = {
-        "subcull": m.params.replace(sweep_subcull=True, sweep_lowp="off"),
-        "block": m.params.replace(sweep_subcull=False, sweep_lowp="off"),
-        "subcull_bf16": m.params.replace(sweep_subcull=True,
-                                         sweep_lowp="bf16"),
+        "subcull": m.params.replace(sweep_subcull=True, sweep_lowp="off",
+                                    sweep_mxu=False),
+        "block": m.params.replace(sweep_subcull=False, sweep_lowp="off",
+                                  sweep_mxu=False),
+        "mxu": m.params.replace(sweep_subcull=True, sweep_lowp="bf16",
+                                sweep_mxu=True),
     }
     warm = {}
     errors: dict = {}
@@ -1651,6 +1698,38 @@ def _sweep_variants_probe(m, traces, link_rtt: float, K: int = 12,
     identical = (all(np.array_equal(warm["subcull"], w)
                      for w in warm.values())
                  if len(warm) >= 2 else None)
+    # whether the mxu arm actually PARTICIPATED in the comparisons: the
+    # summary's r13 acceptance token folds this tile's identity bits
+    # only when True — a lowering failure must read as "not exercised",
+    # never as a green three-arm contract proven by the two legacy arms
+    mxu_compared = "mxu" in warm
+    # paging-cycle identity (acceptance: byte-identity holds through a
+    # fleet evict→promote): drop the matcher's device tables, restage a
+    # FRESH host_tables build through the same device_put + version-check
+    # seam the fleet promotion uses, and re-harvest one arm. Values are
+    # deterministic, so bytes must match the pre-paging harvest exactly.
+    paged_identical = None
+    if hasattr(m, "unstage_tables"):
+        orig_tables = m._tables
+        try:
+            import jax as _jax
+            ref_arm = "mxu" if "mxu" in warm else "subcull"
+            host = m.ts.host_tables(m.params.candidate_backend)
+            m.unstage_tables()
+            m.restage_tables(_jax.device_put(host))
+            w2 = np.asarray(match_batch_wire_q(
+                *args, m._tables, m.ts.meta, arms[ref_arm], None,
+                spec=spec))
+            paged_identical = bool(np.array_equal(w2, warm[ref_arm]))
+            del w2
+        except Exception as exc:
+            errors["paging"] = repr(exc)[:200]
+            # a failure between unstage and restage (link dying mid-
+            # transfer) must not leave the matcher paged out — the
+            # timing windows below and every later leg sharing this
+            # matcher dispatch through m._tables
+            if not m.tables_staged:
+                m.restage_tables(orig_tables)
     del warm
     best: dict = dict.fromkeys(arms)
     for _ in range(windows):
@@ -1670,14 +1749,93 @@ def _sweep_variants_probe(m, traces, link_rtt: float, K: int = 12,
     out["dispatch_shape"] = f"{len(sub)}x{T}pts"
     out["wires_bit_identical"] = (None if identical is None
                                   else bool(identical))
+    out["wires_identical_after_paging"] = paged_identical
+    out["mxu_compared"] = mxu_compared
     if errors:
         out["arm_errors"] = errors
     if "block" in best:
         out["speedup_subcull_vs_block"] = round(
             best["block"] / best["subcull"], 3)
-    if "subcull_bf16" in best:
-        out["speedup_bf16_vs_subcull"] = round(
-            best["subcull"] / best["subcull_bf16"], 3)
+    if "mxu" in best:
+        out["speedup_mxu_vs_subcull"] = round(
+            best["subcull"] / best["mxu"], 3)
+    return out
+
+
+def _sweep_ab_cpu_validate() -> dict:
+    """No-chip stand-in for _sweep_variants_probe (manual / CPU-forced
+    composites): the SAME three kernel arms — subcull / block / mxu —
+    through the pallas INTERPRETER at tiny scale, wire bytes compared
+    across arms AND through an evict→promote paging cycle of a real
+    SegmentMatcher (the fleet restage seam, stale-layout version check
+    live). Interpreter timings are meaningless, so the per-arm pps slots
+    record None — the acceptance artifact here is the identity bits,
+    re-proven on every composite the way detail.fleet's tiny-scale run
+    validates paging (the r7 BENCH_DETAIL_CPU.json convention)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.config import CompilerParams, Config, MatcherParams
+    from reporter_tpu.matcher.api import SegmentMatcher
+    from reporter_tpu.netgen.synthetic import generate_city
+    from reporter_tpu.netgen.traces import synthesize_fleet
+    from reporter_tpu.ops import dense_candidates as dc
+    from reporter_tpu.ops.match import match_batch_wire
+    from reporter_tpu.tiles.compiler import compile_network
+
+    base = MatcherParams(candidate_backend="dense")
+    cfg = Config(matcher_backend="jax", matcher=base)
+    ts = compile_network(generate_city("tiny", seed=23), CompilerParams())
+    m = SegmentMatcher(ts, cfg)
+    fleet = synthesize_fleet(ts, 6, num_points=40, seed=4)
+    pts = np.stack([p.xy for p in fleet]).astype(np.float32)
+    lens = np.full(len(fleet), pts.shape[1], np.int32)
+    arms = {
+        "subcull": base.replace(sweep_subcull=True, sweep_lowp="off"),
+        "block": base.replace(sweep_subcull=False, sweep_lowp="off"),
+        "mxu": base.replace(sweep_subcull=True, sweep_lowp="bf16",
+                            sweep_mxu=True),
+    }
+    wires: dict = {}
+    errors: dict = {}
+    paged_identical = None
+    prev = dc._INTERPRET
+    dc._INTERPRET = True
+    try:
+        for a, p in arms.items():
+            try:
+                wires[a] = np.asarray(match_batch_wire(
+                    jnp.asarray(pts), jnp.asarray(lens), m._tables,
+                    ts.meta, p, None, spec=None))
+            except Exception as exc:
+                errors[a] = repr(exc)[:200]
+        if "mxu" in wires:
+            try:
+                m.unstage_tables()
+                m.restage_tables(jax.device_put(ts.host_tables("dense")))
+                w2 = np.asarray(match_batch_wire(
+                    jnp.asarray(pts), jnp.asarray(lens), m._tables,
+                    ts.meta, arms["mxu"], None, spec=None))
+                paged_identical = bool(np.array_equal(w2, wires["mxu"]))
+            except Exception as exc:
+                errors["paging"] = repr(exc)[:200]
+    finally:
+        dc._INTERPRET = prev
+    identical = (all(np.array_equal(wires["subcull"], w)
+                     for w in wires.values())
+                 if "subcull" in wires and len(wires) >= 2 else None)
+    out: dict = {a: {"device_ms_per_dispatch": None,
+                     "device_probes_per_sec": None} for a in arms}
+    out["config"] = (f"interpret-mode validation, {len(fleet)}x"
+                     f"{pts.shape[1]}pt traces, tile={ts.name} (no chip)")
+    out["wires_bit_identical"] = identical
+    out["wires_identical_after_paging"] = paged_identical
+    out["mxu_compared"] = "mxu" in wires    # same honesty rule as the
+    #                                         chip probe's token folding
+    if errors:
+        out["arm_errors"] = errors
     return out
 
 
@@ -2719,7 +2877,7 @@ def main() -> None:
             # vs readback vs host walk vs submit, plus the sweep roofline
             "device_compute": _device_compute_probe(xm, xtraces, link_rtt),
             # round-8 tentpole evidence at metro-xl scale: kernel-lever
-            # A/B (subcull / whole-block / bf16) in interleaved windows +
+            # A/B (subcull / whole-block / mxu) in interleaved windows +
             # on-chip byte-identity of the three result wires
             "sweep_ab": _sweep_variants_probe(xm, xtraces, link_rtt),
             "tile_source": xtile_info["source"],
@@ -3024,6 +3182,16 @@ def main() -> None:
     detail["prepare_bench"] = _prepare_bench(ts, traces)
     split["prepare_bench_s"] = round(time.perf_counter() - t0, 1)
 
+    # Sweep-kernel three-arm A/B validation on composites with no chip
+    # (manual / CPU-forced): the acceptance contract — wire byte-identity
+    # across subcull/block/mxu, including through a paging cycle — is
+    # asserted through the pallas interpreter at tiny scale, so EVERY
+    # composite carries the identity bits even when no TPU can time them.
+    if "sweep_ab" not in detail:
+        t0 = time.perf_counter()
+        detail["sweep_ab"] = _sweep_ab_cpu_validate()
+        split["sweep_ab_s"] = round(time.perf_counter() - t0, 1)
+
     # Metro fleet residency (ISSUE 6) runs on EVERY composite: N>=8
     # generated metros served from this one process — steady-state mixed
     # traffic, a cold-metro promotion storm through a half-size budget,
@@ -3064,6 +3232,29 @@ def main() -> None:
         json.dump(doc, f, indent=1)
     print(json.dumps(doc))
     print(json.dumps(_summary_line(doc)))
+
+
+def _mxu_token(_g) -> list:
+    """mxu = [sf Mpps, xl Mpps, bytes_identical] — the round-13
+    acceptance bar in three slots. The identity slot folds a tile's
+    recorded identity bits (cross-arm wire bytes + the paging-cycle
+    re-harvest) ONLY when that tile's probe says the mxu arm actually
+    participated (``mxu_compared`` — a lowering failure drops the arm,
+    and two legacy arms agreeing must not read as a green three-arm
+    contract): any counted False → 0, all counted True → 1, no tile
+    compared the mxu arm → None."""
+    sf = _g("sweep_ab", "mxu", "device_probes_per_sec")
+    xl = _g("xl", "sweep_ab", "mxu", "device_probes_per_sec")
+    bits = []
+    for path in ((), ("xl",)):
+        if _g(*path, "sweep_ab", "mxu_compared"):
+            bits += [b for b in (
+                _g(*path, "sweep_ab", "wires_bit_identical"),
+                _g(*path, "sweep_ab", "wires_identical_after_paging"),
+            ) if b is not None]
+    return [None if sf is None else round(sf / 1e6, 2),
+            None if xl is None else round(xl / 1e6, 2),
+            None if not bits else int(all(bits))]
 
 
 def _summary_line(doc: dict) -> dict:
@@ -3135,7 +3326,11 @@ def _summary_line(doc: dict) -> dict:
                      ("organic_xl", "ground_truth"))],
         "reach_miss": [_g(k, "reach_audit", "step_miss_rate")
                        for k in ("xl", "organic", "organic_xl")],
-        "stream_pps": _g("streaming", "probes_per_sec"),
+        # kpps int (r13: the mxu token needed the bytes — the r8
+        # tiles_kpps compaction applied here; exact value in
+        # detail.streaming.probes_per_sec)
+        "stream_kpps": (None if _g("streaming", "probes_per_sec") is None
+                        else int(_g("streaming", "probes_per_sec") / 1e3)),
         # dict-pipeline pps + soak p99/offered/duration + the full
         # capacity grid live in the detail file only: the FINAL line must
         # stay under the driver's ~1 KB tail
@@ -3146,7 +3341,12 @@ def _summary_line(doc: dict) -> dict:
                  "p50_ms": _g("streaming_soak", "p50_probe_to_report_ms"),
                  "cap": _g("streaming_capacity", "best_held_pps"),
                  "rej": _g("streaming_overload", "broker_rejected")},
-        "colocated_pps": _g("device_compute", "colocated_probes_per_sec"),
+        # sf submit-vs-device colocated bound, kpps int (same r13
+        # compaction; exact value in detail.device_compute)
+        "colo_kpps": (
+            None if _g("device_compute", "colocated_probes_per_sec") is None
+            else int(_g("device_compute",
+                        "colocated_probes_per_sec") / 1e3)),
         # per-tile co-located e2e in THOUSANDS of probes/s, fixed tile
         # order [sf, bayarea, sf+r, bayarea-xl, organic, organic-xl] —
         # the link-mood-free headline table (full per-tile attribution in
@@ -3156,17 +3356,23 @@ def _summary_line(doc: dict) -> dict:
             for v in (_g("colocated_e2e", t) for t in
                       ("sf", "bayarea", "sf+r", "bayarea-xl",
                        "organic", "organic-xl"))],
-        # round-8 kernel-lever A/B on sf, thousands of device probes/s:
-        # [subcull, whole-block, subcull+bf16, wires byte-identical] —
-        # xl's copy + ms/dispatch live in detail.sweep_ab / detail.xl
+        # kernel-lever A/B on sf, thousands of device probes/s: [subcull,
+        # whole-block, mxu (r13 matmul coarse pass — the promoted home of
+        # the r8 bf16 lever), wires byte-identical] — xl's copy +
+        # ms/dispatch live in detail.sweep_ab / detail.xl
         "sweep_kpps": [
             None if v is None else int(v / 1e3) if not isinstance(v, bool)
             else int(v)
             for v in (_g("sweep_ab", "subcull", "device_probes_per_sec"),
                       _g("sweep_ab", "block", "device_probes_per_sec"),
-                      _g("sweep_ab", "subcull_bf16",
-                         "device_probes_per_sec"),
+                      _g("sweep_ab", "mxu", "device_probes_per_sec"),
                       _g("sweep_ab", "wires_bit_identical"))],
+        # round-13 acceptance token: mxu arm in MILLIONS of device
+        # probes/s on [sf, xl], then bytes-identical (1 requires EVERY
+        # recorded identity bit — both tiles' cross-arm wires AND the
+        # evict→promote paging re-harvest — to be True; 0 = some bit
+        # False; None = nothing recorded)
+        "mxu": _mxu_token(_g),
         # chaos headline (full legs in detail.recovery /
         # detail.publish_outage / detail.streaming_soak_mp): [recovery
         # seconds after a SIGKILL, duplicated reports (the at-least-once

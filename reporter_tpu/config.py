@@ -44,7 +44,24 @@ class MatcherParams:
                                    # refinement inside surviving sub-blocks
                                    # (also bit-identical — the bf16 pass
                                    # only ever SKIPS provably-out-of-radius
-                                   # slices). "off" = f32 only.
+                                   # slices). "off" = f32 only. With
+                                   # sweep_mxu=True this selects the MXU
+                                   # matmul's operand dtype instead
+                                   # ("bf16" = the MXU's native width).
+    sweep_mxu: bool = False        # dense sweep: matmul-form coarse pair
+                                   # pass on the MXU (round 13 kernel arm)
+                                   # — per surviving sub-slice, one
+                                   # [P,8]x[8,subw] dot over staged
+                                   # quadratic feature rows yields every
+                                   # pair's point-to-LINE distance; exact
+                                   # f32 geometry + top-K run only on
+                                   # slices the coarse pass can't prove
+                                   # empty. Bit-identical to the other
+                                   # kernel arms by construction
+                                   # (test-asserted). Requires
+                                   # sweep_subcull=True. Default off
+                                   # pending chip numbers (bench sweep_ab
+                                   # measures it every run).
     breakage_distance: float = 2000.0  # consecutive points farther apart break the HMM chain
     max_route_distance_factor: float = 5.0  # route dist > factor*gc ⇒ transition disallowed
     interpolation_distance: float = 10.0    # points closer than this are interpolated, not matched
@@ -89,9 +106,10 @@ class MatcherParams:
                            ) -> "MatcherParams":
         """Kernel-tuning env overrides (the matcher analog of
         ServiceConfig.with_env_overrides): only set variables apply.
-        RTPU_SWEEP_SUBCULL=0|1 and RTPU_SWEEP_LOWP=off|bf16 flip the
-        dense-sweep kernel levers without a code edit — the on-chip A/B
-        discipline every kernel knob here follows (RTPU_SBLK precedent).
+        RTPU_SWEEP_SUBCULL=0|1, RTPU_SWEEP_LOWP=off|bf16 and
+        RTPU_SWEEP_MXU=0|1 flip the dense-sweep kernel levers without a
+        code edit — the on-chip A/B discipline every kernel knob here
+        follows (RTPU_SBLK precedent).
         """
         e = os.environ if env is None else env
         kw: dict[str, Any] = {}
@@ -114,6 +132,15 @@ class MatcherParams:
                 raise ValueError(
                     f"RTPU_SWEEP_LOWP={lowp!r}: use 'off' or 'bf16'")
             kw["sweep_lowp"] = lowp
+        if "RTPU_SWEEP_MXU" in e:
+            raw = e["RTPU_SWEEP_MXU"].strip().lower()
+            if raw in ("0", "false", "off", "no", ""):
+                kw["sweep_mxu"] = False
+            elif raw in ("1", "true", "on", "yes"):
+                kw["sweep_mxu"] = True
+            else:
+                raise ValueError(
+                    f"RTPU_SWEEP_MXU={raw!r}: use 0/1")
         if "RTPU_DISPATCH_TIMEOUT_S" in e:
             t = float(e["RTPU_DISPATCH_TIMEOUT_S"])
             if t < 0:
@@ -134,6 +161,11 @@ class MatcherParams:
             raise ValueError(
                 "sweep_lowp='bf16' requires sweep_subcull=True — the "
                 "whole-block kernel has no low-precision pass")
+        if out.sweep_mxu and not out.sweep_subcull:
+            # the MXU coarse pass rides the sub-slice structure
+            raise ValueError(
+                "sweep_mxu=True requires sweep_subcull=True — the "
+                "whole-block kernel has no matmul coarse pass")
         return out
 
     @classmethod
@@ -376,6 +408,10 @@ class Config:
             raise ValueError(
                 "matcher.sweep_lowp='bf16' requires sweep_subcull=True — "
                 "the whole-block kernel has no low-precision pass")
+        if self.matcher.sweep_mxu and not self.matcher.sweep_subcull:
+            raise ValueError(
+                "matcher.sweep_mxu=True requires sweep_subcull=True — "
+                "the whole-block kernel has no matmul coarse pass")
         if (self.matcher.candidate_backend == "grid"
                 and self.compiler.index_radius < self.matcher.search_radius):
             raise ValueError(

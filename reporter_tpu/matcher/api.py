@@ -280,6 +280,13 @@ class SegmentMatcher:
                 # values through the same wire programs is what makes
                 # fleet-resident wire bytes identical to a dedicated
                 # matcher's by construction).
+                if staged_tables is not None:
+                    # injected dicts may be pinned/cached from an older
+                    # code version — fail loudly at the staging seam,
+                    # not as kernel garbage (tiles.tileset version tag)
+                    from reporter_tpu.tiles.tileset import (
+                        check_staged_layout)
+                    check_staged_layout(staged_tables)
                 self._tables = (staged_tables if staged_tables is not None
                                 else tileset.device_tables(
                                     self.params.candidate_backend))
@@ -352,6 +359,12 @@ class SegmentMatcher:
         if self.backend != "jax" or not isinstance(self._wire, _LocalWire):
             raise ValueError(
                 "table paging requires the single-device jax backend")
+        # the paging seam's stale-layout guard: a host dict pinned before
+        # a table-layout change (fleet cold tier outliving a code change,
+        # external caches) fails loudly here instead of shipping an
+        # incomplete layout to the kernel
+        from reporter_tpu.tiles.tileset import check_staged_layout
+        check_staged_layout(tables)
         self._tables = tables
         self._wire.tables = tables
 

@@ -58,6 +58,33 @@ except Exception:  # pragma: no cover
 SP_AX, SP_AY, SP_BX, SP_BY, SP_OFF, SP_LEN, SP_EDGE, SP_SPARE = range(8)
 SP_NCOMP = 8
 
+# seg_feat component rows (round 13, the MXU coarse pass): per-column
+# quadratic-form coefficients such that, for a point recentered on the
+# column's SUB-slice center q = p - c,
+#   A*qx^2 + B*qy^2 + C*qx*qy + D*qx + E*qy + F
+#     == squared distance from p to the segment's INFINITE line,
+# a lower bound on the exact point-to-segment distance (the clamp to the
+# endpoints only ever moves the closest point further away). One
+# [P, 8] @ [8, subw] dot per surviving slice evaluates the whole slice's
+# coarse distances on the MXU. Rows CX/CY carry the slice center the
+# coefficients were recentered on — the kernel reads it from HERE, never
+# recomputes it, so host/device center drift is impossible by
+# construction. Padding columns carry F = BIG (coarse distance BIG →
+# never admit a pair on their own).
+SF_A, SF_B, SF_C, SF_D, SF_E, SF_F, SF_CX, SF_CY = range(8)
+SF_NCOMP = 8
+
+# Conservative margin of the MXU coarse test, RELATIVE to the squared
+# clamp-box scale: XLA TPU may serve even an f32-input matmul with
+# bf16-multiply passes (precision=DEFAULT), so the margin assumes
+# bf16-grade operand rounding (2^-9 relative) for BOTH dtypes — the
+# worst-case term-sum bound is ~9*s^2 * 2^-8 ≈ s^2 * 2^-4.8; 2^-4 gives
+# ~1.8x headroom over that already-unattainable joint worst case, and
+# tests/test_dense_candidates.py fuzzes the bound with emulated bf16
+# rounding. An absolute 0.5 m^2 slack covers the tiny-scale regime.
+_MXU_REL_MARGIN = 0.0625
+_MXU_ABS_MARGIN = 0.5
+
 # interpret mode: run the kernel through the pallas interpreter on any
 # backend — slow, for debugging kernel logic without TPU access
 _INTERPRET = os.environ.get("RTPU_PALLAS_INTERPRET", "") == "1"
@@ -99,6 +126,11 @@ class SegPack(NamedTuple):
     #                  # — the in-kernel second culling level; None on
     #                  # packs built before round 8 (kernel falls back to
     #                  # whole-block sweeps)
+    feat: "np.ndarray | None" = None
+    #                  # f32 [8, S_pad] per-column MXU feature rows (SF_*)
+    #                  # — the round-13 matmul-form coarse pass; None on
+    #                  # packs built before round 13 (mxu=True then raises
+    #                  # rather than silently measuring f32 against itself)
 
 
 def _morton(x: np.ndarray, y: np.ndarray) -> np.ndarray:
@@ -236,8 +268,37 @@ def build_seg_pack(seg_a: np.ndarray, seg_b: np.ndarray, seg_edge: np.ndarray,
                       cxmax.reshape(-1, subw).max(1),
                       cymax.reshape(-1, subw).max(1)], axis=1)
     quads[~real.reshape(-1, subw).any(1)] = np.nan
-    sub = quads.astype(np.float32).reshape(nblocks, nsub * 4)
-    return SegPack(pack=pack, bbox=bbox, sub=sub)
+    quads = quads.astype(np.float32)
+    sub = quads.reshape(nblocks, nsub * 4)
+
+    # Per-column MXU feature rows (round 13): quadratic expansion of the
+    # point-to-LINE squared distance, recentered on each column's slice
+    # center. Coefficients are computed in f64 and stored f32 (host
+    # rounding ≪ the kernel's bf16-grade margin); the CENTER itself rides
+    # rows SF_CX/SF_CY so the kernel and the builder can never disagree
+    # on it. Padding columns get zero coefficients + F = BIG → their
+    # coarse distance is BIG and can never keep a slice alive by itself.
+    centers = np.stack([(quads[:, 0] + quads[:, 2]) * np.float32(0.5),
+                        (quads[:, 1] + quads[:, 3]) * np.float32(0.5)],
+                       axis=1)                         # f32 [nslices, 2]
+    c64 = np.repeat(centers, subw, axis=0).astype(np.float64)  # [spad, 2]
+    a64 = np.stack([pack[SP_AX], pack[SP_AY]], 1).astype(np.float64)
+    b64 = np.stack([pack[SP_BX], pack[SP_BY]], 1).astype(np.float64)
+    d64 = b64 - a64
+    w = 1.0 / np.maximum((d64 * d64).sum(1), 1e-12)    # same eps as the
+    #                                                    exact geometry
+    e64 = a64 - c64
+    g = e64[:, 0] * d64[:, 1] - e64[:, 1] * d64[:, 0]  # e x d
+    feat = np.zeros((SF_NCOMP, spad), np.float32)
+    feat[SF_A] = np.where(real, d64[:, 1] ** 2 * w, 0.0)
+    feat[SF_B] = np.where(real, d64[:, 0] ** 2 * w, 0.0)
+    feat[SF_C] = np.where(real, -2.0 * d64[:, 0] * d64[:, 1] * w, 0.0)
+    feat[SF_D] = np.where(real, -2.0 * g * d64[:, 1] * w, 0.0)
+    feat[SF_E] = np.where(real, 2.0 * g * d64[:, 0] * w, 0.0)
+    feat[SF_F] = np.where(real, g * g * w, BIG)
+    feat[SF_CX] = c64[:, 0]
+    feat[SF_CY] = c64[:, 1]
+    return SegPack(pack=pack, bbox=bbox, sub=sub, feat=feat)
 
 
 def cull_radius(radius: float) -> float:
@@ -358,10 +419,9 @@ def _sweep_kernel(ids_ref, pts_ref, seg_ref, edge_out, off_out, dist_out,
                                 jnp.sqrt(jnp.maximum(md, 0.0)), BIG)
 
 
-def _sweep_kernel_sub(ids_ref, pts_ref, seg_ref, sub_ref, edge_out, off_out,
-                      dist_out, d2_s, edge_s, off_s, *, r2: float, rc2: float,
-                      radius: float, k: int, nj: int, nsub: int, subw: int,
-                      lowp: str):
+def _sweep_kernel_sub(ids_ref, pts_ref, seg_ref, sub_ref, *rest,
+                      r2: float, rc2: float, radius: float, k: int, nj: int,
+                      nsub: int, subw: int, lowp: str, mxu: bool = False):
     """Two-level sweep (round 8). Per ``subw``-column slice of the DMA'd
     block: (1) an exact point-vs-slice-bbox distance test (min over the
     chunk's actual points — tighter than the host pre-pass's chunk-bbox
@@ -372,12 +432,23 @@ def _sweep_kernel_sub(ids_ref, pts_ref, seg_ref, sub_ref, edge_out, off_out,
     reductions when a single slice holds every in-radius pair, and the
     roofline says selection roughly doubles effective sweep cost.
 
-    ``lowp="bf16"`` inserts a recentered bf16 coarse pair pass per
-    surviving slice: exact f32 geometry + selection run only when the
-    coarse distances admit an in-radius pair within a conservative
-    margin (a 16-ulp bound on the recentered coordinate magnitude plus
-    0.5 m slack), so the refinement is exact and results stay
-    bit-identical to the f32-only path by construction.
+    ``mxu=True`` (round 13) inserts a matmul-form coarse pair pass per
+    surviving slice: point features [P, 8] (quadratic expansion of the
+    recentered, clamp-boxed point coordinates) against the staged
+    per-column coefficient rows [8, subw] — ONE dot on the MXU whose
+    output is each pair's squared point-to-LINE distance, a lower bound
+    on the exact point-to-segment distance. Exact f32 geometry +
+    selection run only when some coarse distance admits an in-radius
+    pair within a conservative margin (bf16-grade operand rounding is
+    assumed for BOTH matmul dtypes — see _MXU_REL_MARGIN), so results
+    stay bit-identical to every other kernel arm by construction.
+    ``lowp`` selects the matmul operand dtype ("bf16" = native MXU
+    width, "off" = f32 operands).
+
+    ``lowp="bf16"`` WITHOUT mxu keeps the round-8 VPU filter: a
+    recentered bf16 coarse pair pass per surviving slice (a 16-ulp bound
+    on the recentered coordinate magnitude plus 0.5 m slack), same
+    conservative-refinement contract.
 
     Exactness of the culling: slice bboxes are built from the same f32
     endpoint values the geometry reads, the point-to-bbox distance is a
@@ -385,6 +456,11 @@ def _sweep_kernel_sub(ids_ref, pts_ref, seg_ref, sub_ref, edge_out, off_out,
     ``rc2`` carries a small static dilation over ``r2`` to absorb f32
     rounding of the bound itself — so no in-radius pair is ever skipped.
     """
+    if mxu:
+        (feat_ref, edge_out, off_out, dist_out, d2_s, edge_s, off_s) = rest
+    else:
+        feat_ref = None
+        (edge_out, off_out, dist_out, d2_s, edge_s, off_s) = rest
     i = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -431,7 +507,51 @@ def _sweep_kernel_sub(ids_ref, pts_ref, seg_ref, sub_ref, edge_out, off_out,
                         edge_s[:] = me
                         off_s[:] = mo
 
-                if lowp != "bf16":
+                if mxu:
+                    # MXU coarse pass: evaluate every pair's squared
+                    # point-to-LINE distance as one [P, 8] x [8, subw]
+                    # dot over the staged quadratic coefficients. The
+                    # point is recentered on the SAME center the
+                    # coefficients were built with (read from the
+                    # feature rows — never recomputed) and clamped into
+                    # the slice bbox dilated by ~radius: the box contains
+                    # every segment of the slice, so projecting the
+                    # point into it never increases its distance to
+                    # them, and the clamp bounds every matmul operand by
+                    # the slice extent instead of the chunk's spread
+                    # (the r8 bf16-filter argument, verbatim).
+                    feat = feat_ref[:, s * subw:(s + 1) * subw]
+                    cx = feat[SF_CX:SF_CX + 1, 0:1]    # [1, 1] each
+                    cy = feat[SF_CY:SF_CY + 1, 0:1]
+                    mx = jnp.float32(radius) * 1.001 + 0.5
+                    exm = (hix - lox) * 0.5 + mx
+                    eym = (hiy - loy) * 0.5 + mx
+                    qx = jnp.clip(px - cx, -exm, exm)  # [P, 1]
+                    qy = jnp.clip(py - cy, -eym, eym)
+                    one = jnp.ones_like(qx)
+                    zero = jnp.zeros_like(qx)
+                    pf = jnp.concatenate(
+                        [qx * qx, qy * qy, qx * qy, qx, qy, one,
+                         zero, zero], axis=1)          # [P, 8]
+                    # rows SF_CX/SF_CY multiply the two zero point
+                    # features — exactly 0 contribution at any rounding
+                    if lowp == "bf16":
+                        lhs = pf.astype(jnp.bfloat16)
+                        rhs = feat.astype(jnp.bfloat16)
+                    else:
+                        lhs, rhs = pf, feat
+                    d2m = jax.lax.dot_general(
+                        lhs, rhs, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)  # [P, subw]
+                    scale = jnp.maximum(exm, eym)
+                    thr = (jnp.float32(r2)
+                           + scale * scale * jnp.float32(_MXU_REL_MARGIN)
+                           + jnp.float32(_MXU_ABS_MARGIN))
+
+                    @pl.when(jnp.min(d2m) <= jnp.min(thr))
+                    def _():
+                        exact()
+                elif lowp != "bf16":
                     exact()
                 else:
                     # recenter on the slice bbox AND clamp every operand
@@ -532,17 +652,26 @@ def _chunk_block_ids(pts, valid, bbox, radius: float, nchunks: int):
 
 
 def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
-                  k: int, subcull: bool = True, lowp: str = "off"):
+                  k: int, subcull: bool = True, lowp: str = "off",
+                  mxu: bool = False):
     pack, bbox = seg_pack[0], seg_pack[1]
     sub = seg_pack[2] if len(seg_pack) > 2 else None
+    feat = seg_pack[3] if len(seg_pack) > 3 else None
     use_sub = bool(subcull) and sub is not None
-    if lowp == "bf16" and not use_sub:
+    if lowp == "bf16" and not use_sub and not mxu:
         # only the two-level kernel implements the low-precision pass;
         # silently running plain f32 would let an A/B "bf16 arm" measure
         # f32 against itself (the config layer raises the same way)
         raise ValueError(
             "lowp='bf16' requires the two-level kernel: subcull=True and "
             "a seg_pack built with sub quads")
+    use_mxu = bool(mxu)
+    if use_mxu and (not use_sub or feat is None):
+        # same discipline: an "mxu arm" that silently fell back to the
+        # plain two-level kernel would A/B-measure an arm against itself
+        raise ValueError(
+            "mxu=True requires the two-level kernel (subcull=True) and a "
+            "seg_pack built with feat rows (round 13 build_seg_pack)")
     n = points.shape[0]
     spad = pack.shape[1]
     nchunks = max(1, (n + _P - 1) // _P)
@@ -578,9 +707,16 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
             in_specs.append(
                 pl.BlockSpec((1, nsub4), lambda i, j, ids: (ids[i, j], 0)))
             inputs.append(sub)
+            if use_mxu:
+                # feature rows ride the same per-block DMA discipline as
+                # the segment pack (equal consecutive ids skip the fetch)
+                in_specs.append(
+                    pl.BlockSpec((SF_NCOMP, _SBLK),
+                                 lambda i, j, ids: (0, ids[i, j])))
+                inputs.append(feat)
             kern = functools.partial(
                 _sweep_kernel_sub, r2=r2, rc2=rc * rc, radius=float(radius),
-                k=k, nj=nj, nsub=nsub, subw=subw, lowp=lowp)
+                k=k, nj=nj, nsub=nsub, subw=subw, lowp=lowp, mxu=use_mxu)
         else:
             kern = functools.partial(_sweep_kernel, r2=r2, k=k, nj=nj)
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -682,27 +818,33 @@ def _use_pallas() -> bool:
 def find_candidates_dense(points, seg_pack, radius: float,
                           max_candidates: int,
                           valid=None, subcull: bool = True,
-                          lowp: str = "off") -> CandidateSet:
+                          lowp: str = "off",
+                          mxu: bool = False) -> CandidateSet:
     """points f32 [N, 2] → CandidateSet with [N, K] fields (flat batch).
 
-    seg_pack: a SegPack (or (pack, bbox[, sub]) tuple of arrays). valid
-    (bool [N], optional) marks padding points — they still produce
-    (ignored) rows but are excluded from the culling bboxes. Uses the
-    pallas sweep on accelerators, the jnp full sweep on CPU backends.
+    seg_pack: a SegPack (or (pack, bbox[, sub[, feat]]) tuple of
+    arrays). valid (bool [N], optional) marks padding points — they
+    still produce (ignored) rows but are excluded from the culling
+    bboxes. Uses the pallas sweep on accelerators, the jnp full sweep on
+    CPU backends.
 
     subcull enables the in-kernel sub-block culling + fused narrow top-K
     (round 8; needs the pack's ``sub`` quads — silently falls back to the
-    whole-block kernel without them). lowp="bf16" adds the conservative
-    low-precision coarse pair filter with exact f32 refinement. Both are
-    bit-identical to the whole-block kernel and the jnp reference by
-    construction (interpret-mode test-asserted).
+    whole-block kernel without them). mxu=True (round 13) runs the
+    matmul-form coarse pair pass on the MXU per surviving slice (needs
+    the pack's ``feat`` rows — raises without them); lowp="bf16" then
+    selects bf16 matmul operands. lowp="bf16" without mxu keeps the
+    round-8 VPU coarse pair filter. Every combination is bit-identical
+    to the whole-block kernel and the jnp reference by construction
+    (interpret-mode test-asserted): coarse passes only ever SKIP
+    provably-out-of-radius work, refinement is exact f32.
     """
     if valid is None:
         valid = jnp.ones(points.shape[0], bool)
     if _use_pallas():
         edge, off, dist = _dense_pallas(points, valid, seg_pack, radius,
                                         max_candidates, subcull=subcull,
-                                        lowp=lowp)
+                                        lowp=lowp, mxu=mxu)
     else:
         edge, off, dist = _dense_jnp(points, seg_pack, radius, max_candidates)
     return CandidateSet(edge=edge, offset=off, dist=dist, valid=edge >= 0)

@@ -63,11 +63,12 @@ def batch_candidates(points, valid_pt, tables, meta,
         flat = find_candidates_dense(
             points.reshape(B * T, 2),
             (tables["seg_pack"], tables["seg_bbox"],
-             tables.get("seg_sub")),
+             tables.get("seg_sub"), tables.get("seg_feat")),
             params.search_radius, params.max_candidates,
             valid=valid_pt.reshape(B * T),
             subcull=getattr(params, "sweep_subcull", True),
-            lowp=getattr(params, "sweep_lowp", "off"))
+            lowp=getattr(params, "sweep_lowp", "off"),
+            mxu=getattr(params, "sweep_mxu", False))
         return CandidateSet(*(x.reshape(B, T, -1) for x in flat))
     if backend != "grid":
         raise ValueError(

@@ -41,6 +41,10 @@ _PAD_VALUES: dict[str, Any] = {
     # same rule for the in-kernel sub-block quads (rows pad in sync with
     # seg_bbox: whole _SBLK blocks)
     "seg_sub": np.float32(np.nan),
+    # MXU feature rows pad in whole blocks too; those blocks' NaN seg_sub
+    # quads gate them off before the matmul ever reads these — any fill
+    # works, BIG in the F slot's spirit keeps a stray read conservative
+    "seg_feat": np.float32(1e30),
     "reach_to": -1,          # no reachable target
     "reach_dist": np.float32(np.inf),
     "edge_osmlr": -1,

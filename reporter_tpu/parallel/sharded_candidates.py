@@ -2,9 +2,9 @@
 
 SURVEY.md §2.3 marks tensor parallelism "not needed; optional sharded kNN
 reduce over ICI if a metro's edge set exceeds one core's HBM". This is
-that option: the Morton-blocked segment table (seg_pack columns + their
-bboxes) is sharded over a mesh axis, every device sweeps its shard of the
-map against the FULL point batch, and the per-shard top-K candidate lists
+that option: the Morton-blocked segment table (seg_pack + seg_feat
+columns + their bboxes) is sharded over a mesh axis, every device sweeps
+its shard of the map against the FULL point batch, and the per-shard top-K candidate lists
 are all-gathered over ICI and merged with the same distinct-edge K-merge
 the dense kernel uses per block. Viterbi then runs data-parallel on the
 merged candidates (reach tables replicated — node-keyed [N, M] and small
@@ -48,6 +48,8 @@ class ShardedTables(NamedTuple):
     seg_pack: jnp.ndarray    # [8, S_pad] — sharded over columns
     seg_bbox: jnp.ndarray    # [nblocks, 4] — sharded over rows
     seg_sub: jnp.ndarray     # [nblocks, nsub*4] — sharded over rows
+    seg_feat: jnp.ndarray    # [8, S_pad] MXU feature rows — sharded over
+    #                          columns in lockstep with seg_pack
     edge_len: jnp.ndarray    # replicated
     reach_row: jnp.ndarray   # replicated (edge → governing reach row)
     reach_to: jnp.ndarray
@@ -71,6 +73,10 @@ def shard_tables(mesh: Mesh, ts: TileSet, axis: str = "tile",
     bbox[:sp.bbox.shape[0]] = sp.bbox
     sub = np.full((total // _SBLK, sp.sub.shape[1]), np.nan, np.float32)
     sub[:sp.sub.shape[0]] = sp.sub
+    # feature rows pad in whole blocks whose NaN sub quads gate them off
+    # before the matmul — BIG fill keeps a stray read conservative
+    feat = np.full((sp.feat.shape[0], total), np.float32(1e30), np.float32)
+    feat[:, :spad] = sp.feat
 
     return ShardedTables(
         seg_pack=jax.device_put(jnp.asarray(pack),
@@ -79,6 +85,8 @@ def shard_tables(mesh: Mesh, ts: TileSet, axis: str = "tile",
                                 NamedSharding(mesh, P(axis))),
         seg_sub=jax.device_put(jnp.asarray(sub),
                                NamedSharding(mesh, P(axis))),
+        seg_feat=jax.device_put(jnp.asarray(feat),
+                                NamedSharding(mesh, P(None, axis))),
         edge_len=jax.device_put(jnp.asarray(ts.edge_len),
                                 NamedSharding(mesh, P())),
         reach_row=jax.device_put(jnp.asarray(ts.edge_reach_row),
@@ -116,14 +124,16 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     tables = shard_tables(mesh, ts, axis)
     radius, k = params.search_radius, params.max_candidates
 
-    def local(points, valid, seg_pack, seg_bbox, seg_sub, edge_len,
-              reach_row, reach_to, reach_dist):
+    def local(points, valid, seg_pack, seg_bbox, seg_sub, seg_feat,
+              edge_len, reach_row, reach_to, reach_dist):
         B, T = points.shape[:2]
         flat = find_candidates_dense(
-            points.reshape(B * T, 2), (seg_pack, seg_bbox, seg_sub),
+            points.reshape(B * T, 2),
+            (seg_pack, seg_bbox, seg_sub, seg_feat),
             radius, k, valid=valid.reshape(B * T),
             subcull=getattr(params, "sweep_subcull", True),
-            lowp=getattr(params, "sweep_lowp", "off"))
+            lowp=getattr(params, "sweep_lowp", "off"),
+            mxu=getattr(params, "sweep_mxu", False))
         # all-gather each shard's K-list over ICI, then K-merge
         ge = jax.lax.all_gather(flat.edge, axis)        # [shards, N, K]
         gd = jax.lax.all_gather(flat.dist, axis)
@@ -147,7 +157,8 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     sharded = shard_map(
         local, mesh=mesh,
         in_specs=(P(*other) if other else P(), P(*other) if other else P(),
-                  P(None, axis), P(axis), P(axis), P(), P(), P(), P()),
+                  P(None, axis), P(axis), P(axis), P(None, axis),
+                  P(), P(), P(), P()),
         out_specs=P(*other) if other else P(),
         check_vma=False,
     )
@@ -155,7 +166,7 @@ def make_sharded_matcher(mesh: Mesh, ts: TileSet, params: MatcherParams,
     @jax.jit
     def step(points, valid) -> MatchOutput:
         return sharded(points, valid, tables.seg_pack, tables.seg_bbox,
-                       tables.seg_sub, tables.edge_len, tables.reach_row,
-                       tables.reach_to, tables.reach_dist)
+                       tables.seg_sub, tables.seg_feat, tables.edge_len,
+                       tables.reach_row, tables.reach_to, tables.reach_dist)
 
     return step

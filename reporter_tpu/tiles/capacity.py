@@ -52,16 +52,17 @@ class StagingPlan(NamedTuple):
 def dense_staged_bytes(ts: TileSet) -> tuple[int, int]:
     """(shardable, fixed) HBM bytes for the dense path's device tables.
 
-    shardable — seg_pack [8, S] f32 + per-block bboxes, what
-    parallel/sharded_candidates.shard_tables splits over the mesh;
+    shardable — seg_pack + seg_feat [8, S] f32 each + per-block bboxes,
+    what parallel/sharded_candidates.shard_tables splits over the mesh;
     fixed — per-edge arrays + node-keyed reach rows, replicated by design
     (every shard's Viterbi needs them).
     """
-    from reporter_tpu.ops.dense_candidates import (_SBLK, _SUB, SP_NCOMP,
-                                                   packed_columns)
+    from reporter_tpu.ops.dense_candidates import (_SBLK, _SUB, SF_NCOMP,
+                                                   SP_NCOMP, packed_columns)
 
     # exact shape math for build_seg_pack's layout ([SP_NCOMP, S_pad] f32
-    # pack + [S_pad/_SBLK, 4] f32 block bboxes + the per-sub-block quads
+    # pack + the round-13 [SF_NCOMP, S_pad] f32 MXU feature rows +
+    # [S_pad/_SBLK, 4] f32 block bboxes + the per-sub-block quads
     # [S_pad/_SBLK, (SBLK/SUB)*4]) — computing it beats REBUILDING the
     # Morton pack (~seconds at 0.6M segments on a one-core host).
     # packed_columns accounts for the long-segment pre-split at the
@@ -69,7 +70,8 @@ def dense_staged_bytes(ts: TileSet) -> tuple[int, int]:
     # ts.seg_edge on tiles with long segments).
     spad = packed_columns(ts.seg_len)
     nsub = _SBLK // _SUB if _SUB and _SBLK % _SUB == 0 else 1
-    shardable = (SP_NCOMP * spad + (spad // _SBLK) * 4 * (1 + nsub)) * 4
+    shardable = ((SP_NCOMP + SF_NCOMP) * spad
+                 + (spad // _SBLK) * 4 * (1 + nsub)) * 4
     fixed = int(ts.edge_len.nbytes + ts.edge_reach_row.nbytes
                 + ts.edge_osmlr.nbytes + ts.reach_to.nbytes
                 + ts.reach_dist.nbytes)

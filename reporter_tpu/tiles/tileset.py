@@ -57,6 +57,55 @@ PACK_AX, PACK_AY, PACK_BX, PACK_BY = 0, 1, 2, 3
 PACK_OFF, PACK_LEN, PACK_EDGE, PACK_SPARE = 4, 5, 6, 7
 PACK_NCOMP = 8
 
+# Version of the staged device-table LAYOUT (the member set host_tables
+# builds). Bumped whenever a table is added/changed so a host_tables
+# dict pinned BEFORE the change (the fleet cold tier keeps them for the
+# process lifetime; external callers may cache them) fails loudly at
+# restage time instead of shipping an incomplete layout to the kernel.
+#   v2 (round 13): + seg_feat (MXU quadratic feature rows) next to the
+#   round-8 seg_sub quads. Pre-tag dicts (≤ r12) carry no tag at all.
+STAGED_LAYOUT_VERSION = 2
+
+# every SegPack member the dense layout must stage as of this version —
+# check_staged_layout cross-checks the member set, not just the tag, so
+# a hand-assembled dict can't pass with a fresh tag and a stale layout
+_DENSE_LAYOUT_KEYS = ("seg_pack", "seg_bbox", "seg_sub", "seg_feat")
+
+
+def check_staged_layout(tables) -> None:
+    """Assert a staged-tables dict was built by THIS code version's
+    ``host_tables``/``device_tables``. Called at every staging seam that
+    accepts a pre-built dict (SegmentMatcher(staged_tables=...),
+    restage_tables — the fleet promotion path): a dict built before a
+    layout change would otherwise reach the kernel missing a table (or
+    carrying a stale one) and fail as garbage three layers down."""
+    v = None
+    if hasattr(tables, "get"):
+        v = tables.get("staged_layout")
+    if v is None:
+        raise ValueError(
+            "staged tables carry no staged_layout version tag — built "
+            "before the versioned staging layout (round 13); rebuild the "
+            "dict with TileSet.host_tables()/device_tables()")
+    # value check only on host-backed tags: reading a device-resident
+    # scalar back would cost a link RTT on the fleet promote path (the
+    # axon tunnel, CLAUDE.md) for a dict that was device_put from a
+    # host dict any host-side seam already vetted. The key-presence and
+    # member-set checks below are free and cover the realistic stale
+    # case (pre-tag dicts have no key at all).
+    if isinstance(v, (int, np.integer, np.ndarray)):
+        if int(v) != STAGED_LAYOUT_VERSION:
+            raise ValueError(
+                f"staged tables are layout v{int(v)}, this code stages "
+                f"v{STAGED_LAYOUT_VERSION} — rebuild the dict with "
+                "TileSet.host_tables()/device_tables()")
+    if "seg_pack" in tables:
+        missing = [k for k in _DENSE_LAYOUT_KEYS if k not in tables]
+        if missing:
+            raise ValueError(
+                f"staged dense layout is missing {missing} despite a "
+                f"current version tag — rebuild with TileSet.host_tables()")
+
 
 def build_cell_pack(grid: np.ndarray, seg_a: np.ndarray, seg_b: np.ndarray,
                     seg_edge: np.ndarray, seg_off: np.ndarray,
@@ -220,7 +269,8 @@ class TileSet:
         ``candidate_backend`` prunes the candidate-search layout staged:
         "dense" skips cell_pack (the grid backend's [C, 8*cap] f32 fusion
         — by far the largest table at metro scale: ~1.06 GB for
-        bayarea-xl vs 19 MB of seg_pack), "grid" skips seg_pack/bbox,
+        bayarea-xl vs ~39 MB of seg_pack + seg_feat), "grid" skips the
+        seg_pack/bbox/sub/feat layout,
         "auto" resolves like ops.match.batch_candidates (grid on CPU,
         dense on accelerators), "both" stages everything (multimetro
         stacking and tests that flip backends per matcher)."""
@@ -258,6 +308,12 @@ class TileSet:
         # gathers at all; ops/dense_candidates.py). The id-only grid and
         # per-segment SoA arrays stay host-side.
         out: dict[str, np.ndarray] = {
+            # layout version tag (check_staged_layout): a 0-d i32 that
+            # rides the dict everywhere — through device_put (fleet
+            # promotions), the multimetro stack, and the wire entries
+            # (unused dynamic leaf) — so a pinned dict from an older
+            # layout can never silently restage
+            "staged_layout": np.int32(STAGED_LAYOUT_VERSION),
             "edge_len": np.asarray(self.edge_len),
             "reach_row": np.asarray(self.edge_reach_row),
             "edge_osmlr": np.asarray(self.edge_osmlr),
@@ -276,6 +332,9 @@ class TileSet:
             # per-sub-block bbox quads: the kernel's in-block second
             # culling level (round 8) — tiny next to seg_pack
             out["seg_sub"] = np.asarray(sp.sub)
+            # per-column MXU feature rows: the matmul-form coarse pass
+            # (round 13) — same [8, S_pad] footprint as seg_pack
+            out["seg_feat"] = np.asarray(sp.feat)
         return out
 
     def device_tables(self, candidate_backend: str = "both",

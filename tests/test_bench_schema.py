@@ -43,9 +43,10 @@ CULLING_KEYS = {
 
 ROOFLINE_KEYS = CULLING_KEYS | {
     "kernel", "hbm_bytes_swept", "pair_flops", "pair_flops_block_level",
+    "mxu_flops", "select_flops_ceiling",
     "topk_width", "achieved_GBps", "achieved_Gflops",
     "pct_of_v5e_hbm_peak", "pct_of_v5e_vpu_f32_peak",
-    "pct_vpu_block_level", "note",
+    "pct_vpu_block_level", "pct_of_v5e_mxu_bf16_peak", "note",
 }
 
 
@@ -72,7 +73,7 @@ def test_culling_stats_schema_and_invariants():
             == flat["block_visits_per_dispatch"])
 
 
-def test_roofline_schema_both_kernels():
+def test_roofline_schema_all_kernels():
     import jax.numpy as jnp
 
     from reporter_tpu.config import MatcherParams
@@ -81,27 +82,42 @@ def test_roofline_schema_both_kernels():
     sp = _tiny_pack()
     tables = {"seg_pack": jnp.asarray(sp.pack),
               "seg_bbox": jnp.asarray(sp.bbox),
-              "seg_sub": jnp.asarray(sp.sub)}
+              "seg_sub": jnp.asarray(sp.sub),
+              "seg_feat": jnp.asarray(sp.feat)}
     pts = np.random.default_rng(7).uniform(0, 900.0, (256, 2)
                                            ).astype(np.float32)
     for params in (MatcherParams(),
                    MatcherParams(sweep_subcull=False),
-                   MatcherParams(sweep_lowp="bf16")):
+                   MatcherParams(sweep_lowp="bf16"),
+                   MatcherParams(sweep_mxu=True, sweep_lowp="bf16")):
         m = SimpleNamespace(_tables=tables, params=params)
         out = bench._sweep_roofline(m, pts, per_dispatch_s=0.1)
         assert ROOFLINE_KEYS <= set(out), params
         assert out["pair_flops"] <= out["pair_flops_block_level"]
+        assert out["select_flops_ceiling"] > 0
         if params.sweep_subcull:
             assert out["kernel"].startswith("subcull")
         else:
             assert out["kernel"] == "block"
         if params.sweep_lowp == "bf16":
             assert out["kernel"].endswith("+bf16")
+        if params.sweep_mxu:
+            # third work level: the matmul coarse pass is counted and
+            # compared against the MXU peak, and the feature-row DMA
+            # rides the swept bytes
+            assert "+mxu" in out["kernel"]
+            assert out["mxu_flops"] > 0
+            assert out["pct_of_v5e_mxu_bf16_peak"] is not None
+        else:
+            assert out["mxu_flops"] == 0
+            assert out["pct_of_v5e_mxu_bf16_peak"] is None
 
 
 def test_summary_line_carries_roofline_era_fields():
-    """The compact driver line must keep the round-8 fields: per-tile
-    co-located table, sweep A/B, overload boundary."""
+    """The compact driver line must keep the round-8 fields — per-tile
+    co-located table, sweep A/B, overload boundary — with the r13 mxu
+    arm in the third sweep slot (the promoted home of the r8 bf16
+    lever) plus the dedicated mxu acceptance token."""
     bench = _load_bench()
     doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
            "unit": "probes/s", "vs_baseline": 1.0,
@@ -110,15 +126,38 @@ def test_summary_line_carries_roofline_era_fields():
                "sweep_ab": {
                    "subcull": {"device_probes_per_sec": 3500000.0},
                    "block": {"device_probes_per_sec": 3000000.0},
-                   "subcull_bf16": {"device_probes_per_sec": 3300000.0},
-                   "wires_bit_identical": True},
+                   "mxu": {"device_probes_per_sec": 3700000.0},
+                   "wires_bit_identical": True,
+                   "wires_identical_after_paging": True,
+                   "mxu_compared": True},
+               "xl": {"sweep_ab": {
+                   "mxu": {"device_probes_per_sec": 2900000.0},
+                   "wires_bit_identical": True,
+                   "wires_identical_after_paging": True,
+                   "mxu_compared": True}},
                "service_overload_boundary": {"clients": 512},
            }}
     line = bench._summary_line(doc)
     assert line["coe2e_kpps"][0] == 3000    # sf first, fixed order
     assert line["coe2e_kpps"][3] == 1800    # bayarea-xl fourth
-    assert line["sweep_kpps"] == [3500, 3000, 3300, 1]
+    assert line["sweep_kpps"] == [3500, 3000, 3700, 1]
+    assert line["mxu"] == [3.7, 2.9, 1]
     assert line["svc_edge"] == 512
+    # one False identity bit anywhere → the acceptance slot reads 0
+    doc["detail"]["xl"]["sweep_ab"]["wires_identical_after_paging"] = False
+    assert bench._summary_line(doc)["mxu"] == [3.7, 2.9, 0]
+    # a tile where the mxu arm FAILED to run must not contribute its
+    # legacy-arm identity bits to the mxu acceptance slot (a lowering
+    # failure on chip must read "not exercised", never vacuous green)
+    for tile in (doc["detail"]["sweep_ab"], doc["detail"]["xl"]["sweep_ab"]):
+        tile["mxu_compared"] = False
+        tile.pop("mxu")
+    line3 = bench._summary_line(doc)
+    assert line3["mxu"] == [None, None, None]
+    # nothing recorded → None slots, never KeyError
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["mxu"] == [None] * 3
 
 
 def test_coverage_diff_matches_traversals_not_bytes():

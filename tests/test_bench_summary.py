@@ -45,6 +45,14 @@ def _synthetic_doc():
             "device_compute": {"binding_leg": "device_sweep"},
             "ground_truth": {"point_edge_rate": 0.9444},
             "reach_audit": {"step_miss_rate": 0.0},
+            "sweep_ab": {
+                "subcull": {"device_probes_per_sec": 2860000.1},
+                "block": {"device_probes_per_sec": 2410000.2},
+                "mxu": {"device_probes_per_sec": 2930000.3},
+                "wires_bit_identical": True,
+                "wires_identical_after_paging": True,
+                "mxu_compared": True,
+            },
         },
         "organic": {
             **_tile(1730000.5),
@@ -78,9 +86,11 @@ def _synthetic_doc():
                         "device_ms_per_dispatch": 138.11},
             "block": {"device_probes_per_sec": 3030000.8,
                       "device_ms_per_dispatch": 162.22},
-            "subcull_bf16": {"device_probes_per_sec": 3410000.9,
-                             "device_ms_per_dispatch": 144.33},
+            "mxu": {"device_probes_per_sec": 3410000.9,
+                    "device_ms_per_dispatch": 144.33},
             "wires_bit_identical": True,
+            "wires_identical_after_paging": True,
+            "mxu_compared": True,
         },
         "service_ab": {"clients": 512, "scheduler_rps": 1544.3,
                        "legacy_rps": 713.9, "speedup": 2.163,

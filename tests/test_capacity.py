@@ -37,7 +37,8 @@ class TestPlanStaging:
         assert fixed == staged_fixed
         real = (int(np.asarray(tables["seg_pack"]).nbytes)
                 + int(np.asarray(tables["seg_bbox"]).nbytes)
-                + int(np.asarray(tables["seg_sub"]).nbytes))
+                + int(np.asarray(tables["seg_sub"]).nbytes)
+                + int(np.asarray(tables["seg_feat"]).nbytes))
         assert shardable == real    # exact: same builder, same layout
 
     def test_sharded_past_budget_and_monotone(self, tiny_tiles):

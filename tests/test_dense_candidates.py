@@ -285,8 +285,8 @@ def test_pallas_kernels_interpret_parity(ts, monkeypatch):
     ``_INTERPRET`` / ``_SBLK`` / ``_SUB`` / ``_NJ_CAP`` are module
     globals read at CALL time, so monkeypatch flips them, and interpret
     pallas costs seconds PER CALL (the narrow-grid cond traces BOTH
-    sweeps each call), so coverage is folded into four calls over one
-    shared batch shape:
+    sweeps each call), so coverage is folded into a handful of calls
+    over one shared batch shape:
 
       1. round-8 two-level kernel, narrow launch EXECUTING (_NJ_CAP=1,
          spatially tight batch) — junction-node d=0 ties included;
@@ -296,7 +296,13 @@ def test_pallas_kernels_interpret_parity(ts, monkeypatch):
       3. bf16 coarse-filter variant (cond lifted — one trace), same
          spread batch: conservative-refinement exactness incl. ties;
       4. the retained r7 whole-block kernel (sweep_subcull=False), cond
-         live — the bench A/B arm stays pinned too.
+         live — the bench A/B arm stays pinned too;
+      5-7. the round-13 MXU arm: narrow branch executing on the tight
+         batch (d=0 ties through the matmul coarse pass), full-width
+         fallback executing on the spread batch (radius-boundary
+         points), and the bf16-operand matmul (cond lifted) — the three
+         adversarial regimes the r8 arms pinned, now pinned for the
+         matmul-form coarse pass too.
 
     _SBLK forced to 128 / _SUB to 64 so even the tiny tile spans
     multiple blocks x 2 sub-slices per block (multi-block merge + the
@@ -313,7 +319,7 @@ def test_pallas_kernels_interpret_parity(ts, monkeypatch):
                         ts.seg_len, block=128)
     assert sp.bbox.shape[0] >= 2 and sp.sub.shape[1] == 8
     packs = (jnp.asarray(sp.pack), jnp.asarray(sp.bbox),
-             jnp.asarray(sp.sub))
+             jnp.asarray(sp.sub), jnp.asarray(sp.feat))
 
     rng = np.random.default_rng(7)
     lo = ts.node_xy.min(0)
@@ -355,6 +361,10 @@ def test_pallas_kernels_interpret_parity(ts, monkeypatch):
     check(spread, "spread", cap=1)                  # fallback executes
     check(spread, "spread", cap=8, lowp="bf16")     # no cond: one trace
     check(spread, "spread", cap=1, subcull=False)   # r7 whole-block arm
+    check(local, "local", cap=1, mxu=True)          # mxu: narrow + ties
+    check(spread, "spread", cap=1, mxu=True)        # mxu: fallback + 48-52m
+    check(spread, "spread", cap=8, mxu=True,        # mxu: bf16 operands,
+          lowp="bf16")                              # no cond: one trace
 
     # documented 2-tuple fallback: a pack WITHOUT sub quads silently
     # runs the whole-block kernel even with subcull requested (pre-r8
@@ -364,4 +374,241 @@ def test_pallas_kernels_interpret_parity(ts, monkeypatch):
     e, o, d = refs["spread"]
     assert (np.asarray(c.edge) == np.asarray(e)).all()
     assert np.allclose(np.asarray(c.dist), np.asarray(d),
+                       rtol=1e-5, atol=1e-2)
+
+    # mxu=True on a pack WITHOUT feat rows must raise, not silently run
+    # the plain two-level kernel (an A/B arm measuring itself)
+    with pytest.raises(ValueError, match="feat"):
+        dc.find_candidates_dense(jnp.asarray(spread), packs[:3], 50.0, 8,
+                                 mxu=True)
+
+
+def test_seg_pack_feat_quadratic(ts):
+    """The round-13 MXU feature rows: for every real column, the staged
+    quadratic form evaluated at a recentered point equals the squared
+    point-to-LINE distance (f64 reference), which lower-bounds the exact
+    point-to-segment distance; padding columns carry F = BIG so they can
+    never keep a slice alive on their own."""
+    from reporter_tpu.ops import dense_candidates as dc
+
+    sp = build_seg_pack(ts.seg_a, ts.seg_b, ts.seg_edge, ts.seg_off,
+                        ts.seg_len)
+    edges = sp.pack[dc.SP_EDGE].view(np.int32)
+    real = edges >= 0
+    assert sp.feat.shape == sp.pack.shape
+    assert (sp.feat[dc.SF_F][~real] == dc.BIG).all()
+    assert (sp.feat[dc.SF_A:dc.SF_F][:, ~real] == 0.0).all()
+
+    f = sp.feat.astype(np.float64)
+    a = np.stack([sp.pack[dc.SP_AX], sp.pack[dc.SP_AY]], 1)[real].astype(
+        np.float64)
+    b = np.stack([sp.pack[dc.SP_BX], sp.pack[dc.SP_BY]], 1)[real].astype(
+        np.float64)
+    d = b - a
+    denom = np.maximum((d * d).sum(1), 1e-12)
+    rng = np.random.default_rng(9)
+    pts = rng.uniform(ts.node_xy.min(0) - 80, ts.node_xy.max(0) + 80,
+                      (40, 2))
+    for p in pts:
+        qx = p[0] - f[dc.SF_CX][real]
+        qy = p[1] - f[dc.SF_CY][real]
+        form = (f[dc.SF_A][real] * qx * qx + f[dc.SF_B][real] * qy * qy
+                + f[dc.SF_C][real] * qx * qy + f[dc.SF_D][real] * qx
+                + f[dc.SF_E][real] * qy + f[dc.SF_F][real])
+        cross = (p[0] - a[:, 0]) * d[:, 1] - (p[1] - a[:, 1]) * d[:, 0]
+        dline2 = cross * cross / denom
+        np.testing.assert_allclose(form, dline2, rtol=1e-3, atol=0.05)
+        # lower bound on the exact segment distance (clamped projection)
+        t = np.clip(((p - a) * d).sum(1) / denom, 0.0, 1.0)
+        proj = a + t[:, None] * d
+        dseg2 = ((p - proj) ** 2).sum(1)
+        assert (form <= dseg2 + 0.06).all()
+
+
+def test_mxu_coarse_filter_is_conservative_under_bf16():
+    """Fuzz the margin constants (_MXU_REL_MARGIN/_MXU_ABS_MARGIN): a
+    host replication of the kernel's coarse pass — recenter, clamp into
+    the dilated slice box, build the [.., 8] features, round EVERY matmul
+    operand to bf16 (harsher than the MXU's exact-product/f32-accumulate
+    pipeline) — must never score an in-radius pair above the slice
+    threshold. The clamp-projection argument (the box contains the
+    slice's segments, so projecting the point into it never increases
+    its distance to them) plus the margin must absorb every rounding
+    source, or the kernel could silently drop candidates on chip."""
+    import ml_dtypes
+
+    from reporter_tpu.ops import dense_candidates as dc
+
+    rng = np.random.default_rng(17)
+    n = 400
+    radius = 50.0
+    a = rng.uniform(0, 3000.0, (n, 2)).astype(np.float32)
+    # mixed lengths incl. >256 m (exercises the pre-split inside
+    # build_seg_pack) and near-degenerate segments
+    span = rng.uniform(0.01, 600.0, (n, 1)).astype(np.float32)
+    ang = rng.uniform(0, 2 * np.pi, (n, 1))
+    b = (a + span * np.concatenate(
+        [np.cos(ang), np.sin(ang)], 1)).astype(np.float32)
+    seg_len = np.linalg.norm(b - a, axis=1).astype(np.float32)
+    sp = build_seg_pack(a, b, np.arange(n, dtype=np.int32),
+                        np.zeros(n, np.float32), seg_len)
+    # points: near segments, at endpoints (d=0 ties), at the radius
+    # boundary, and far away (the clamp regime)
+    pts = np.concatenate([
+        a[:80] + rng.uniform(-60, 60, (80, 2)).astype(np.float32),
+        a[:40],
+        rng.uniform(-5000, 8000, (40, 2)).astype(np.float32),
+    ]).astype(np.float32)
+
+    pack, feat, sub = sp.pack, sp.feat, sp.sub
+    edges = pack[dc.SP_EDGE].view(np.int32)
+    nsub = sub.shape[1] // 4
+    subw = pack.shape[1] // (sub.shape[0] * nsub)
+    mx = np.float32(radius * 1.001 + 0.5)
+    bf = ml_dtypes.bfloat16
+    # exact segment distances (f64) for the conservativeness reference
+    a64 = np.stack([pack[dc.SP_AX], pack[dc.SP_AY]], 1).astype(np.float64)
+    b64 = np.stack([pack[dc.SP_BX], pack[dc.SP_BY]], 1).astype(np.float64)
+    d64 = b64 - a64
+    denom = np.maximum((d64 * d64).sum(1), 1e-12)
+    checked = 0
+    for blk in range(sub.shape[0]):
+        for s in range(nsub):
+            quad = sub[blk, 4 * s:4 * s + 4]
+            if np.isnan(quad).any():
+                continue
+            cols = slice(blk * subw * nsub + s * subw,
+                         blk * subw * nsub + (s + 1) * subw)
+            fcols = feat[:, cols]
+            cx, cy = fcols[dc.SF_CX, 0], fcols[dc.SF_CY, 0]
+            exm = (quad[2] - quad[0]) * np.float32(0.5) + mx
+            eym = (quad[3] - quad[1]) * np.float32(0.5) + mx
+            qx = np.clip(pts[:, 0] - cx, -exm, exm).astype(np.float32)
+            qy = np.clip(pts[:, 1] - cy, -eym, eym).astype(np.float32)
+            pf = np.stack([qx * qx, qy * qy, qx * qy, qx, qy,
+                           np.ones_like(qx), np.zeros_like(qx),
+                           np.zeros_like(qx)], 1)           # [P, 8]
+            lhs = pf.astype(bf).astype(np.float32)
+            rhs = fcols.astype(bf).astype(np.float32)
+            coarse = lhs @ rhs                              # [P, subw]
+            scale = np.float32(max(exm, eym))
+            thr = (np.float32(radius * radius)
+                   + scale * scale * np.float32(dc._MXU_REL_MARGIN)
+                   + np.float32(dc._MXU_ABS_MARGIN))
+            # exact pair distances for this slice's real columns
+            real = edges[cols] >= 0
+            if not real.any():
+                continue
+            ai = a64[cols][real]
+            di = d64[cols][real]
+            den = denom[cols][real]
+            t = np.clip(((pts[:, None, :] - ai[None]) * di[None]).sum(-1)
+                        / den[None], 0.0, 1.0)
+            proj = ai[None] + t[..., None] * di[None]
+            dseg2 = ((pts[:, None, :] - proj) ** 2).sum(-1)  # [P, nreal]
+            in_radius = dseg2 <= radius * radius
+            if in_radius.any():
+                assert (coarse[:, :len(den)][in_radius] <= thr).all(), (
+                    blk, s)
+                checked += int(in_radius.sum())
+    assert checked > 300    # the fuzz actually exercised in-radius pairs
+
+
+def test_mxu_coarse_gate_actually_culls():
+    """The gate's OTHER edge: an always-admit defect (flipped
+    comparison, runaway threshold) would pass every parity and
+    conservativeness test — the coarse pass only ever ADDS exact work —
+    and ship as pure matmul overhead. Pin the skip case with a host
+    replica under bf16 rounding: points INSIDE a sparse slice's bbox
+    (so the r8 sub-bbox cull admits them) but far from its actual lines
+    must score above the slice threshold, i.e. the matmul gate would
+    skip the slice."""
+    import ml_dtypes
+
+    from reporter_tpu.ops import dense_candidates as dc
+
+    radius = 50.0
+    # parallel diagonals: their joint bbox is the whole square, but the
+    # lower-right corner is hundreds of meters from every line — the
+    # bbox-inflated sparse-slice shape the matmul pass exists to cull
+    n = 4
+    a = np.stack([np.arange(n) * 12.0, np.zeros(n)], 1).astype(np.float32)
+    b = (a + np.float32(400.0)).astype(np.float32)
+    seg_len = np.linalg.norm(b - a, axis=1).astype(np.float32)
+    sp = build_seg_pack(a, b, np.arange(n, dtype=np.int32),
+                        np.zeros(n, np.float32), seg_len,
+                        split_len=0.0)         # keep ONE slice of lines
+    quad = sp.sub[0, 0:4]
+    assert not np.isnan(quad).any()
+    feat = sp.feat[:, :dc._SUB]
+    mx = np.float32(radius * 1.001 + 0.5)
+    exm = (quad[2] - quad[0]) * np.float32(0.5) + mx
+    eym = (quad[3] - quad[1]) * np.float32(0.5) + mx
+    scale = np.float32(max(exm, eym))
+    thr = (np.float32(radius * radius)
+           + scale * scale * np.float32(dc._MXU_REL_MARGIN)
+           + np.float32(dc._MXU_ABS_MARGIN))
+    # in-bbox points far from the diagonals (>= ~240 m to every line,
+    # well past the margin-widened threshold radius)
+    pts = np.array([[380.0, 20.0], [410.0, 40.0], [350.0, 5.0]],
+                   np.float32)
+    bf = ml_dtypes.bfloat16
+    cx, cy = feat[dc.SF_CX, 0], feat[dc.SF_CY, 0]
+    qx = np.clip(pts[:, 0] - cx, -exm, exm).astype(np.float32)
+    qy = np.clip(pts[:, 1] - cy, -eym, eym).astype(np.float32)
+    pf = np.stack([qx * qx, qy * qy, qx * qy, qx, qy,
+                   np.ones_like(qx), np.zeros_like(qx),
+                   np.zeros_like(qx)], 1)
+    coarse = pf.astype(bf).astype(np.float32) @ feat.astype(bf).astype(
+        np.float32)
+    # min over the chunk's points × the slice's columns is the kernel's
+    # gate operand: it must EXCEED the threshold → the slice is skipped
+    assert coarse.min() > thr, (float(coarse.min()), float(thr))
+
+
+def test_mxu_interpret_parity_split_tile(monkeypatch):
+    """MXU arm on a tile with >256 m edges (the long-segment pre-split):
+    collinear sub-span seams + endpoint-pinned ties must survive the
+    matmul coarse pass bit-identically — one interpret call, jnp
+    reference (the satellite's fourth adversarial regime; the other
+    three ride the shared-fixture calls in the main parity test)."""
+    import jax.numpy as jnp
+
+    import reporter_tpu.ops.dense_candidates as dc
+    from reporter_tpu.geometry import xy_to_lonlat
+    from reporter_tpu.netgen.network import RoadNetwork, Way
+
+    monkeypatch.setattr(dc, "_INTERPRET", True)
+    monkeypatch.setattr(dc, "_SBLK", 128)
+    monkeypatch.setattr(dc, "_SUB", 64)
+    monkeypatch.setattr(dc, "_NJ_CAP", 8)       # cond lifted: one trace
+
+    xy = np.array([[-1000.0, 0.0], [1000.0, 0.0], [1000.0, 150.0],
+                   [-1000.0, -150.0], [0.0, 140.0]])
+    ll = xy_to_lonlat(xy, np.array([-122.3, 37.8]))
+    net = RoadNetwork(node_lonlat=ll, ways=[
+        Way(way_id=1, nodes=[0, 1], speed_mps=29.0),      # 2 km edge
+        Way(way_id=2, nodes=[1, 2]),
+        Way(way_id=3, nodes=[0, 3]),
+        Way(way_id=4, nodes=[4, 1]),
+    ])
+    lts = compile_network(net, CompilerParams(reach_radius=400.0))
+    assert float(lts.seg_len.max()) > 1000.0
+    sp = build_seg_pack(lts.seg_a, lts.seg_b, lts.seg_edge, lts.seg_off,
+                        lts.seg_len, block=128)
+    packs = (jnp.asarray(sp.pack), jnp.asarray(sp.bbox),
+             jnp.asarray(sp.sub), jnp.asarray(sp.feat))
+    rng = np.random.default_rng(2)
+    pts = np.vstack([
+        rng.uniform([-1100, -250], [1100, 250], (90, 2)),
+        lts.node_xy[[0, 1]],                   # exactly at the junctions
+        np.stack([np.linspace(-950, 950, 4), np.full(4, 50.0)], 1),
+    ]).astype(np.float32)
+    ref = dc._dense_jnp(jnp.asarray(pts), (packs[0], None), 50.0, 8)
+    c = dc.find_candidates_dense(jnp.asarray(pts), packs, 50.0, 8,
+                                 mxu=True)
+    assert (np.asarray(c.edge) == np.asarray(ref[0])).all()
+    assert np.allclose(np.asarray(c.dist), np.asarray(ref[2]),
+                       rtol=1e-5, atol=1e-2)
+    assert np.allclose(np.asarray(c.offset), np.asarray(ref[1]),
                        rtol=1e-5, atol=1e-2)

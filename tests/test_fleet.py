@@ -431,6 +431,59 @@ class TestBitIdentity:
             m._submit_many([trace])
 
 
+class TestStagedLayoutVersion:
+    """Round-13 stale-capture guard: staged-table dicts are version-
+    tagged by host_tables/device_tables, and BOTH staging seams that
+    accept a pre-built dict (staged_tables injection, restage_tables —
+    the fleet promotion path) refuse a dict from another layout version
+    instead of shipping an incomplete layout to the kernel."""
+
+    def test_host_and_device_tables_carry_the_tag(self, metros):
+        from reporter_tpu.tiles.tileset import STAGED_LAYOUT_VERSION
+
+        for backend in ("dense", "grid", "both"):
+            host = metros[0].host_tables(backend)
+            assert int(host["staged_layout"]) == STAGED_LAYOUT_VERSION
+        dev = metros[0].device_tables("grid")
+        assert int(dev["staged_layout"]) == STAGED_LAYOUT_VERSION
+
+    def test_untagged_dict_fails_on_restage(self, metros):
+        import jax
+
+        m = SegmentMatcher(metros[0], CFG)
+        stale = dict(metros[0].host_tables("auto"))
+        stale.pop("staged_layout")          # a pre-r13 pinned dict
+        m.unstage_tables()
+        with pytest.raises(ValueError, match="staged_layout"):
+            m.restage_tables(jax.device_put(stale))
+        # and the matcher stays loudly unstaged, not half-staged
+        assert not m.tables_staged
+        m.restage_tables(jax.device_put(metros[0].host_tables("auto")))
+        assert m.tables_staged
+
+    def test_wrong_version_and_missing_member_fail(self, metros):
+        import numpy as np
+
+        from reporter_tpu.tiles.tileset import check_staged_layout
+
+        good = metros[0].host_tables("dense")
+        old = dict(good, staged_layout=np.int32(1))
+        with pytest.raises(ValueError, match="layout v1"):
+            check_staged_layout(old)
+        # fresh tag but a hand-assembled dict missing a dense member
+        torn = dict(good)
+        torn.pop("seg_feat")
+        with pytest.raises(ValueError, match="seg_feat"):
+            check_staged_layout(torn)
+        check_staged_layout(good)           # the real builder passes
+
+    def test_untagged_injection_fails_at_construction(self, metros):
+        stale = dict(metros[0].host_tables("auto"))
+        stale.pop("staged_layout")
+        with pytest.raises(ValueError, match="staged_layout"):
+            SegmentMatcher(metros[0], CFG, staged_tables=stale)
+
+
 class TestPromoteWatchdog:
     """promote_timeout_s: the page-in device_put is a device interaction
     on the serving path, and the tunnel dies by HANGING — unbounded, one

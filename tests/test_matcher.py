@@ -563,6 +563,29 @@ class TestSweepEnvOverrides:
             Config(matcher=MatcherParams(sweep_lowp="bf16",
                                          sweep_subcull=False)).validate()
 
+    def test_mxu_lever_parsing_and_combo_validation(self, monkeypatch):
+        """RTPU_SWEEP_MXU (round 13): same strict-parse discipline, and
+        the matmul coarse pass rides the sub-slice structure — mxu
+        without subcull must raise at every validation seam."""
+        from reporter_tpu.config import MatcherParams
+
+        assert MatcherParams().sweep_mxu is False       # off pending chip
+        monkeypatch.setenv("RTPU_SWEEP_MXU", "1")
+        assert MatcherParams().with_env_overrides().sweep_mxu is True
+        monkeypatch.setenv("RTPU_SWEEP_MXU", "no")
+        assert MatcherParams().with_env_overrides().sweep_mxu is False
+        monkeypatch.setenv("RTPU_SWEEP_MXU", "maybe")
+        with pytest.raises(ValueError, match="RTPU_SWEEP_MXU"):
+            MatcherParams().with_env_overrides()
+        monkeypatch.setenv("RTPU_SWEEP_MXU", "1")
+        monkeypatch.setenv("RTPU_SWEEP_SUBCULL", "0")
+        with pytest.raises(ValueError, match="sweep_subcull"):
+            MatcherParams().with_env_overrides()
+        monkeypatch.delenv("RTPU_SWEEP_SUBCULL")
+        with pytest.raises(ValueError, match="sweep_subcull"):
+            Config(matcher=MatcherParams(sweep_mxu=True,
+                                         sweep_subcull=False)).validate()
+
     def test_matcher_mirrors_override_into_config(self, tiny_tiles,
                                                   monkeypatch):
         monkeypatch.setenv("RTPU_SWEEP_SUBCULL", "0")
