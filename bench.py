@@ -1547,6 +1547,9 @@ def _sweep_roofline(m, pts: "np.ndarray", per_dispatch_s: float) -> dict:
 
     from reporter_tpu.ops import dense_candidates as dc
 
+    # lint: allow[staged-layout] 2026-08-04 roofline calibration READS the
+    # culling tables (bbox/sub/feat) only; it stages nothing — seg_pack
+    # geometry is swept on device, not consulted host-side here
     if "seg_bbox" not in m._tables:
         return {"note": "grid backend staged — no dense sweep to calibrate"}
     bbox = np.asarray(m._tables["seg_bbox"])           # [nblocks, 4]
@@ -2585,7 +2588,9 @@ def main() -> None:
     # REPORTER_BENCH_FORCE_CPU=1 exercises the tunnel-outage fallback
     # path on demand (it must emit a well-formed JSON line at round end
     # even when the device probe fails)
-    forced_cpu = os.environ.get("REPORTER_BENCH_FORCE_CPU") == "1"
+    from reporter_tpu.utils.tracing import env_flag
+
+    forced_cpu = env_flag(os.environ.get("REPORTER_BENCH_FORCE_CPU"))
     tpu_ok = not forced_cpu and _tpu_reachable()
     split["device_probe_s"] = round(time.perf_counter() - t0, 1)
     if not tpu_ok:
@@ -3160,8 +3165,8 @@ def main() -> None:
     # degraded (tiny fleet, CPU grid path) — REPORTER_BENCH_CHAOS=1 on a
     # fallback run exercises kill/recover + outage end to end without a
     # chip, writing to BENCH_DETAIL_CPU.json as usual
-    if (manual or not tpu_ok) and os.environ.get(
-            "REPORTER_BENCH_CHAOS") == "1":
+    if (manual or not tpu_ok) and env_flag(
+            os.environ.get("REPORTER_BENCH_CHAOS")):
         _run_chaos_legs(ts, traces, detail, split)
 
     # Latency attribution runs on EVERY composite (chip, manual,

@@ -1,0 +1,24 @@
+"""Static analysis + concurrency-contract enforcement (round 14).
+
+Two detectors, both CI-gated by tests/test_static_analysis.py:
+
+  lint_rules.py          AST lints encoding the repo's written-but-
+                         unenforced contracts (CLAUDE.md): env-var
+                         truthiness through the ONE parser
+                         (tracing.env_flag), env reads documented in
+                         README's consolidated table, no blocking calls
+                         under locks, no forked wire bodies, staged-table
+                         member-set completeness, no uncapped
+                         pow2-of-len jit shapes, no dead imports.
+                         ``python -m reporter_tpu.analysis`` runs it.
+  concurrency_contract   the committed lockdep golden state: the
+                         allowed lock-order edge set and the
+                         blocking-call-under-lock allowlist, both
+                         extend-with-dated-justification only. The
+                         runtime half lives in utils/locks.py and is
+                         armed by tests/conftest.py.
+"""
+
+from reporter_tpu.analysis.lint_rules import Finding, run_lint
+
+__all__ = ["Finding", "run_lint"]

@@ -1,0 +1,7 @@
+"""``python -m reporter_tpu.analysis`` — run the repo lint gate from the
+command line (same rules + waiver semantics as the CI gate in
+tests/test_static_analysis.py). Exit 1 on any unwaived finding."""
+
+from reporter_tpu.analysis.lint_rules import main
+
+raise SystemExit(main())

@@ -1,0 +1,180 @@
+"""The committed lockdep golden state — extend with a DATED justification
+only; never delete an entry to silence a failure without understanding
+the ordering it pinned.
+
+``LOCK_ORDER_EDGES`` is the set of legal lock-class acquisition-order
+edges (A, B): "a thread may acquire B while holding A". The runtime
+(utils/locks.py, armed by tests/conftest.py) records every observed edge
+across the tier-1 concurrency suites; the per-test gate fails on
+
+  - any edge NOT in this set (a new nesting — either add it here with a
+    justification, or restructure the code so the nesting disappears);
+  - any edge that would close a CYCLE in the graph (potential deadlock —
+    never allowlist these; fix the order).
+
+``BLOCKING_ALLOW`` is the set of (lock class, blocking call) pairs that
+are deliberately held across a blocking call. The bar for an entry is
+high: the hold must be load-bearing for correctness (not convenience)
+and the blocking call bounded. Everything else is a bug — round 11's
+promotion ``device_put`` under the fleet lock and round 9's dead-letter
+replay POSTing under the spool lock both lived here until hand-found.
+
+How to read a failure: the gate prints the violation dicts — ``kind``
+(lock-order | blocking-under-lock), the offending ``edge`` or ``call``,
+the ``held`` stack, and ``site`` (file:line of the acquisition). For a
+lock-order violation, the fix is almost always to shrink the inner
+critical section or to snapshot state and release before calling out.
+"""
+
+from __future__ import annotations
+
+__all__ = ["LOCK_ORDER_EDGES", "BLOCKING_ALLOW", "validate"]
+
+LOCK_ORDER_EDGES: "dict[tuple[str, str], str]" = {
+    # ---- scheduler (service/scheduler.py) -------------------------------
+    ("scheduler.cv", "scheduler.stats"): "2026-08-04 batch close updates "
+        "deferral/hist counters while still deciding under the condvar; "
+        "stats is a leaf lock held for a dict write",
+    ("scheduler.cv", "metrics.registry"): "2026-08-04 admission/inflight "
+        "gauges published at the decision point under the condvar; the "
+        "registry lock is a leaf (O(1) dict write, never calls out)",
+    # ---- metrics as a leaf under component locks -------------------------
+    ("scheduler.stats", "metrics.registry"): "2026-08-04 padding stats + "
+        "occupancy gauge in one section (pad_traces); leaf write",
+    ("fleet.ledger", "metrics.registry"): "2026-08-04 residency "
+        "hit/miss/eviction counters and occupancy gauges publish at the "
+        "paging event under the ledger (O(1) per event by design, "
+        "round 11); leaf write",
+    ("matcher.fallback", "metrics.registry"): "2026-08-04 the oracle "
+        "fallback matcher counts its own traces while serialized on the "
+        "fallback lock; leaf write",
+    ("app.combine", "metrics.registry"): "2026-08-04 combine-mode leader "
+        "observes request metrics while holding the one-batch-in-flight "
+        "lock (legacy A/B path, kept by round-7 decision); leaf write",
+    # ---- legacy combine leader (service/app.py) --------------------------
+    ("app.combine", "app.pending"): "2026-08-04 the leader drains the "
+        "pending queue it owns; pending is a leaf list-swap lock",
+    ("app.combine", "app.stats"): "2026-08-04 leader bumps batch counters "
+        "after a drain round; leaf write",
+    ("app.combine", "cache.entries"): "2026-08-04 combine-mode "
+        "_process_validated merges/retains per-uuid tails under the "
+        "leader lock; cache is a leaf (TTL dict ops only)",
+    ("app.combine", "publisher.counters"): "2026-08-04 combine-mode "
+        "publish counts outcomes under the leader lock; leaf write",
+    ("app.combine", "faults.plan"): "2026-08-04 combine-mode publish "
+        "consults the active fault plan (a counter increment) under the "
+        "leader lock; leaf write",
+    ("app.combine", "faults.registry"): "2026-08-04 faults.active()'s "
+        "lazy one-shot env parse takes the registry lock on first "
+        "consultation, which can land under the combine leader; leaf",
+    ("app.combine", "tracer.dump"): "2026-08-04 combine-mode publish "
+        "failure can dead-letter and post-mortem under the leader lock "
+        "(legacy path); dump lock is only contended by other dumps",
+    ("app.combine", "publisher.spool"): "2026-08-04 combine-mode "
+        "dead-letter append under the leader lock (legacy path); the "
+        "spool append is a bounded local write",
+    ("app.combine", "watchdog.ledger"): "2026-08-04 combine-mode "
+        "dispatch checks the watchdog breaker (tripped/abandoned "
+        "bookkeeping) under the leader lock; the ledger lock is held "
+        "for nanoseconds by contract (utils/watchdog.py docstring)",
+    # ---- fleet router (fleet/router.py) ----------------------------------
+    ("fleet_router.app_build", "fleet_router.apps"): "2026-08-04 app() "
+        "re-checks and publishes the built app in the dict under the "
+        "per-metro build lock (double-checked construction); apps is a "
+        "leaf dict guard",
+    ("fleet_router.app_build", "fleet.ledger"): "2026-08-04 building a "
+        "metro's app promotes it through residency under the per-metro "
+        "build lock — the lock is PER METRO precisely so this nesting "
+        "stalls only that metro's first touch (round-11 decision)",
+    ("fleet_router.app_build", "metrics.registry"): "2026-08-04 "
+        "promotion under the build lock publishes paging gauges; leaf",
+    # ---- tracing ---------------------------------------------------------
+    ("tracer.dump", "tracer.tid"): "2026-08-04 dump() resolves thread ids "
+        "while holding the dump lock — tid got its OWN lock for exactly "
+        "this nesting (round 10); tid is a leaf",
+    # ---- streaming brokers ----------------------------------------------
+    ("broker.partitions", "faults.plan"): "2026-08-04 durable append "
+        "consults the broker fault site inside the partition lock so an "
+        "injected torn write lands exactly where a real one would; the "
+        "plan lock is a leaf counter",
+    # ---- publisher -------------------------------------------------------
+    ("publisher.spool", "publisher.counters"): "2026-08-04 replay "
+        "rewrites the spool prefix and reconciles pending/replayed "
+        "counts in one section; counters is a leaf",
+}
+
+BLOCKING_ALLOW: "dict[tuple[str, str], str]" = {
+    ("publisher.spool", "os.fsync"): "2026-08-04 dead-letter prefix "
+        "rewrite must exclude concurrent appends or a just-spooled batch "
+        "is lost in the os.replace; the spool is bounded and the POSTs "
+        "(the unbounded leg) run outside the lock (round-9 hardening)",
+    ("broker.partitions", "os.fsync"): "2026-08-04 durable broker "
+        "appends fsync under the partition lock so on-disk batch order "
+        "always matches offset order (round-6 discipline); per-append "
+        "fsync is the opted-in durability cost",
+    ("app.combine", "urllib.request.urlopen"): "2026-08-04 the legacy "
+        "combine leader holds its lock through the full publish round "
+        "trip BY DESIGN (round-7 A/B baseline: 'the leader holds the "
+        "lock through the full link round-trip'); the scheduler path "
+        "exists because of this — do not extend this entry to new code",
+    ("app.combine", "time.sleep"): "2026-08-04 same combine-leader "
+        "design: publish retry backoff sleeps ride the leader lock in "
+        "the legacy path only",
+    ("app.combine", "jax.block_until_ready"): "2026-08-04 same "
+        "combine-leader design: the device dispatch rides the leader "
+        "lock; the r7 scheduler is the fix, combine is the kept A/B arm",
+    ("app.combine", "jax.device_put"): "2026-08-04 same combine-leader "
+        "design: jnp.asarray of the submit slice device_puts under the "
+        "leader lock in the legacy path only",
+    ("fleet_router.app_build", "jax.device_put"): "2026-08-04 the "
+        "per-metro build lock holds through the metro's first promotion "
+        "BY DESIGN (round 11: replaced a global lock so one cold "
+        "metro's multi-second page-in stalls only its own traffic); the "
+        "transfer is bounded by FleetConfig.promote_timeout_s when armed",
+    ("fleet_router.app_build", "jax.block_until_ready"): "2026-08-04 "
+        "same per-metro first-promotion design (residency.py "
+        "_device_put_guarded's local-dispatch bound)",
+    ("fleet_router.app_build", "wait:fleet.ledger"): "2026-08-04 a "
+        "first-touch app build can park on the fleet condvar (another "
+        "thread mid-promotion of the same metro, or a capacity wait) "
+        "while holding the per-metro build lock — the same round-11 "
+        "design as the device_put hold above: only THIS metro's "
+        "traffic waits, and the wait is bounded by promote_wait_s",
+}
+
+
+def validate() -> None:
+    """Golden-state self-checks (test-asserted): the edge set must be
+    acyclic (a cyclic golden graph would bless a deadlock) and every
+    entry must carry a dated justification."""
+    import re
+
+    dated = re.compile(r"20\d\d-\d\d-\d\d")
+    for table in (LOCK_ORDER_EDGES, BLOCKING_ALLOW):
+        for key, why in table.items():
+            if not dated.search(why or ""):
+                raise AssertionError(
+                    f"{key}: justification must carry a date: {why!r}")
+    # cycle check over the golden edges
+    adj: "dict[str, list[str]]" = {}
+    for (a, b) in LOCK_ORDER_EDGES:
+        adj.setdefault(a, []).append(b)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in set(adj) | {b for v in adj.values()
+                                           for b in v}}
+
+    def dfs(n: str, path: "list[str]") -> None:
+        color[n] = GRAY
+        for m in adj.get(n, ()):
+            if color[m] == GRAY:
+                raise AssertionError(
+                    f"golden lock-order graph has a cycle through "
+                    f"{path + [n, m]} — a committed deadlock; fix the "
+                    "order instead of extending the graph")
+            if color[m] == WHITE:
+                dfs(m, path + [n])
+        color[n] = BLACK
+
+    for n in list(color):
+        if color[n] == WHITE:
+            dfs(n, [])

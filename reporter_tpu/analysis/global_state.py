@@ -1,0 +1,60 @@
+"""Process-global state leak detection for the test harness.
+
+Round 10's post-review log has the canonical bug: a bench leg enabled
+the process-global tracer and an exception skipped the restore, so every
+LATER leg ran traced and the overhead A/B measured traced-vs-traced.
+Tests have the same failure mode — the tracer ring, the installed fault
+plan, and the RTPU_*/REPORTER_* environment are process-global, and a
+test that mutates one without restoring poisons every test after it.
+
+``snapshot()`` captures the restorable global surface; ``diff()``
+renders the human-readable delta. tests/conftest.py snapshots around
+EVERY test (autouse) and fails the test that leaked — attribution at the
+leak site, not three suites later.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["snapshot", "diff"]
+
+_ENV_PREFIXES = ("RTPU_", "REPORTER_", "DATASTORE_")
+
+
+def snapshot() -> dict:
+    from reporter_tpu import faults
+    from reporter_tpu.utils import tracing
+
+    tr = tracing.tracer()
+    return {
+        "tracer.enabled": tr.enabled,
+        "tracer.dump_dir": tr.dump_dir,
+        "tracer.capacity": tr.capacity,
+        "tracer.max_dumps": tr.max_dumps,
+        # identity, not equality: `with faults.use(plan)` restores the
+        # previous object; a leaked install leaves a different one
+        "faults.installed": faults._installed,
+        "env": {k: v for k, v in os.environ.items()
+                if k.startswith(_ENV_PREFIXES)},
+    }
+
+
+def diff(pre: dict, post: dict) -> "list[str]":
+    out = []
+    for key in ("tracer.enabled", "tracer.dump_dir", "tracer.capacity",
+                "tracer.max_dumps"):
+        if pre[key] != post[key]:
+            out.append(f"{key}: {pre[key]!r} -> {post[key]!r} "
+                       "(restore the process-global recorder — "
+                       "tracing.configure mutates a singleton)")
+    if pre["faults.installed"] is not post["faults.installed"]:
+        out.append("faults plan left installed "
+                   f"({post['faults.installed']!r}) — use "
+                   "`with faults.use(plan):` so the restore is scoped")
+    pe, qe = pre["env"], post["env"]
+    for k in sorted(set(pe) | set(qe)):
+        if pe.get(k) != qe.get(k):
+            out.append(f"os.environ[{k!r}]: {pe.get(k)!r} -> {qe.get(k)!r} "
+                       "(use monkeypatch.setenv / restore in finally)")
+    return out

@@ -1,0 +1,611 @@
+"""Repo-invariant AST lints — the contracts CLAUDE.md writes down but
+nothing enforced until round 14.
+
+Each rule is a pure function over one parsed module (or, for the
+cross-file rules, over the whole target set) returning ``Finding``s.
+A finding is WAIVED by a comment on its line or the line above:
+
+    # lint: allow[rule-id] 2026-08-04 why this one site is legal
+
+The CI gate (tests/test_static_analysis.py) requires zero UNWAIVED
+findings over ``reporter_tpu/`` + ``bench.py``, and requires every
+waiver to carry a non-empty justification — an empty ``allow[...]`` is
+itself a finding. Rules:
+
+  env-flag        RTPU_*/REPORTER_* boolean env values must be parsed by
+                  ``tracing.env_flag`` (strict=True where a typo must
+                  raise) — ad-hoc ``== "1"`` / ``.lower() in (...)`` /
+                  bare-truthiness parses are the r10 drift bug class
+                  (config.py and tracing accepted different sets;
+                  REPORTER_TPU_NO_NATIVE=0 DISABLED native).
+  env-table       every RTPU_*/REPORTER_* env read must have a row in
+                  README's consolidated env table, and every table row
+                  must correspond to a real read (drift both ways).
+  lock-blocking   no known-blocking call (sleep, urlopen, fsync,
+                  subprocess, device_put, block_until_ready, foreign
+                  ``.wait``) lexically inside a ``with <lock>:`` body.
+                  The runtime twin (utils/locks.py) catches the
+                  non-lexical cases; this catches them at review time.
+  wire-fork       ``wire_from_*`` bodies are defined ONLY in
+                  ops/match.py (don't fork the wire programs), and
+                  ``shard_map`` targets are never jit-wrapped inside the
+                  shard_map call (jit goes outside).
+  staged-layout   a module that references ANY dense staged-table member
+                  (tiles/tileset._DENSE_LAYOUT_KEYS) must reference ALL
+                  of them — "seg_feat stages everywhere seg_sub rides"
+                  (round 13) as a checked invariant, auto-extending when
+                  the layout version grows.
+  jit-shape-len   next-power-of-2 shape derivations (``1 << x.bit_length()``
+                  / ``2 ** ceil(log2 ...)``) without a visible cap/rung
+                  clamp — the r12 per-shape-trace lesson (each new cap
+                  dropped ~150 ms of jit trace into a measured wave).
+  dead-import     unused imports (pyflakes-equivalent; none installed in
+                  this image, so the check is implemented here).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+__all__ = ["Finding", "run_lint", "lint_source", "iter_targets",
+           "RULES", "REPO_ROOT"]
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(_PKG_DIR)
+
+_ENV_NAME = re.compile(r"^(RTPU|REPORTER)_[A-Z0-9_]+$")
+_README_TOKEN = re.compile(r"`((?:RTPU|REPORTER)_[A-Z0-9_]+)`")
+_WAIVE = re.compile(r"lint:\s*allow\[([a-z0-9-]+)\]\s*(.*)")
+
+# boolean-ish literal sets an ad-hoc env truthiness parse compares with
+_TRUTHY_TOKENS = {"1", "0", "true", "false", "on", "off", "yes", "no", ""}
+
+# call names that block (must never run while a lock is held); dotted
+# suffixes are matched against the call's rendered qualname
+_BLOCKING_SUFFIXES = (
+    "time.sleep", "os.fsync", "subprocess.run", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "urllib.request.urlopen", "request.urlopen",
+    "socket.create_connection",
+    "jax.device_put", "jax.block_until_ready",
+)
+_BLOCKING_ATTRS = {"sleep", "urlopen", "fsync", "device_put",
+                   "block_until_ready", "create_connection"}
+
+_LOCKISH = re.compile(r"lock|_cv\b|\bcv\b|cond", re.IGNORECASE)
+# with-targets that merely LOOK lockish but aren't locks
+_LOCKISH_NOT = re.compile(r"stage|span|tracer|use\(|open\(")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    message: str
+    waived: bool = False
+    justification: str = ""
+
+    def __str__(self) -> str:
+        tag = " (waived: %s)" % self.justification if self.waived else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+@dataclass
+class _Module:
+    path: str                     # repo-relative
+    source: str
+    tree: ast.AST
+    lines: "list[str]" = field(default_factory=list)
+
+    def seg(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.source, node) or ""
+
+
+def _apply_waivers(mod: _Module, findings: "list[Finding]") -> None:
+    """Waiver = ``lint: allow[rule]`` on the finding line, or anywhere in
+    the contiguous comment block directly above it (multi-line dated
+    justifications are the norm)."""
+    for f in findings:
+        candidates = []
+        if 1 <= f.line <= len(mod.lines):
+            candidates.append(mod.lines[f.line - 1])
+        ln = f.line - 1
+        while ln >= 1 and mod.lines[ln - 1].lstrip().startswith("#"):
+            candidates.append(mod.lines[ln - 1])
+            ln -= 1
+        for text in candidates:
+            m = _WAIVE.search(text)
+            if m and m.group(1) == f.rule:
+                f.waived = True
+                f.justification = m.group(2).strip()
+                if not f.justification:
+                    # an unexplained waiver is itself a finding
+                    f.waived = False
+                    f.message += (" (waiver present but carries no "
+                                  "justification)")
+                break
+    return None
+
+
+# ---------------------------------------------------------------------------
+# env helpers
+
+def _env_read_name(node: ast.AST) -> "str | None":
+    """Env var name when ``node`` is an env read — ``X.get("NAME"[, d])``
+    or ``X["NAME"]`` where X smells like an environ mapping — possibly
+    wrapped in chained str methods (``.strip().lower()``)."""
+    # unwrap chained method calls on the read result
+    while isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in ("strip", "lower", "upper", "casefold"):
+            node = node.func.value
+            continue
+        break
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "get" and node.args:
+        key = node.args[0]
+        holder = node.func.value
+    elif isinstance(node, ast.Subscript):
+        key = node.slice
+        holder = node.value
+    else:
+        return None
+    if not (isinstance(key, ast.Constant) and isinstance(key.value, str)
+            and _ENV_NAME.match(key.value)):
+        return None
+    h = ast.unparse(holder)
+    if "environ" in h or h in ("e", "env", "_e"):
+        return key.value
+    return None
+
+
+def _env_reads(mod: _Module) -> "list[tuple[str, int]]":
+    """(name, line) for every env read + env-name constant declaration
+    (``_ENV_VAR = "RTPU_FAULTS"`` counts: the read goes through the
+    constant)."""
+    out = []
+    for node in ast.walk(mod.tree):
+        n = _env_read_name(node)
+        if n is not None:
+            out.append((n, node.lineno))
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and isinstance(node.left, ast.Constant) \
+                and isinstance(node.left.value, str) \
+                and _ENV_NAME.match(node.left.value):
+            out.append((node.left.value, node.lineno))
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                         ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and _ENV_NAME.match(node.value.value):
+            out.append((node.value.value, node.lineno))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: env-flag
+
+def _rule_env_flag(mod: _Module) -> "list[Finding]":
+    out: "list[Finding]" = []
+
+    def flag(node, name, how):
+        out.append(Finding(
+            "env-flag", mod.path, node.lineno,
+            f"{name} parsed by {how} — boolean env values go through "
+            "tracing.env_flag (strict=True where a typo must raise), "
+            "the ONE truthiness parser"))
+
+    # env names also read in a clearly NON-boolean way in this module
+    # (int()/float() coercion, plain subscript value use): a bare
+    # truthiness test on those is a presence gate ("is it set"), not a
+    # boolean parse — multihost's `env.get("…_NUM_PROCESSES")` guard
+    # before `int(env["…"])` must not be flagged.
+    bare_atoms: "set[int]" = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            for t in _test_atoms(node.test):
+                bare_atoms.add(id(t))
+    value_read: "set[str]" = set()
+    for node in ast.walk(mod.tree):
+        n = _env_read_name(node)
+        if n is not None and id(node) not in bare_atoms:
+            value_read.add(n)
+
+    for node in ast.walk(mod.tree):
+        # (a): comparison of a (possibly str-method-chained) env read
+        # with truthy literal tokens
+        if isinstance(node, ast.Compare):
+            name = _env_read_name(node.left)
+            if name is None:
+                continue
+            for comp in node.comparators:
+                toks = _literal_strings(comp)
+                if toks is not None and toks <= _TRUTHY_TOKENS:
+                    flag(node, name, "an ad-hoc literal comparison")
+                    break
+        # (c): env read used directly as a boolean test, with no other
+        # value-read of the same name in the module (presence gates pass)
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            for t in _test_atoms(node.test):
+                name = _env_read_name(t)
+                if name is not None and name not in value_read:
+                    flag(t, name, "bare string truthiness")
+    # (b) taint pass: x = <env read>[.strip().lower()]; if x in ("1", …)
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tainted: "dict[str, str]" = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                n = _env_read_name(node.value)
+                if n is not None:
+                    tainted[node.targets[0].id] = n
+            elif isinstance(node, ast.Compare) \
+                    and isinstance(node.left, ast.Name) \
+                    and node.left.id in tainted:
+                for comp in node.comparators:
+                    toks = _literal_strings(comp)
+                    if toks is not None and toks <= _TRUTHY_TOKENS:
+                        flag(node, tainted[node.left.id],
+                             "an ad-hoc literal comparison")
+                        break
+    return out
+
+
+def _literal_strings(node: ast.AST) -> "set[str] | None":
+    """The set of string constants when ``node`` is a string literal or a
+    tuple/list/set of them; None otherwise."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        vals = set()
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            vals.add(el.value)
+        return vals
+    return None
+
+
+def _test_atoms(test: ast.AST):
+    """The atomic truthiness operands of a test expression (BoolOp and
+    ``not`` unwrapped)."""
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            yield from _test_atoms(v)
+    elif isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        yield from _test_atoms(test.operand)
+    else:
+        yield test
+
+
+# ---------------------------------------------------------------------------
+# rule: lock-blocking
+
+def _rule_lock_blocking(mod: _Module) -> "list[Finding]":
+    out: "list[Finding]" = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lockish = []
+        for item in node.items:
+            txt = mod.seg(item.context_expr)
+            if _LOCKISH.search(txt) and not _LOCKISH_NOT.search(txt):
+                lockish.append(txt)
+        if not lockish:
+            continue
+        for body_stmt in node.body:
+            for call in ast.walk(body_stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                qn = ast.unparse(call.func) if not isinstance(
+                    call.func, ast.Lambda) else ""
+                blocked = (qn.endswith(_BLOCKING_SUFFIXES)
+                           or qn.split(".")[-1] in _BLOCKING_ATTRS)
+                if not blocked \
+                        and (qn.endswith(".wait")
+                             or qn.endswith(".wait_for")) \
+                        and not any(qn[:qn.rfind(".")] == lk
+                                    for lk in lockish):
+                    # foreign condvar/event wait (either spelling): the
+                    # with-target's own wait (``with self._cv:
+                    # self._cv.wait()``) is the condvar idiom and exempt
+                    blocked = True
+                if blocked:
+                    out.append(Finding(
+                        "lock-blocking", mod.path, call.lineno,
+                        f"blocking call {qn}() inside `with "
+                        f"{lockish[0]}:` — move it outside the lock or "
+                        "waive with a dated justification"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: wire-fork
+
+def _rule_wire_fork(mod: _Module) -> "list[Finding]":
+    out: "list[Finding]" = []
+    is_match_py = mod.path.replace(os.sep, "/").endswith(
+        "reporter_tpu/ops/match.py")
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.startswith("wire_from_") and not is_match_py:
+            out.append(Finding(
+                "wire-fork", mod.path, node.lineno,
+                f"wire body {node.name}() defined outside ops/match.py — "
+                "the mesh product path shard_maps the ONE set of "
+                "undecorated wire programs; don't fork them"))
+        elif isinstance(node, ast.Call):
+            qn = ast.unparse(node.func) if not isinstance(node.func,
+                                                          ast.Lambda) else ""
+            if qn.split(".")[-1] == "shard_map" and node.args:
+                tgt = node.args[0]
+                if isinstance(tgt, ast.Call):
+                    tq = ast.unparse(tgt.func)
+                    if tq.split(".")[-1] == "jit":
+                        out.append(Finding(
+                            "wire-fork", mod.path, node.lineno,
+                            "jit-wrapped function passed to shard_map — "
+                            "jit goes OUTSIDE shard_map "
+                            "(jax.jit(shard_map(wire_from_*)))"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: staged-layout
+
+def _dense_layout_keys() -> "tuple[str, ...]":
+    from reporter_tpu.tiles.tileset import _DENSE_LAYOUT_KEYS
+
+    return _DENSE_LAYOUT_KEYS
+
+
+def _rule_staged_layout(mod: _Module) -> "list[Finding]":
+    keys = set(_dense_layout_keys())
+    seen: "dict[str, int]" = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value in keys and node.value not in seen:
+            seen[node.value] = node.lineno
+    if not seen or set(seen) == keys:
+        return []
+    missing = sorted(keys - set(seen))
+    line = min(seen.values())
+    return [Finding(
+        "staged-layout", mod.path, line,
+        f"references staged dense members {sorted(seen)} but not "
+        f"{missing} — every member of tiles/tileset._DENSE_LAYOUT_KEYS "
+        "stages together (seg_feat rides everywhere seg_sub rides, "
+        "round 13); handle the missing members or bump the layout "
+        "contract")]
+
+
+# ---------------------------------------------------------------------------
+# rule: jit-shape-len
+
+def _rule_jit_shape_len(mod: _Module) -> "list[Finding]":
+    out: "list[Finding]" = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.BinOp):
+            continue
+        src = mod.seg(node)
+        pow2 = (isinstance(node.op, ast.LShift)
+                and isinstance(node.left, ast.Constant)
+                and node.left.value == 1 and "bit_length" in src) or \
+               (isinstance(node.op, ast.Pow)
+                and isinstance(node.left, ast.Constant)
+                and node.left.value == 2 and "log2" in src)
+        if not pow2:
+            continue
+        # a visible clamp (min(..., CAP) / a rung table lookup) on the
+        # same source line absolves it: the executable population stays
+        # a small fixed set instead of growing with the data. The LINE,
+        # not the BinOp segment — the clamp wraps the pow2 expression.
+        parent = node
+        line_src = (mod.lines[node.lineno - 1]
+                    if 1 <= node.lineno <= len(mod.lines) else src)
+        if "min(" in line_src or re.search(r"\b_?[A-Z][A-Z0-9_]*CAP\b",
+                                           line_src):
+            continue
+        out.append(Finding(
+            "jit-shape-len", mod.path, parent.lineno,
+            "next-pow2 shape derivation without a visible cap — a "
+            "jit-fed shape that grows with the data re-traces per new "
+            "size (the r12 SpeedHistogram lesson: ~150 ms of trace cost "
+            "landing in whichever wave first hits a new cap); clamp to "
+            "a fixed rung set or waive with the reason the population "
+            "is bounded"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: dead-import
+
+def _rule_dead_import(mod: _Module) -> "list[Finding]":
+    out: "list[Finding]" = []
+    imports: "list[tuple[str, int, str]]" = []   # (bound name, line, shown)
+    import_lines = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                imports.append((bound, node.lineno, a.name))
+                import_lines.update(range(node.lineno,
+                                          (node.end_lineno or node.lineno)
+                                          + 1))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                bound = a.asname or a.name
+                imports.append((bound, node.lineno, a.name))
+                # the WHOLE statement (parenthesized multi-line
+                # from-imports are the dominant style here): a name on a
+                # continuation line must not count as its own use
+                import_lines.update(range(node.lineno,
+                                          (node.end_lineno or node.lineno)
+                                          + 1))
+    if not imports:
+        return out
+    # usage = word occurrence anywhere outside the import statement's own
+    # line(s). String annotations ("FaultPlan | None") and __all__ entries
+    # count as uses by construction — deliberately conservative: this
+    # rule must never flag a live import.
+    body = "\n".join(ln for i, ln in enumerate(mod.lines, 1)
+                     if i not in import_lines)
+    for bound, line, shown in imports:
+        if not re.search(rf"\b{re.escape(bound)}\b", body):
+            out.append(Finding(
+                "dead-import", mod.path, line,
+                f"import {shown!r} (bound as {bound!r}) is never used"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cross-file rule: env-table
+
+def _rule_env_table(mods: "list[_Module]",
+                    readme_path: str) -> "list[Finding]":
+    out: "list[Finding]" = []
+    reads: "dict[str, tuple[str, int]]" = {}
+    for mod in mods:
+        for name, line in _env_reads(mod):
+            reads.setdefault(name, (mod.path, line))
+    documented: "dict[str, int]" = {}
+    try:
+        with open(readme_path) as f:
+            readme = f.readlines()
+    except OSError:
+        return [Finding("env-table", "README.md", 1,
+                        "README.md not found — the consolidated env "
+                        "table is the documentation contract")]
+    for i, ln in enumerate(readme, 1):
+        if not ln.lstrip().startswith("|"):
+            continue
+        for tok in _README_TOKEN.findall(ln):
+            documented.setdefault(tok, i)
+    for name, (path, line) in sorted(reads.items()):
+        if name not in documented:
+            out.append(Finding(
+                "env-table", path, line,
+                f"env var {name} is read here but has no row in "
+                "README's consolidated env table"))
+    for name, line in sorted(documented.items()):
+        if name not in reads:
+            out.append(Finding(
+                "env-table", "README.md", line,
+                f"README env table documents {name} but nothing in the "
+                "lint targets reads it — dead row (or the read moved "
+                "outside reporter_tpu/ + bench.py)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+RULES = {
+    "env-flag": _rule_env_flag,
+    "lock-blocking": _rule_lock_blocking,
+    "wire-fork": _rule_wire_fork,
+    "staged-layout": _rule_staged_layout,
+    "jit-shape-len": _rule_jit_shape_len,
+    "dead-import": _rule_dead_import,
+}
+
+
+def iter_targets(root: str = REPO_ROOT) -> "list[str]":
+    """Lint scope: the package + the driver-facing scripts at repo root
+    (bench.py reads REPORTER_BENCH_*; the env table documents them)."""
+    out = []
+    pkg = os.path.join(root, "reporter_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    for extra in ("bench.py",):
+        p = os.path.join(root, extra)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def _load(path: str, root: str) -> "_Module | None":
+    with open(path) as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    rel = os.path.relpath(path, root)
+    return _Module(rel, source, tree, source.splitlines())
+
+
+def lint_source(source: str, path: str = "<synthetic>",
+                rules: "list[str] | None" = None) -> "list[Finding]":
+    """Lint one source string (the seeded-violation tests' entry)."""
+    mod = _Module(path, source, ast.parse(source), source.splitlines())
+    out: "list[Finding]" = []
+    for rid, fn in RULES.items():
+        if rules is not None and rid not in rules:
+            continue
+        out.extend(fn(mod))
+    out = _dedupe(out)
+    _apply_waivers(mod, out)
+    return out
+
+
+def _dedupe(findings: "list[Finding]") -> "list[Finding]":
+    seen = set()
+    out = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def run_lint(root: str = REPO_ROOT,
+             rules: "list[str] | None" = None) -> "list[Finding]":
+    mods = [m for m in (_load(p, root) for p in iter_targets(root))
+            if m is not None]
+    out: "list[Finding]" = []
+    for mod in mods:
+        per_mod: "list[Finding]" = []
+        for rid, fn in RULES.items():
+            if rules is not None and rid not in rules:
+                continue
+            per_mod.extend(fn(mod))
+        per_mod = _dedupe(per_mod)
+        _apply_waivers(mod, per_mod)
+        out.extend(per_mod)
+    if rules is None or "env-table" in rules:
+        table = _rule_env_table(mods, os.path.join(root, "README.md"))
+        by_path = {m.path: m for m in mods}
+        for f in table:
+            m = by_path.get(f.path)
+            if m is not None:
+                _apply_waivers(m, [f])
+        out.extend(table)
+    return out
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    findings = run_lint()
+    unwaived = [f for f in findings if not f.waived]
+    for f in findings:
+        print(f)
+    print(f"{len(findings)} finding(s), {len(unwaived)} unwaived")
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":          # pragma: no cover - CLI convenience
+    raise SystemExit(main())

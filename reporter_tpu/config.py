@@ -117,15 +117,18 @@ class MatcherParams:
         # in SegmentMatcher, and a typo'd lever that silently fell back to
         # its default would make an on-chip A/B measure an arm against
         # itself and record a bogus 1.0x
+        # tracing.env_flag is THE boolean parse (round-14 env-flag lint);
+        # strict=True keeps the round-8 fail-loudly contract for typos
+        from reporter_tpu.utils.tracing import env_flag
+
         if "RTPU_SWEEP_SUBCULL" in e:
-            raw = e["RTPU_SWEEP_SUBCULL"].strip().lower()
-            if raw in ("0", "false", "off", "no", ""):
-                kw["sweep_subcull"] = False
-            elif raw in ("1", "true", "on", "yes"):
-                kw["sweep_subcull"] = True
-            else:
+            try:
+                kw["sweep_subcull"] = env_flag(e["RTPU_SWEEP_SUBCULL"],
+                                               strict=True)
+            except ValueError:
                 raise ValueError(
-                    f"RTPU_SWEEP_SUBCULL={raw!r}: use 0/1")
+                    f"RTPU_SWEEP_SUBCULL={e['RTPU_SWEEP_SUBCULL']!r}: "
+                    "use 0/1") from None
         if "RTPU_SWEEP_LOWP" in e:
             lowp = e["RTPU_SWEEP_LOWP"] or "off"
             if lowp not in ("off", "bf16"):
@@ -133,14 +136,12 @@ class MatcherParams:
                     f"RTPU_SWEEP_LOWP={lowp!r}: use 'off' or 'bf16'")
             kw["sweep_lowp"] = lowp
         if "RTPU_SWEEP_MXU" in e:
-            raw = e["RTPU_SWEEP_MXU"].strip().lower()
-            if raw in ("0", "false", "off", "no", ""):
-                kw["sweep_mxu"] = False
-            elif raw in ("1", "true", "on", "yes"):
-                kw["sweep_mxu"] = True
-            else:
+            try:
+                kw["sweep_mxu"] = env_flag(e["RTPU_SWEEP_MXU"], strict=True)
+            except ValueError:
                 raise ValueError(
-                    f"RTPU_SWEEP_MXU={raw!r}: use 0/1")
+                    f"RTPU_SWEEP_MXU={e['RTPU_SWEEP_MXU']!r}: "
+                    "use 0/1") from None
         if "RTPU_DISPATCH_TIMEOUT_S" in e:
             t = float(e["RTPU_DISPATCH_TIMEOUT_S"])
             if t < 0:

@@ -54,9 +54,10 @@ from __future__ import annotations
 import os
 import random
 import re
-import threading
 import time
 from dataclasses import dataclass, field
+
+from reporter_tpu.utils import locks
 
 SITES = ("publish", "checkpoint", "broker", "dispatch", "fleet_promote")
 KINDS = ("fail", "crash", "hang", "torn")
@@ -99,7 +100,7 @@ class FaultPlan:
     seed: int = 0
 
     def __post_init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("faults.plan")
         self.calls = {s: 0 for s in SITES}
         self.fired = {s: 0 for s in SITES}
         # zlib.crc32, not hash(): string hashing is per-process
@@ -179,7 +180,7 @@ class FaultPlan:
 
 _ENV_VAR = "RTPU_FAULTS"
 _ENV_SEED = "RTPU_FAULT_SEED"
-_lock = threading.Lock()
+_lock = locks.named_lock("faults.registry")
 _installed: "FaultPlan | None" = None
 _env_plan: "FaultPlan | None | str" = "unset"   # lazy one-shot parse
 

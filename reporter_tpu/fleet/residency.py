@@ -46,11 +46,11 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
-import threading
 import time
 from dataclasses import dataclass
 from typing import Iterator, Sequence
 
+from reporter_tpu.utils import locks
 from reporter_tpu import faults
 from reporter_tpu.config import Config
 from reporter_tpu.utils import watchdog as watchdog_mod
@@ -225,12 +225,12 @@ class FleetResidency:
             raise ValueError(f"per-metro configs must keep "
                              f"matcher_backend='jax': {non_jax}")
         self.metrics = metrics or MetricsRegistry()
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("fleet.ledger")
         # one condvar (same underlying lock — wait() drops it) for both
         # wake events: a lease release (a capacity-blocked promotion may
         # now have an evictable victim) and a promotion finishing (other
         # touches of that metro were waiting for its tables)
-        self._cond = threading.Condition(self._lock)
+        self._cond = locks.named_condition("fleet.ledger", lock=self._lock)
         self._seq = 0
         self._resident_bytes = 0
         self._resident_count = 0

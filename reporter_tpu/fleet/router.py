@@ -24,10 +24,10 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import threading
 from dataclasses import dataclass
 from typing import Sequence
 
+from reporter_tpu.utils import locks
 from reporter_tpu.config import Config
 from reporter_tpu.fleet.residency import FleetConfig, FleetResidency
 from reporter_tpu.service.app import ReporterApp
@@ -104,13 +104,14 @@ class FleetRouter(MetroRouter):
             fleet=dataclasses.replace(fleet, pins=pins),
             configs=self._configs, metrics=self.metrics)
         self.apps: "dict[str, ReporterApp]" = {}
-        self._apps_lock = threading.Lock()      # guards the dict only
+        self._apps_lock = locks.named_lock("fleet_router.apps")  # guards the dict only
         # construction is serialized PER METRO: building an app promotes
         # the metro (staging build + device_put + possibly a lease
         # wait), and doing that under one global lock would stall every
         # OTHER metro's traffic — including pinned-SLO metros — behind
         # one cold metro's first touch
-        self._app_build_locks = {n: threading.Lock() for n in names}
+        self._app_build_locks = {
+            n: locks.named_lock("fleet_router.app_build") for n in names}
 
     # ---- app/matcher access ---------------------------------------------
 

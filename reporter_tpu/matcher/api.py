@@ -16,13 +16,13 @@ batch.
 
 from __future__ import annotations
 
-import threading
 from collections.abc import Sequence as _SequenceABC
 from dataclasses import dataclass
 from typing import Any, Sequence
 
 import numpy as np
 
+from reporter_tpu.utils import locks
 from reporter_tpu import faults
 from reporter_tpu.config import Config, MatcherParams
 from reporter_tpu.geometry import lonlat_to_xy
@@ -245,7 +245,7 @@ class SegmentMatcher:
         # both would let a single in-progress oracle batch block every
         # concurrent healthy dispatch at its breaker check until it
         # spuriously timed out too.
-        self._fallback_lock = threading.Lock()
+        self._fallback_lock = locks.named_lock("matcher.fallback")
         # circuit breaker: count of watchdog threads abandoned and still
         # stuck inside a dispatch. Each pins its wave's traces until the
         # wedge clears, so the count must be BOUNDED — past the cap the

@@ -32,10 +32,14 @@ _lib_cache: "list | None" = None
 
 
 def _env_disabled() -> bool:
-    if os.environ.get("REPORTER_TPU_NO_NATIVE"):
+    # THE truthiness parser (round-14 env-flag lint): the old ad-hoc
+    # parses here read REPORTER_TPU_NO_NATIVE=0 as "disable native" and
+    # RTPU_NATIVE_PREPARE=no as "enabled" — both drift from env_flag
+    from reporter_tpu.utils.tracing import env_flag
+
+    if env_flag(os.environ.get("REPORTER_TPU_NO_NATIVE")):
         return True
-    return os.environ.get("RTPU_NATIVE_PREPARE", "1").strip().lower() in (
-        "0", "off", "false")
+    return not env_flag(os.environ.get("RTPU_NATIVE_PREPARE", "1"))
 
 
 def _lib():

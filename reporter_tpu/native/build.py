@@ -26,9 +26,14 @@ _LIB_NAME = "_libreporter.so"
 # Sanitizer build flavors (SURVEY.md §5 "Race detection / sanitizers":
 # the reference's C++ deps ran ASan/TSan in upstream CI). Each flavor
 # compiles to its own .so; tests/test_native_sanitizers.py drives the
-# multithreaded walker and the reach builder under both.
+# multithreaded walker and the reach builder under both. The DEFAULT
+# flavor is warning-clean and enforced (-Wall -Wextra -Werror, round 14)
+# — a new warning fails the build and falls back to Python, which the
+# native-parity tests then surface loudly; the sanitizer flavors keep
+# their round-9 flags unchanged (their drivers already wedge-probe on
+# this box, and -Werror there would conflate toolchain noise with races).
 _SANITIZE_FLAGS = {
-    None: [],
+    None: ["-O3", "-Wall", "-Wextra", "-Werror"],
     "asan": ["-fsanitize=address,undefined", "-fno-omit-frame-pointer",
              "-g", "-O1"],
     "tsan": ["-fsanitize=thread", "-fno-omit-frame-pointer", "-g", "-O1"],
@@ -90,7 +95,7 @@ def build_native_lib(force: bool = False,
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
     tmpdir = tempfile.mkdtemp(prefix="tmpbuild_", dir=_SRC_DIR)
     tmp = os.path.join(tmpdir, _lib_name(sanitize))
-    cmd = ["g++", *( _SANITIZE_FLAGS[sanitize] or ["-O3"]), "-std=c++17",
+    cmd = ["g++", *_SANITIZE_FLAGS[sanitize], "-std=c++17",
            "-shared", "-fPIC", "-o", tmp, *srcs, "-lpthread"]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
@@ -121,7 +126,12 @@ def load_native_lib(sanitize: "str | None" = None) -> "ctypes.CDLL | None":
     process must have the matching sanitizer runtime preloaded
     (LD_PRELOAD=libasan.so/libtsan.so), so sanitized runs live in
     subprocesses (tests/test_native_sanitizers.py)."""
-    if os.environ.get("REPORTER_TPU_NO_NATIVE"):
+    # env_flag, not bare truthiness: REPORTER_TPU_NO_NATIVE=0 used to
+    # DISABLE native (any non-empty string read as "set") — exactly the
+    # drift class the round-14 env-flag lint exists to catch
+    from reporter_tpu.utils.tracing import env_flag
+
+    if env_flag(os.environ.get("REPORTER_TPU_NO_NATIVE")):
         return None
     lib_path = build_native_lib(sanitize=sanitize)
     if lib_path is None:

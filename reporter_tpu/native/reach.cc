@@ -35,7 +35,7 @@ struct Target {
 // Single-source bounded Dijkstra from node u; appends one Target per
 // out-edge of every reached node (u itself included at dist 0).
 void node_targets(int32_t u,
-                  const int32_t* node_out, int64_t num_nodes, int64_t deg,
+                  const int32_t* node_out, int64_t /*num_nodes*/, int64_t deg,
                   const int32_t* edge_dst, const float* edge_len,
                   double radius,
                   // scratch, epoch-stamped so no per-call clearing:

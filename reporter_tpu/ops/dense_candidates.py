@@ -87,7 +87,10 @@ _MXU_ABS_MARGIN = 0.5
 
 # interpret mode: run the kernel through the pallas interpreter on any
 # backend — slow, for debugging kernel logic without TPU access
-_INTERPRET = os.environ.get("RTPU_PALLAS_INTERPRET", "") == "1"
+# (env_flag = THE boolean env parse, round-14 env-flag lint)
+from reporter_tpu.utils.tracing import env_flag as _env_flag
+
+_INTERPRET = _env_flag(os.environ.get("RTPU_PALLAS_INTERPRET"))
 
 _P = 256          # points per chunk: halves the (chunks x blocks) launch
 #                   grid vs 128 — measured ~2/5/9% faster on sf/organic/xl

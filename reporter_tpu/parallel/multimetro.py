@@ -13,7 +13,6 @@ is psum'd over the "dp" axis (the ICI collective; SURVEY.md §2.3
 
 from __future__ import annotations
 
-import functools
 from typing import Any, NamedTuple, Sequence
 
 import numpy as np
@@ -231,6 +230,10 @@ def dispatch_traces(names: Sequence[str],
             by_metro[metro].append((j, lo, min(bucket, len(xy) - lo)))
 
     load = max((len(v) for v in by_metro.values()), default=1)
+    # lint: allow[jit-shape-len] 2026-08-04 the pow2 ladder IS the bound
+    # here: B takes log2(max load) distinct values per dp, and stack
+    # dispatch is the offline/test path (the serving face buckets via
+    # the scheduler's fixed _TRACE_RUNGS instead)
     B = dp * (1 << max(0, (load + dp - 1) // dp - 1).bit_length())
     M = len(names)
     points = np.zeros((M, B, bucket, 2), np.float32)

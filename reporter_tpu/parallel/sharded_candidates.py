@@ -34,7 +34,6 @@ from reporter_tpu.ops.candidates import CandidateSet
 from reporter_tpu.parallel.compat import shard_map
 from reporter_tpu.ops.dense_candidates import (
     _SBLK,
-    SegPack,
     _select_topk,
     build_seg_pack,
     find_candidates_dense,

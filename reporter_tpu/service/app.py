@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable
 
+from reporter_tpu.utils import locks
 from reporter_tpu.config import Config
 from reporter_tpu.matcher.api import DispatchTimeout, SegmentMatcher, Trace
 from reporter_tpu.service.cache import PartialTraceCache
@@ -146,10 +147,10 @@ class ReporterApp:
             transport=transport,
             **publisher_kwargs(svc, metrics=self.matcher.metrics))
         self.min_segment_length = svc.min_segment_length
-        self._lock = threading.Lock()     # combine mode: one batch in flight
+        self._lock = locks.named_lock("app.combine")  # combine mode: one batch in flight
         self._pending: list[_Submission] = []
-        self._pending_lock = threading.Lock()
-        self._stats_lock = threading.Lock()   # scheduler batches run
+        self._pending_lock = locks.named_lock("app.pending")
+        self._stats_lock = locks.named_lock("app.stats")  # scheduler batches run
         #                                       _process_validated concurrently
         self.stats = {"requests": 0, "traces": 0, "points": 0,
                       "reports": 0, "errors": 0, "match_seconds": 0.0,

@@ -10,10 +10,11 @@ accumulated.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import OrderedDict
-from dataclasses import dataclass, field
+from dataclasses import dataclass
+
+from reporter_tpu.utils import locks
 
 
 @dataclass
@@ -37,7 +38,7 @@ class PartialTraceCache:
         self.max_uuids = int(max_uuids)
         self.max_points = int(max_points)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("cache.entries")
         self._entries: OrderedDict[str, _Entry] = OrderedDict()
 
     def __len__(self) -> int:

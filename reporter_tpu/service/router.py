@@ -17,7 +17,6 @@ aggregated over metros.
 from __future__ import annotations
 
 import contextlib
-import json
 import logging
 from typing import Callable, Sequence
 

@@ -49,6 +49,7 @@ import time
 from collections import deque
 from typing import TYPE_CHECKING, Sequence
 
+from reporter_tpu.utils import locks
 from reporter_tpu.utils import tracing
 
 if TYPE_CHECKING:                            # pragma: no cover
@@ -121,14 +122,14 @@ class BatchScheduler:
         self.max_inflight = int(svc.max_inflight_batches)
         self.limit = int(svc.admission_queue_limit)
         self._clock = clock
-        self._cv = threading.Condition()
+        self._cv = locks.named_condition("scheduler.cv")
         self._queue: "deque[_ScheduledSubmission]" = deque()
         self._queued_traces = 0
         self._dispatch_serial = 0      # batch id for trace spans (under _cv)
         self._inflight = 0
         self._inflight_uuids: set[str] = set()
         self._closed = False
-        self._stats_lock = threading.Lock()
+        self._stats_lock = locks.named_lock("scheduler.stats")
         self.stats = {"batches": 0, "submissions": 0, "padded_traces": 0,
                       "deferred": 0, "rejected": 0, "isolated_retries": 0,
                       "max_inflight_seen": 0}

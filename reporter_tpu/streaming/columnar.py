@@ -30,12 +30,12 @@ this is the deployment shape for sustained firehose rates.
 from __future__ import annotations
 
 import bisect
-import threading
 import time
 from typing import Any, NamedTuple, Sequence
 
 import numpy as np
 
+from reporter_tpu.utils import locks
 from reporter_tpu.config import Config
 from reporter_tpu.geometry import lonlat_to_xy
 from reporter_tpu.matcher.api import (DispatchTimeout, MatchBatch,
@@ -166,7 +166,7 @@ class ColumnarIngestQueue:
             [] for _ in range(self.num_partitions)]
         self._end = [0] * self.num_partitions
         self._floor = [0] * self.num_partitions
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("broker.partitions")
 
     # ---- producer surface ----------------------------------------------
 

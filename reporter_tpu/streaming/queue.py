@@ -8,9 +8,10 @@ consumption — replay from any retained offset is the recovery mechanism.
 
 from __future__ import annotations
 
-import threading
 import zlib
 from typing import Any, Sequence
+
+from reporter_tpu.utils import locks
 
 
 def partition_of(uuid: str, num_partitions: int) -> int:
@@ -44,7 +45,7 @@ class IngestQueue:
         self.dropped_oldest = 0
         self._parts: list[list[Any]] = [[] for _ in range(self.num_partitions)]
         self._base: list[int] = [0] * self.num_partitions   # offset of _parts[p][0]
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("broker.partitions")
 
     def append(self, record: dict) -> tuple[int, int]:
         """Producer API: route by record["uuid"], return (partition,

@@ -23,7 +23,6 @@ what write_osmlr_tile saw, asserted by tests/test_osmlr_tiles.py.
 
 from __future__ import annotations
 
-import numpy as np
 
 from reporter_tpu.netgen.pbf import (_field, _fields, _ld, _packed,
                                      _packed_varints, _read_varint, _varint)

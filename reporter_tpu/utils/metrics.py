@@ -16,9 +16,10 @@ from __future__ import annotations
 
 import bisect
 import re
-import threading
 import time
 from typing import Any
+
+from reporter_tpu.utils import locks
 
 # Fixed histogram bucket upper bounds (seconds-scale, matching the
 # stage-timer series this registry mostly holds). FIXED, not adaptive:
@@ -129,7 +130,7 @@ class MetricsRegistry:
     """Named counters + observation series; thread-safe; snapshot-able."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("metrics.registry")
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._series: dict[str, _Reservoir] = {}

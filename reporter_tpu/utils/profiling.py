@@ -18,8 +18,9 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 from typing import Iterator
+
+from reporter_tpu.utils import locks
 
 # jax.profiler.trace is a PROCESS-GLOBAL singleton (start_trace raises if
 # one is already active). The serving scheduler overlaps match_many calls
@@ -27,7 +28,7 @@ from typing import Iterator
 # normal — only the first concurrent entrant starts a capture; the rest
 # run untraced (their device work still lands in the active capture,
 # which is what an XPlane trace of overlapped batches should show).
-_trace_lock = threading.Lock()
+_trace_lock = locks.named_lock("profiling.trace")
 _trace_active = False
 
 

@@ -48,6 +48,8 @@ import threading
 import time
 from typing import Any
 
+from reporter_tpu.utils import locks
+
 __all__ = ["FlightRecorder", "Span", "tracer", "configure", "span",
            "post_mortem", "NOOP"]
 
@@ -152,12 +154,12 @@ class FlightRecorder:
         self.max_dumps = 16
         self._ring: "collections.deque[Span]" = collections.deque(
             maxlen=int(capacity))
-        self._dump_lock = threading.Lock()
+        self._dump_lock = locks.named_lock("tracer.dump")
         self._dump_seq = 0
         self.dumps_written = 0
         self.dumps_suppressed = 0     # past max_dumps (counted, not silent)
         self._tids: dict[int, int] = {}   # thread ident → small stable id
-        self._tid_lock = threading.Lock()   # its own lock: dump() calls
+        self._tid_lock = locks.named_lock("tracer.tid")  # its own lock: dump() calls
         #                                     _tid while holding _dump_lock
 
     # ---- configuration ---------------------------------------------------
@@ -313,17 +315,29 @@ _ENV_DIR = "RTPU_TRACE_DIR"
 _ENV_RING = "RTPU_TRACE_RING"
 
 
-def env_flag(value: "str | None") -> bool:
+def env_flag(value: "str | None", strict: bool = False) -> bool:
     """THE env-var truthiness parse for RTPU_*/REPORTER_* boolean knobs
     — shared with ServiceConfig.with_env_overrides so the config view
     and the process-global recorder can never disagree on the same
-    string. Unset, blank/whitespace, and 0/false/off/no are False."""
+    string. Unset, blank/whitespace, and 0/false/off/no are False.
+
+    ``strict=True`` raises ValueError on a token outside the recognized
+    true/false sets instead of reading it as True — the matcher-lever
+    discipline (config.py round 8): a typo'd kernel knob must fail
+    loudly, or an on-chip A/B measures an arm against itself. The
+    analysis/ env-flag lint requires every boolean RTPU_*/REPORTER_*
+    parse to go through this function (round 14)."""
     if not value:
         return False
-    return value.strip().lower() not in ("", "0", "false", "off", "no")
+    tok = value.strip().lower()
+    if strict and tok not in ("", "0", "false", "off", "no",
+                              "1", "true", "on", "yes"):
+        raise ValueError(f"unrecognized boolean env value {value!r}; "
+                         "use 0/1 (or true/false, on/off, yes/no)")
+    return tok not in ("", "0", "false", "off", "no")
 
 _tracer = FlightRecorder()
-_env_lock = threading.Lock()
+_env_lock = locks.named_lock("tracer.env")
 _env_applied = False
 
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+from reporter_tpu.utils import locks
 from reporter_tpu import faults
 
 TIMED_OUT = object()    # sentinel: the body was abandoned (a body may
@@ -34,7 +35,7 @@ class AbandonedThreadWatchdog:
     """
 
     def __init__(self, cap: int = 4, thread_name: str = "watchdog"):
-        self.lock = threading.Lock()
+        self.lock = locks.named_lock("watchdog.ledger")
         self.abandoned = 0
         self.cap = cap
         self.thread_name = thread_name

@@ -21,6 +21,20 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Arm the lockdep runtime BEFORE any reporter_tpu module with locks is
+# imported (arming is creation-time: named_lock returns instrumented
+# wrappers only for locks created while armed). The whole tier-1 session
+# runs armed — overhead is one thread-local push/pop per lock op plus an
+# edge-set lookup when locks nest (measured < 1% of suite wall-clock;
+# STATUS.md r14) — and the autouse gate below fails the exact test that
+# introduced a lock-order inversion, a blocking call under a lock, or a
+# global-state leak.
+from reporter_tpu.analysis import concurrency_contract as _contract
+from reporter_tpu.analysis import global_state as _global_state
+from reporter_tpu.utils import locks as _locks
+
+_LOCKDEP = _locks.arm(blocking_allow=set(_contract.BLOCKING_ALLOW))
+
 import numpy as np
 import pytest
 
@@ -48,3 +62,60 @@ def sf_tiles():
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockdep_session_gate():
+    """Backstop for violations landing OUTSIDE any test's gate window —
+    session-fixture setup (sf_tiles building a matcher) and
+    collection-time imports run before the first per-test snapshot, so
+    their violations would be sliced out of every [v0:] check. The
+    per-test gate gives attribution; this gives completeness. (A
+    violation that already failed its test is re-reported here — the
+    run is red either way.)"""
+    yield
+    snap = _LOCKDEP.snapshot()
+    assert not snap["violations"], (
+        "lockdep violations recorded during the session (incl. fixture/"
+        "import windows):\n" + "\n".join(map(str, snap["violations"])))
+    unknown = [e for e in snap["edges"]
+               if e not in _contract.LOCK_ORDER_EDGES]
+    assert not unknown, (
+        f"lock-order edges outside the committed golden graph: {unknown}")
+
+
+@pytest.fixture(autouse=True)
+def _concurrency_and_leak_gate(request):
+    """Round-14 CI gates, per test:
+
+    - lockdep: no new lock-order/blocking-under-lock violations during
+      the test, and every observed order edge is in the committed golden
+      graph (analysis/concurrency_contract.py — extend with a dated
+      justification only);
+    - global-state leaks: the process-global tracer, installed fault
+      plan, and RTPU_*/REPORTER_*/DATASTORE_* env must be restored (the
+      r10 "tracer left ON for every later leg" class).
+
+    Daemon threads from a previous test can in principle land a
+    violation inside a later test's window — that is still a real
+    violation; attribution is best-effort, the failure is not.
+    """
+    pre_state = _global_state.snapshot()
+    v0, e0 = _LOCKDEP.counts()
+    yield
+    problems = _global_state.diff(pre_state, _global_state.snapshot())
+    new_violations = _LOCKDEP.violations[v0:]
+    if new_violations:
+        problems.extend(
+            f"lockdep violation: {v}" for v in new_violations)
+    # only edges OBSERVED FIRST during this test (insertion-ordered
+    # dict): a pre-existing unknown edge fails the test that created it,
+    # not every test after it
+    unknown = [e for e in list(_LOCKDEP.snapshot()["edges"])[e0:]
+               if e not in _contract.LOCK_ORDER_EDGES]
+    if unknown:
+        problems.append(
+            f"lock-order edges outside the committed golden graph: "
+            f"{unknown} — add to analysis/concurrency_contract."
+            f"LOCK_ORDER_EDGES with a dated justification, or unnest")
+    assert not problems, "\n".join(problems)

@@ -1,0 +1,474 @@
+"""Round-14 static-analysis + concurrency-contract gates.
+
+Three layers, each with BOTH directions tested so the gate can't rot
+into vacuous green:
+
+  1. the repo gate: zero unwaived lint findings over reporter_tpu/ +
+     bench.py, every waiver dated, the committed lockdep golden state
+     valid (acyclic, dated);
+  2. seeded violations: each lint rule and each lockdep detector must
+     FIRE on a synthetic bad input (an AB/BA inversion, a
+     sleep-under-lock, a forked wire body, a rogue env read, ...);
+  3. clean inputs must PASS the same detectors.
+
+The runtime gates themselves (per-test violation/edge/leak assertions)
+live in tests/conftest.py and run around every tier-1 test.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+import pytest
+
+from reporter_tpu.analysis import concurrency_contract as contract
+from reporter_tpu.analysis import global_state
+from reporter_tpu.analysis.lint_rules import lint_source, run_lint
+from reporter_tpu.utils import locks
+
+
+# ---------------------------------------------------------------------------
+# 1. the repo gates
+
+
+_REPO_FINDINGS: "list | None" = None
+
+
+def _repo_findings():
+    """One full-repo lint pass shared by the gate tests (the pass walks
+    every module incl. bench.py; three identical walks would cost ~45 s
+    of tier-1 budget for nothing)."""
+    global _REPO_FINDINGS
+    if _REPO_FINDINGS is None:
+        _REPO_FINDINGS = run_lint()
+    return _REPO_FINDINGS
+
+
+def test_lint_zero_unexplained_findings():
+    findings = _repo_findings()
+    unwaived = [f for f in findings if not f.waived]
+    assert not unwaived, (
+        "unexplained lint findings (fix, or waive with "
+        "`# lint: allow[rule] <dated justification>`):\n"
+        + "\n".join(str(f) for f in unwaived))
+
+
+def test_lint_waivers_carry_dated_justifications():
+    dated = re.compile(r"20\d\d-\d\d-\d\d")
+    for f in _repo_findings():
+        if f.waived:
+            assert dated.search(f.justification), \
+                f"waiver without a date: {f}"
+
+
+def test_golden_lockdep_state_is_valid():
+    # acyclic edge set + dated justifications on every entry
+    contract.validate()
+
+
+def test_lockdep_is_armed_in_tier1():
+    # the conftest arms before reporter_tpu lock construction; if this
+    # regresses, every runtime gate silently stops observing
+    assert locks.armed()
+    import time as _time
+
+    assert getattr(_time.sleep, "__lockdep_label__", "") == "time.sleep"
+
+
+def test_observed_edges_subset_is_enforced_per_test():
+    # the conftest fixture compares observed edges against the golden
+    # graph; sanity-check the mechanism reads the same objects
+    snap = locks.global_dep().snapshot()
+    unknown = [e for e in snap["edges"]
+               if e not in contract.LOCK_ORDER_EDGES]
+    assert not unknown, f"edges missing from the golden graph: {unknown}"
+
+
+# ---------------------------------------------------------------------------
+# 2+3. lockdep runtime: seeded violations + clean passes
+
+
+def test_lockdep_catches_ab_ba_inversion():
+    dep = locks.Lockdep()
+    a = locks.NamedLock("syn.A", dep=dep)
+    b = locks.NamedLock("syn.B", dep=dep)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:                      # the inversion
+            pass
+    kinds = [v["kind"] for v in dep.violations]
+    assert "lock-order" in kinds
+    v = next(v for v in dep.violations if v["kind"] == "lock-order")
+    assert v["edge"] == ("syn.B", "syn.A")
+
+
+def test_lockdep_catches_transitive_cycle():
+    dep = locks.Lockdep()
+    a, b, c = (locks.NamedLock(f"syn3.{x}", dep=dep) for x in "ABC")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:                      # A→B→C→A
+            pass
+    assert any(v["kind"] == "lock-order" for v in dep.violations)
+
+
+def test_lockdep_violation_does_not_poison_the_graph():
+    # Linux-lockdep semantics: the inverting edge is reported, NOT
+    # inserted — otherwise one real inversion cascades false violations
+    # onto innocent later nestings through the bogus path
+    dep = locks.Lockdep()
+    a = locks.NamedLock("np.A", dep=dep)
+    b = locks.NamedLock("np.B", dep=dep)
+    x = locks.NamedLock("np.X", dep=dep)
+    with a:
+        with b:
+            pass
+    with x:
+        with b:
+            pass
+    with b:
+        with a:                      # the one real inversion
+            pass
+    n = len(dep.violations)
+    assert n == 1
+    assert ("np.B", "np.A") not in dep.edges
+    with a:                          # innocent: A→X is a fresh edge
+        with x:
+            pass
+    assert len(dep.violations) == n, dep.violations[n:]
+
+
+def test_lockdep_clean_consistent_order_passes():
+    dep = locks.Lockdep()
+    a = locks.NamedLock("ok.A", dep=dep)
+    b = locks.NamedLock("ok.B", dep=dep)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert dep.violations == []
+    assert ("ok.A", "ok.B") in dep.edges
+
+
+def test_lockdep_same_class_nesting_is_flagged():
+    dep = locks.Lockdep()
+    l1 = locks.NamedLock("cls.same", dep=dep)
+    l2 = locks.NamedLock("cls.same", dep=dep)
+    with l1:
+        with l2:                     # two instances, one class
+            pass
+    assert any(v["kind"] == "lock-order" and v["edge"][0] == v["edge"][1]
+               for v in dep.violations)
+
+
+def test_lockdep_rlock_reentry_is_not_flagged():
+    dep = locks.Lockdep()
+    rl = locks.NamedLock("re.R", dep=dep, reentrant=True)
+    with rl:
+        with rl:
+            pass
+        # locked() must work on the reentrant wrapper too (stdlib RLock
+        # grows .locked() only in 3.14; the wrapper papers over that)
+        assert rl.locked()
+    assert not rl.locked()
+    assert dep.violations == []
+
+
+def test_lockdep_catches_sleep_under_lock():
+    dep = locks.Lockdep()
+    lk = locks.NamedLock("syn.sleepy", dep=dep)
+    with locks.use(dep):
+        with lk:
+            time.sleep(0)            # patched entry point
+    assert any(v["kind"] == "blocking-under-lock"
+               and v["call"] == "time.sleep" for v in dep.violations)
+
+
+def test_lockdep_sleep_outside_lock_is_clean():
+    dep = locks.Lockdep()
+    lk = locks.NamedLock("syn.fine", dep=dep)
+    with locks.use(dep):
+        with lk:
+            pass
+        time.sleep(0)
+    assert dep.violations == []
+
+
+def test_lockdep_blocking_allowlist_waives():
+    dep = locks.Lockdep(blocking_allow={("syn.waived", "time.sleep")})
+    lk = locks.NamedLock("syn.waived", dep=dep)
+    with locks.use(dep):
+        with lk:
+            time.sleep(0)
+    assert dep.violations == []
+
+
+def test_lockdep_foreign_condvar_wait_is_flagged():
+    dep = locks.Lockdep()
+    outer = locks.NamedLock("syn.outer", dep=dep)
+    cv = locks.NamedCondition("syn.cv", dep=dep)
+    with outer:
+        with cv:
+            cv.wait(timeout=0.001)   # releases cv only; outer stays held
+    assert any(v["kind"] == "blocking-under-lock"
+               and v["call"] == "wait:syn.cv"
+               and "syn.outer" in v["held"] for v in dep.violations)
+
+
+def test_lockdep_own_condvar_wait_is_clean():
+    dep = locks.Lockdep()
+    cv = locks.NamedCondition("syn.solo_cv", dep=dep)
+    with cv:
+        cv.wait(timeout=0.001)
+    assert dep.violations == []
+    # the held stack is restored after the wait re-acquires
+    with cv:
+        assert dep.held() == ("syn.solo_cv",)
+    assert dep.held() == ()
+
+
+def test_lockdep_wait_for_predicate_runs_with_lock_visible():
+    # wait_for re-acquires the condvar lock to evaluate the predicate;
+    # a named-lock acquisition inside it must record the (cv, inner)
+    # edge — the ledger must not go blind during predicate evaluation
+    dep = locks.Lockdep()
+    cv = locks.NamedCondition("wf.cv", dep=dep)
+    inner = locks.NamedLock("wf.inner", dep=dep)
+
+    def pred():
+        assert "wf.cv" in dep.held()
+        with inner:
+            pass
+        return True
+
+    with cv:
+        assert cv.wait_for(pred, timeout=1.0)
+    assert ("wf.cv", "wf.inner") in dep.edges
+    assert dep.violations == []
+    assert dep.held() == ()
+
+
+def test_lockdep_condvar_notify_wakes_waiter_across_threads():
+    # the instrumented condvar must still BE a condvar
+    dep = locks.Lockdep()
+    cv = locks.NamedCondition("syn.wake", dep=dep)
+    got = []
+
+    def waiter():
+        with cv:
+            got.append(cv.wait(timeout=5.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=5.0)
+    assert got == [True]
+    assert dep.violations == []
+
+
+def test_named_lock_try_acquire_semantics():
+    dep = locks.Lockdep()
+    lk = locks.NamedLock("syn.try", dep=dep)
+    assert lk.acquire(blocking=False)
+    assert not lk.acquire(blocking=False)
+    lk.release()
+    assert dep.held() == ()
+
+
+# ---------------------------------------------------------------------------
+# 2+3. lint rules: seeded violations + clean passes
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings if not f.waived}
+
+
+def test_lint_catches_rogue_env_read_truthiness():
+    bad = ("import os\n"
+           "if os.environ.get(\"RTPU_SYNTH_FLAG\"):\n"
+           "    x = 1\n")
+    assert "env-flag" in _rules_of(lint_source(bad))
+
+
+def test_lint_catches_env_literal_comparison():
+    bad = ("import os\n"
+           "on = os.environ.get(\"REPORTER_SYNTH\", \"\") == \"1\"\n")
+    assert "env-flag" in _rules_of(lint_source(bad))
+
+
+def test_lint_catches_env_taint_chain():
+    bad = ("import os\n"
+           "def f(e):\n"
+           "    raw = e[\"RTPU_SYNTH\"].strip().lower()\n"
+           "    if raw in (\"1\", \"true\"):\n"
+           "        return True\n")
+    assert "env-flag" in _rules_of(lint_source(bad))
+
+
+def test_lint_env_flag_clean_usage_passes():
+    good = ("import os\n"
+            "from reporter_tpu.utils.tracing import env_flag\n"
+            "on = env_flag(os.environ.get(\"RTPU_SYNTH_FLAG\"))\n")
+    assert "env-flag" not in _rules_of(lint_source(good))
+
+
+def test_lint_env_presence_gate_is_not_flagged():
+    # truthiness as a presence check before a VALUE read (multihost
+    # pattern) is legal
+    good = ("import os\n"
+            "def f(env):\n"
+            "    n = None\n"
+            "    if n is None and env.get(\"RTPU_SYNTH_N\"):\n"
+            "        n = int(env[\"RTPU_SYNTH_N\"])\n"
+            "    return n\n")
+    assert "env-flag" not in _rules_of(lint_source(good))
+
+
+def test_lint_catches_sleep_under_lock_lexically():
+    bad = ("import time\n"
+           "def f(self):\n"
+           "    with self._lock:\n"
+           "        time.sleep(1)\n")
+    assert "lock-blocking" in _rules_of(lint_source(bad))
+
+
+def test_lint_catches_foreign_wait_under_lock():
+    bad = ("def f(self):\n"
+           "    with self._stats_lock:\n"
+           "        self._other_cv.wait()\n")
+    assert "lock-blocking" in _rules_of(lint_source(bad))
+    bad2 = ("def f(self):\n"
+            "    with self._stats_lock:\n"
+            "        self._other_cv.wait_for(lambda: True)\n")
+    assert "lock-blocking" in _rules_of(lint_source(bad2))
+
+
+def test_lint_own_condvar_wait_passes():
+    good = ("def f(self):\n"
+            "    with self._cv:\n"
+            "        self._cv.wait()\n")
+    assert "lock-blocking" not in _rules_of(lint_source(good))
+
+
+def test_lint_catches_forked_wire_body():
+    bad = ("def wire_from_q8_fast(deltas, origins, lengths, tables):\n"
+           "    return tables\n")
+    assert "wire-fork" in _rules_of(
+        lint_source(bad, path="reporter_tpu/parallel/rogue.py"))
+
+
+def test_lint_wire_body_in_match_py_passes():
+    good = ("def wire_from_f32(points, lengths, tables):\n"
+            "    return tables\n")
+    assert "wire-fork" not in _rules_of(
+        lint_source(good, path="reporter_tpu/ops/match.py"))
+
+
+def test_lint_catches_jit_inside_shard_map():
+    bad = ("import jax\n"
+           "from reporter_tpu.parallel.compat import shard_map\n"
+           "f = shard_map(jax.jit(lambda x: x), mesh=None,\n"
+           "              in_specs=None, out_specs=None)\n")
+    assert "wire-fork" in _rules_of(lint_source(bad))
+
+
+def test_lint_catches_partial_staged_layout():
+    bad = ("out = {}\n"
+           "out[\"seg_pack\"] = 1\n"
+           "out[\"seg_bbox\"] = 2\n")
+    assert "staged-layout" in _rules_of(lint_source(bad))
+
+
+def test_lint_full_staged_layout_passes():
+    from reporter_tpu.tiles.tileset import _DENSE_LAYOUT_KEYS
+
+    good = "\n".join(f"out[\"{k}\"] = 1" for k in _DENSE_LAYOUT_KEYS)
+    assert "staged-layout" not in _rules_of(lint_source(good))
+
+
+def test_lint_catches_uncapped_pow2_shape():
+    bad = "B = 1 << (n - 1).bit_length()\n"
+    assert "jit-shape-len" in _rules_of(lint_source(bad))
+
+
+def test_lint_capped_pow2_shape_passes():
+    good = "B = min(1 << (n - 1).bit_length(), 4096)\n"
+    assert "jit-shape-len" not in _rules_of(lint_source(good))
+
+
+def test_lint_catches_dead_import():
+    bad = "import os\nimport sys\n\nprint(os.getpid())\n"
+    found = lint_source(bad)
+    assert any(f.rule == "dead-import" and "'sys'" in f.message
+               for f in found)
+    assert not any(f.rule == "dead-import" and "'os'" in f.message
+                   for f in found)
+
+
+def test_lint_waiver_requires_justification():
+    # a bare allow[] marker with no reason stays a finding
+    bad = ("import time\n"
+           "def f(self):\n"
+           "    with self._lock:\n"
+           "        # lint: allow[lock-blocking]\n"
+           "        time.sleep(1)\n")
+    found = lint_source(bad)
+    assert any(f.rule == "lock-blocking" and not f.waived for f in found)
+    ok = bad.replace("allow[lock-blocking]",
+                     "allow[lock-blocking] 2026-08-04 synthetic reason")
+    assert "lock-blocking" not in _rules_of(lint_source(ok))
+
+
+def test_env_table_documents_all_real_reads():
+    findings = [f for f in _repo_findings() if f.rule == "env-table"]
+    assert not [f for f in findings if not f.waived], \
+        "\n".join(str(f) for f in findings if not f.waived)
+
+
+# ---------------------------------------------------------------------------
+# global-state leak detector (the conftest gate's engine)
+
+
+def test_leak_detector_sees_tracer_leak_and_restore():
+    from reporter_tpu.utils import tracing
+
+    pre = global_state.snapshot()
+    tr = tracing.tracer()
+    was = tr.enabled
+    tr.configure(enabled=True)
+    try:
+        leaked = global_state.diff(pre, global_state.snapshot())
+        assert was or any("tracer.enabled" in p for p in leaked)
+    finally:
+        tr.configure(enabled=was)
+    assert global_state.diff(pre, global_state.snapshot()) == []
+
+
+def test_leak_detector_sees_installed_fault_plan():
+    from reporter_tpu import faults
+
+    pre = global_state.snapshot()
+    plan = faults.FaultPlan.parse("publish:fail@0", seed=1)
+    with faults.use(plan):
+        leaked = global_state.diff(pre, global_state.snapshot())
+        assert any("faults plan left installed" in p for p in leaked)
+    assert global_state.diff(pre, global_state.snapshot()) == []
+
+
+def test_leak_detector_sees_env_mutation(monkeypatch):
+    pre = global_state.snapshot()
+    monkeypatch.setenv("RTPU_SYNTH_LEAK", "1")
+    leaked = global_state.diff(pre, global_state.snapshot())
+    assert any("RTPU_SYNTH_LEAK" in p for p in leaked)
+    # monkeypatch restores on teardown → the conftest gate stays green
