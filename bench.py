@@ -24,6 +24,18 @@ the same work the reference's segment_matcher.Match does per trace.
 Manual runs: `python bench.py [n_traces] [city]` bench exactly one tile
 (skips the metro/restricted extras).
 
+Round 15 — the composite is a DAG of journaled legs (bench_journal.jsonl,
+atomic per-leg appends stamped with git sha + wall time + the
+contemporaneous link-health window from utils/linkhealth.py):
+`--resume` (or RTPU_BENCH_RESUME=1) serves already-journaled legs
+instead of re-measuring, so a mid-run tunnel death keeps everything
+captured; `--legs sweep_ab,fleet` (or RTPU_BENCH_LEGS) runs a subset
+that fits a short tunnel window, writing BENCH_DETAIL*_PARTIAL.json so
+a sparse composite never clobbers the committed full capture. Every
+run's tail self-reports a schema-aware delta vs the committed capture
+(analysis/bench_delta.py) with regressions attributed against the
+recorded link mood.
+
 Tiles and fleets are cached on disk (.bench_tiles_*.npz /
 .bench_fleet_*.npz) so repeat runs exercise the operational
 load-from-npz restart path instead of recompiling; detail.setup_split
@@ -2580,9 +2592,281 @@ def _link_rtt() -> float:
     return rtts[len(rtts) // 2]
 
 
+# ---------------------------------------------------------------------------
+# Round 15: the capture journal + link-health + regression sentinel — the
+# layer that turns "the tunnel died again" from a zeroed 10-13 min run
+# into a journaled, attributable, resumable artifact (ROADMAP open item
+# 1's first half; the r13 MXU acceptance bar is blocked on exactly this).
+
+_JOURNAL_NAME = "bench_journal.jsonl"
+
+# the composite's leg DAG in run order. Self-contained legs build their
+# own inputs (fleet always; sweep_ab on the no-chip validation path) so
+# `--legs sweep_ab` / `--legs fleet` fits a short tunnel window without
+# paying the primary tile+fleet setup.
+_ALL_LEGS = (
+    "primary", "service", "oracle", "fresh_rotation",
+    "metro", "restricted", "xl", "organic", "organic_xl", "bicycle",
+    "streaming", "streaming_capacity", "streaming_soak",
+    "latency_attribution", "streaming_overload", "chaos",
+    "device_compute", "sweep_ab", "window2", "prepare_bench", "fleet",
+)
+_SELF_CONTAINED_LEGS = {"fleet"}        # + sweep_ab when no chip is in
+#                                         play (_sweep_ab_cpu_validate
+#                                         compiles its own tiny tile)
+
+
+class BenchJournal:
+    """Crash-safe per-leg capture journal (``bench_journal.jsonl``).
+
+    Every completed leg is appended as one JSON line — result +
+    provenance (wall time, capture timestamp, the contemporaneous
+    link-health window) — via the r9 checkpoint discipline (full
+    tmp+fsync+rename rewrite: a reader never sees a torn file this
+    writer produced, and a crash mid-append leaves the previous journal
+    intact). ``--resume`` reloads the journal and serves journaled legs
+    from it instead of re-measuring, so a mid-run tunnel death keeps
+    everything already captured; a torn/corrupt TAIL line (a foreign
+    writer, a half-synced disk) is truncated at reopen and counted,
+    never fatal. Resume is refused — journal restarted, noted — when
+    the header's config/git-sha fingerprint doesn't match this run:
+    journaled numbers from a different workload or code state must not
+    leak into a composite claiming this one.
+    """
+
+    def __init__(self, path: str, meta: dict, resume: bool = False,
+                 only: "set[str] | None" = None):
+        self.path = path
+        self.meta = dict(meta)
+        self.only = set(only) if only is not None else None
+        self.entries: "dict[str, dict]" = {}
+        self.order: "list[str]" = []
+        self.reused: "set[str]" = set()
+        self.truncated_lines = 0
+        self.resume_rejected: "str | None" = None
+        if resume:
+            self._load()
+        self._write_all()
+
+    # ---- persistence -----------------------------------------------------
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return
+        header = None
+        for i, ln in enumerate(lines):
+            if not ln.strip():
+                continue
+            try:
+                doc = json.loads(ln)
+            except json.JSONDecodeError:
+                # torn tail: keep everything before it, drop the rest
+                self.truncated_lines = len(lines) - i
+                break
+            if i == 0 or header is None:
+                if doc.get("journal") != "bench":
+                    self.resume_rejected = "no journal header"
+                    return
+                header = doc
+                continue
+            if isinstance(doc, dict) and "leg" in doc:
+                self.entries[doc["leg"]] = doc
+                self.order.append(doc["leg"])
+        if header is None:
+            self.resume_rejected = "empty journal"
+            self.entries.clear()
+            self.order.clear()
+            return
+        for key in ("config", "git_sha"):
+            if header.get(key) != self.meta.get(key):
+                self.resume_rejected = (
+                    f"{key} changed ({header.get(key)!r} -> "
+                    f"{self.meta.get(key)!r}) — journaled legs are from "
+                    "a different workload/code state")
+                self.entries.clear()
+                self.order.clear()
+                return
+        self.reused = set(self.entries)
+
+    def _write_all(self) -> None:
+        # r9 checkpoint discipline: .tmp + fsync + atomic rename — a
+        # crash between any two syscalls leaves either the old journal
+        # or the new one, never a torn line of this writer's making
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"journal": "bench", **self.meta}) + "\n")
+            for name in self.order:
+                f.write(json.dumps(self.entries[name]) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # ---- leg execution ---------------------------------------------------
+
+    def wants(self, name: str) -> bool:
+        return self.only is None or name in self.only
+
+    def leg(self, name: str, fn):
+        """Run (or replay) one journaled leg. Returns the leg's result —
+        from the journal when resuming and the leg is already captured,
+        None when a ``--legs`` subset excludes it."""
+        if not self.wants(name):
+            return None
+        if name in self.entries:
+            return self.entries[name].get("result")
+        from reporter_tpu.utils import linkhealth
+
+        s = linkhealth.sampler() if linkhealth.enabled() else None
+        t_link0 = s.clock() if s is not None else None
+        t0 = time.perf_counter()
+        result = fn()
+        entry = {
+            "leg": name,
+            "seconds": round(time.perf_counter() - t0, 2),
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "link": (s.window(since=t_link0) if s is not None
+                     else {"rtt_ms": None, "mbps": None, "mood": None,
+                           "samples": 0}),
+            "result": result,
+        }
+        self.entries[name] = entry
+        self.order.append(name)
+        self._write_all()
+        return result
+
+    def seconds(self, name: str) -> "float | None":
+        e = self.entries.get(name)
+        return None if e is None else e.get("seconds")
+
+    def to_json(self) -> dict:
+        """The composite's journal block: which legs were measured this
+        run vs replayed, plus the per-leg link windows — the capture's
+        own provenance for every number in it."""
+        return {
+            "path": os.path.basename(self.path),
+            "legs": {n: {"seconds": e.get("seconds"),
+                         "captured_at": e.get("captured_at"),
+                         "link": e.get("link"),
+                         "resumed": n in self.reused}
+                     for n, e in self.entries.items()},
+            "resumed_legs": sorted(self.reused),
+            "truncated_lines": self.truncated_lines,
+            **({"resume_rejected": self.resume_rejected}
+               if self.resume_rejected else {}),
+        }
+
+
+def _current_round() -> "int | None":
+    """This build's round number: REPORTER_BENCH_ROUND when the driver
+    sets it (e.g. "r15"), else derived from CHANGES.md (one ``- rN``
+    line per landed round; the next capture is N+1)."""
+    import re as _re
+
+    tag = os.environ.get("REPORTER_BENCH_ROUND", "")
+    m = _re.search(r"(\d+)", tag)
+    if m:
+        return int(m.group(1))
+    try:
+        with open(_repo_path("CHANGES.md")) as f:
+            rounds = [int(x) for x in _re.findall(r"^- r(\d+)", f.read(),
+                                                  _re.MULTILINE)]
+        return max(rounds) + 1 if rounds else None
+    except OSError:
+        return None
+
+
+def _staleness_banner() -> "str | None":
+    """Loud when the committed chip capture is >=2 rounds behind the
+    code being benched (the r5-run8 capture sat silently stale for 8
+    rounds while r8/r12/r13 perf work shipped with zero silicon
+    numbers). Printed to stderr AND recorded in the journal header, so
+    both the operator and the artifact know the baseline is old."""
+    import re as _re
+
+    cur = _current_round()
+    if cur is None:
+        return None
+    try:
+        with open(_repo_path("BENCH_DETAIL.json")) as f:
+            committed = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    tag = (committed.get("provenance") or {}).get("round") or ""
+    m = _re.search(r"(\d+)", str(tag))
+    if not m:
+        return None
+    behind = cur - int(m.group(1))
+    if behind < 2:
+        return None
+    return (f"STALE CHIP CAPTURE: committed BENCH_DETAIL.json is "
+            f"round {m.group(1)} ({tag!r}), current round is r{cur} — "
+            f"{behind} rounds behind. Every perf feature since has no "
+            f"silicon numbers; land a chip capture (or use --legs for "
+            f"a short-window partial) before trusting cross-round "
+            f"comparisons.")
+
+
+def _bench_delta_tail(doc: dict, against_path: str) -> "dict | None":
+    """The regression sentinel, run against the committed capture of
+    the SAME flavor (chip runs diff the chip capture, CPU runs the CPU
+    one) BEFORE this run overwrites it. Returns the bounded embed (top
+    regressions + counters) or None when there is nothing to compare."""
+    from reporter_tpu.analysis import bench_delta
+
+    try:
+        with open(against_path) as f:
+            old = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    delta = bench_delta.compare(old, doc)
+    out = bench_delta.compact(delta)
+    out["against"] = os.path.basename(against_path)
+    return out
+
+
+def _parse_args(argv: "list[str]") -> "tuple":
+    """(n_traces|None, city, resume, legs|None). Positional args keep
+    the historical manual form (``bench.py 16000 bayarea``); --resume /
+    --legs are the round-15 journal controls, with env twins
+    (RTPU_BENCH_RESUME / RTPU_BENCH_LEGS) so the driver can steer a run
+    it can't pass flags to."""
+    import argparse
+
+    from reporter_tpu.utils.tracing import env_flag
+
+    ap = argparse.ArgumentParser(
+        description="reporter_tpu composite bench (see module docstring)")
+    ap.add_argument("n_traces", nargs="?", type=int, default=None)
+    ap.add_argument("city", nargs="?", default="sf")
+    ap.add_argument("--resume", action="store_true",
+                    help="serve already-journaled legs from "
+                         f"{_JOURNAL_NAME} instead of re-measuring")
+    ap.add_argument("--legs", default=None,
+                    help="comma-separated leg subset to run (names: "
+                         + ",".join(_ALL_LEGS) + ")")
+    args = ap.parse_args(argv)
+    resume = args.resume or env_flag(os.environ.get("RTPU_BENCH_RESUME"))
+    legs_raw = args.legs or os.environ.get("RTPU_BENCH_LEGS") or None
+    legs = None
+    if legs_raw:
+        legs = {x.strip() for x in legs_raw.split(",") if x.strip()}
+        unknown = legs - set(_ALL_LEGS)
+        if unknown:
+            ap.error(f"unknown legs {sorted(unknown)}; "
+                     f"known: {', '.join(_ALL_LEGS)}")
+    return args.n_traces, args.city, resume, legs
+
+
 def main() -> None:
     t_setup = time.perf_counter()
     split: dict = {}
+
+    n_arg, city, resume, legs_filter = _parse_args(sys.argv[1:])
+    manual = n_arg is not None
 
     t0 = time.perf_counter()
     # REPORTER_BENCH_FORCE_CPU=1 exercises the tunnel-outage fallback
@@ -2606,12 +2890,25 @@ def main() -> None:
 
     enable_compilation_cache()
 
-    from reporter_tpu.config import Config
-    from reporter_tpu.matcher.api import SegmentMatcher, Trace
+    import numpy as np
 
-    manual = len(sys.argv) > 1
-    n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 16000
-    city = sys.argv[2] if len(sys.argv) > 2 else "sf"
+    from reporter_tpu.config import Config
+    from reporter_tpu.matcher.api import SegmentMatcher
+    from reporter_tpu.utils import linkhealth
+
+    # link-health sampler (round 15): probes RTT + bandwidth at low duty
+    # for the WHOLE composite; every journaled leg gets stamped with its
+    # contemporaneous window. Bench tightens the period (finer per-leg
+    # attribution) unless the operator pinned it.
+    link_enabled = linkhealth.enabled()
+    if link_enabled:
+        _ls = linkhealth.sampler()
+        if "RTPU_LINK_PROBE_PERIOD_S" not in os.environ:
+            _ls.period_s = 30.0
+        _ls.start()
+        _ls.sample_once()       # every leg window has >= 1 observation
+
+    n_traces = n_arg if manual else 16000
     if not tpu_ok:
         n_traces = min(n_traces, 128)   # keep the degraded-mode run short:
                                         # even the grid gather path (auto's
@@ -2620,619 +2917,766 @@ def main() -> None:
                                         # under a minute on one core
     n_points = 120
     n_cpu = min(250, n_traces)          # sf leg of the ≥500-trace audit
+    full_run = (not manual) and tpu_ok
 
-    t0 = time.perf_counter()
-    ts, tile_info = _cached_tileset(city)
-    split["tile_s"] = round(time.perf_counter() - t0, 1)
-    t0 = time.perf_counter()
-    traces, true_edges = _cached_fleet(ts, n_traces, n_points)
-    split["fleet_s"] = round(time.perf_counter() - t0, 1)
+    prov = _provenance(tpu_ok)
+    banner = _staleness_banner()
+    if banner:
+        print("=" * 72 + f"\n{banner}\n" + "=" * 72, file=sys.stderr)
 
-    t0 = time.perf_counter()
-    jax_matcher, jax_pps, decode_pps, dt_jax = _throughput(
-        ts, traces, repeats=5)
-    split["primary_measure_s"] = round(time.perf_counter() - t0, 1)
+    requested = set(legs_filter) if legs_filter is not None \
+        else set(_ALL_LEGS)
+    self_contained = set(_SELF_CONTAINED_LEGS) | (
+        set() if tpu_ok else {"sweep_ab"})
+    needs_primary = bool(requested - self_contained)
 
-    # p50 single-trace match latency (the north star's second metric; on a
-    # remote-attached chip this is link-RTT-bound, not compute-bound).
-    # Untimed warmup first: the B=1 executable was not compiled by the
-    # full-batch warmup above, and the first rep must not pay jit cost.
-    jax_matcher.match_many(traces[:1])
-    lat = sorted(_time_best(lambda: jax_matcher.match_many(traces[:1]),
-                            repeats=1) for _ in range(7))
-    p50_latency = lat[len(lat) // 2]
+    cur_round = _current_round()
+    journal = BenchJournal(
+        _repo_path(_JOURNAL_NAME),
+        meta={"config": {"n_traces": n_traces, "city": city,
+                         "tpu_ok": bool(tpu_ok), "manual": bool(manual)},
+              "git_sha": prov.get("git_sha"),
+              "round": (prov.get("round")
+                        or (f"r{cur_round}" if cur_round else None)),
+              "staleness_banner": banner,
+              "started_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())},
+        resume=resume, only=legs_filter)
 
-    # Link RTT floor: one tiny dispatch + host readback. When the p50 above
-    # is within a small multiple of this, the latency is the link's, not
-    # the matcher's — the honest breakdown for a remote-attached chip.
-    import numpy as np
-    link_rtt = _link_rtt()
+    # ---- setup (always re-run: disk-cached tiles/fleets + one compile
+    # warm; the journal resumes MEASUREMENTS, not staging) ---------------
+    ts = traces = true_edges = jax_matcher = None
+    tile_info = {"source": None}
+    link_rtt = 0.0
+    if needs_primary:
+        t0 = time.perf_counter()
+        ts, tile_info = _cached_tileset(city)
+        split["tile_s"] = round(time.perf_counter() - t0, 1)
+        t0 = time.perf_counter()
+        traces, true_edges = _cached_fleet(ts, n_traces, n_points)
+        split["fleet_s"] = round(time.perf_counter() - t0, 1)
+        jax_matcher = SegmentMatcher(ts, Config(matcher_backend="jax"))
+        jax_matcher.match_many(traces)  # compile + stage HBM (untimed)
+        link_rtt = _link_rtt()
 
-    # Matcher-only B=1 latency (VERDICT r4 next #8): the per-trace number
-    # a CO-LOCATED deployment would quote. K chained B=1 dispatches with
-    # ONE sync amortize the link RTT away, leaving the device's own
-    # single-trace decode time; median of 3 windows.
-    p50_matcher_only = _matcher_only_latency(jax_matcher, traces[0],
-                                             link_rtt)
+    # window-2 re-measure set: (matcher, traces, window2 repeats) per
+    # tile MEASURED FRESH this run — a journal-resumed tile keeps its
+    # window-1 numbers (its matcher was never rebuilt)
+    live: dict = {}
 
-    # Mitigation: the serving face batches concurrent single-trace
-    # requests into shared device dispatches. Round 7 A/Bs the TWO
-    # batching schedulers in the same run (same link mood): "scheduler" =
-    # continuous in-flight batching (SLO-deadline close, shape-bucketed
-    # padding, max_inflight_batches overlapped dispatches —
-    # service/scheduler.py), "legacy" = the round-4 queue-and-combine
-    # leader (one batch in flight). Closed-loop saturation curve at
-    # 16/64/256 clients + an open-loop offered-rate sweep.
-    from reporter_tpu.config import ServiceConfig as _SvcCfg
-    from reporter_tpu.service.app import ReporterApp
+    # ---- primary tile (BASELINE config 2) ------------------------------
+    def _leg_primary():
+        dt, dt_dec = _timed_pair(jax_matcher, traces, repeats=5)
+        probes = sum(len(t.xy) for t in traces)
+        # p50 single-trace match latency (the north star's second
+        # metric; on a remote-attached chip this is link-RTT-bound, not
+        # compute-bound). Untimed B=1 warmup first.
+        jax_matcher.match_many(traces[:1])
+        lat = sorted(
+            _time_best(lambda: jax_matcher.match_many(traces[:1]),
+                       repeats=1) for _ in range(7))
+        p50_mo = _matcher_only_latency(jax_matcher, traces[0], link_rtt)
+        return {"jax_pps": probes / dt, "decode_pps": probes / dt_dec,
+                "batch_seconds": round(dt, 3),
+                "p50_latency_s": lat[len(lat) // 2],
+                "p50_matcher_only_s": p50_mo,
+                "link_rtt_s": link_rtt}
 
-    svc_apps = {
-        "scheduler": ReporterApp(ts, Config(matcher_backend="jax")),
-        "legacy": ReporterApp(ts, Config(
-            matcher_backend="jax",
-            service=_SvcCfg(batching="combine"))),
-    }
-    # one level past 256 (round-8 satellite / VERDICT weak #6): 512
-    # clients probes for the overload boundary instead of stopping where
-    # nothing has ever broken; _service_overload_boundary names the first
-    # level that degrades (or records that 512 still held)
-    service_curve = _service_saturation_curve(
-        svc_apps, ts, traces,
-        levels=(16, 64, 256, 512) if tpu_ok else (16, 64, 256))
-    # degraded (CPU) runs keep the paced sweep short: one core serves
-    # both the submitters and the matcher, so high offers only measure
-    # thread thrash
-    service_open_loop = _service_open_loop(
-        svc_apps, ts, traces,
-        rates=(100, 250, 500, 1000) if tpu_ok else (50, 100))
-    for _app in svc_apps.values():
-        _app.close()            # drain schedulers; frees the executor
-    lvl16 = service_curve[0]["scheduler"]
-    n_conc = service_curve[0]["clients"]
-    conc_p50 = (lvl16["p50_ms"] / 1e3 if lvl16["p50_ms"] is not None
-                else None)
-    conc_rps = lvl16["req_per_sec"]
-    conc_errors = [e for lvl in service_curve for arm in ("scheduler",
-                                                          "legacy")
-                   for e in lvl[arm].get("error_samples", [])]
-    # acceptance headline: at the top client level, scheduler vs legacy
-    # req/s (same run, alternated rounds) + dispatches at depth >= 2
-    top = service_curve[-1]
-    ab = {
-        "clients": top["clients"],
-        "scheduler_rps": top["scheduler"]["req_per_sec"],
-        "legacy_rps": top["legacy"]["req_per_sec"],
-        "speedup": (round(top["scheduler"]["req_per_sec"]
-                          / top["legacy"]["req_per_sec"], 3)
-                    if top["scheduler"]["req_per_sec"]
-                    and top["legacy"]["req_per_sec"] else None),
-        "inflight_ge2_dispatches": sum(
-            v for k, v in top["scheduler"].get("inflight_hist", {}).items()
-            if int(k) >= 2),
-        "errors": top["scheduler"]["errors"] + top["legacy"]["errors"],
-    }
+    primary = (journal.leg("primary", _leg_primary) or {}
+               if needs_primary else {})
+    split["primary_measure_s"] = journal.seconds("primary")
+    jax_pps = primary.get("jax_pps")
+    decode_pps = primary.get("decode_pps")
+    if needs_primary:
+        live["sf"] = (jax_matcher, traces, 3)
 
-    # Fidelity audit leg 1 (BASELINE north star: <5% segment-ID
-    # disagreement, length-weighted — matcher/fidelity.py, the same metric
-    # the CI gates enforce) + the CPU throughput anchor.
-    t0 = time.perf_counter()
-    disagreement, cpu_pps, _, fsrc = _oracle_audit(
-        ts, jax_matcher, traces, n_cpu)
-    split["oracle_primary_s"] = round(time.perf_counter() - t0, 1)
-    audit = {ts.name: {"traces": n_cpu, "disagreement": round(disagreement, 4),
-                       "fidelity_source": fsrc}}
+    # ---- serving face (round 7 A/B: scheduler vs queue-and-combine) ----
+    def _leg_service():
+        from reporter_tpu.config import ServiceConfig as _SvcCfg
+        from reporter_tpu.service.app import ReporterApp
 
-    # Guaranteed-fresh rotation leg (VERDICT r4 weak #2/next #7): 25
-    # traces from a window that rotates every run, oracle recomputed from
-    # scratch regardless of cache state — every capture contains at least
-    # one freshly computed oracle comparison, on trace content the disk
-    # cache has (usually) never seen.
-    t0 = time.perf_counter()
-    rotf = _repo_path(".bench_fresh_rotation")
-    try:
-        with open(rotf) as f:
-            rot_k = int(f.read().strip() or 0)
-    except (OSError, ValueError):
-        rot_k = 0
-    with open(rotf, "w") as f:
-        f.write(str(rot_k + 1))
-    n_fresh = min(25, max(0, len(traces) - n_cpu))
-    if n_fresh:     # tiny fallback fleets: the audited set covers it all
+        svc_apps = {
+            "scheduler": ReporterApp(ts, Config(matcher_backend="jax")),
+            "legacy": ReporterApp(ts, Config(
+                matcher_backend="jax",
+                service=_SvcCfg(batching="combine"))),
+        }
+        # one level past 256 (round-8 satellite / VERDICT weak #6)
+        curve = _service_saturation_curve(
+            svc_apps, ts, traces,
+            levels=(16, 64, 256, 512) if tpu_ok else (16, 64, 256))
+        # degraded (CPU) runs keep the paced sweep short: one core
+        # serves both the submitters and the matcher
+        open_loop = _service_open_loop(
+            svc_apps, ts, traces,
+            rates=(100, 250, 500, 1000) if tpu_ok else (50, 100))
+        for _app in svc_apps.values():
+            _app.close()        # drain schedulers; frees the executor
+        top = curve[-1]
+        ab = {
+            "clients": top["clients"],
+            "scheduler_rps": top["scheduler"]["req_per_sec"],
+            "legacy_rps": top["legacy"]["req_per_sec"],
+            "speedup": (round(top["scheduler"]["req_per_sec"]
+                              / top["legacy"]["req_per_sec"], 3)
+                        if top["scheduler"]["req_per_sec"]
+                        and top["legacy"]["req_per_sec"] else None),
+            "inflight_ge2_dispatches": sum(
+                v for k, v in top["scheduler"].get("inflight_hist",
+                                                   {}).items()
+                if int(k) >= 2),
+            "errors": (top["scheduler"]["errors"]
+                       + top["legacy"]["errors"]),
+        }
+        return {"service_curve": curve, "service_open_loop": open_loop,
+                "service_ab": ab,
+                "service_overload_boundary":
+                    _service_overload_boundary(curve)}
+
+    service = (journal.leg("service", _leg_service) or {}
+               if needs_primary else {})
+    split["service_s"] = journal.seconds("service")
+
+    # ---- fidelity audit leg 1 (BASELINE north star) + truth rates ------
+    def _leg_oracle():
+        disagreement, cpu_pps, _, fsrc = _oracle_audit(
+            ts, jax_matcher, traces, n_cpu)
+        truth = _truth_rates(ts, jax_matcher, traces, true_edges,
+                             n=min(2000, n_traces))
+        return {"disagreement": round(disagreement, 4),
+                "cpu_pps": cpu_pps, "source": fsrc, "truth": truth,
+                "near_tie": _near_tie_stats(jax_matcher, traces),
+                "audit_entry": {"traces": n_cpu,
+                                "disagreement": round(disagreement, 4),
+                                "fidelity_source": fsrc}}
+
+    oracle = (journal.leg("oracle", _leg_oracle) or {}
+              if needs_primary else {})
+    split["oracle_primary_s"] = journal.seconds("oracle")
+    cpu_pps = oracle.get("cpu_pps")
+    audit: dict = {}
+    if oracle:
+        audit[ts.name] = oracle["audit_entry"]
+
+    # ---- guaranteed-fresh rotation leg (VERDICT r4 weak #2/next #7) ----
+    def _leg_fresh():
+        rotf = _repo_path(".bench_fresh_rotation")
+        try:
+            with open(rotf) as f:
+                rot_k = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            rot_k = 0
+        with open(rotf, "w") as f:
+            f.write(str(rot_k + 1))
+        n_fresh = min(25, max(0, len(traces) - n_cpu))
+        if not n_fresh:     # tiny fallback fleets: the audited set
+            return {}       # covers it all
         span = max(1, len(traces) - n_cpu - n_fresh + 1)
         lo = n_cpu + (rot_k * n_fresh) % span
         fr_dis, _, fr_n, fr_src = _oracle_audit(
             ts, jax_matcher, traces[lo:lo + n_fresh], n_fresh,
             force_fresh=True)
-        audit[f"{ts.name}-fresh-rot"] = {
-            "traces": fr_n, "disagreement": round(fr_dis, 4),
-            "fidelity_source": fr_src, "rotation_index": rot_k,
-            "trace_window": [lo, lo + n_fresh]}
-    split["fresh_rotation_s"] = round(time.perf_counter() - t0, 1)
-    truth = _truth_rates(ts, jax_matcher, traces, true_edges,
-                         n=min(2000, n_traces))
+        return {"audit_key": f"{ts.name}-fresh-rot",
+                "audit_entry": {
+                    "traces": fr_n, "disagreement": round(fr_dis, 4),
+                    "fidelity_source": fr_src, "rotation_index": rot_k,
+                    "trace_window": [lo, lo + n_fresh]}}
+
+    fresh = (journal.leg("fresh_rotation", _leg_fresh) or {}
+             if needs_primary else {})
+    split["fresh_rotation_s"] = journal.seconds("fresh_rotation")
+    if fresh.get("audit_key"):
+        audit[fresh["audit_key"]] = fresh["audit_entry"]
 
     detail = {
-        "config": f"{n_traces}x{n_points}pt traces, tile={ts.name}",
-        "headline_tile": ts.name,
+        "config": (f"{n_traces}x{n_points}pt traces, "
+                   f"tile={ts.name if ts is not None else city}"),
+        "headline_tile": ts.name if ts is not None else city,
         "device": (str(jax.devices()[0]).split(":")[0] if tpu_ok
                    else "CPU (forced by REPORTER_BENCH_FORCE_CPU)"
                    if forced_cpu
                    else "CPU-FALLBACK (TPU tunnel unreachable)"),
-        "decode_only_probes_per_sec": round(decode_pps, 1),
-        "e2e_over_decode": round(jax_pps / decode_pps, 3),
-        "p50_single_trace_latency_ms": round(p50_latency * 1e3, 2),
-        "p50_matcher_only_ms": (round(p50_matcher_only * 1e3, 3)
-                                if p50_matcher_only is not None else None),
-        "link_rtt_ms": round(link_rtt * 1e3, 2),
-        "latency_note": (
-            "CPU fallback — no device link in play" if not tpu_ok
-            else "single-trace p50 is link-RTT-bound "
-                 "(remote-attached chip)"
-            if p50_latency < 4 * link_rtt + 5e-3
-            else "single-trace p50 is compute-bound"),
-        f"concurrent{n_conc}_combined_p50_ms": (
-            round(conc_p50 * 1e3, 2) if conc_p50 is not None else None),
-        f"concurrent{n_conc}_requests_per_sec": (
-            round(conc_rps, 1) if conc_rps is not None else None),
-        "service_curve": service_curve,
-        "service_ab": ab,
-        "service_open_loop": service_open_loop,
-        "service_overload_boundary": _service_overload_boundary(
-            service_curve),
-        **({"concurrent_errors": conc_errors[:4]} if conc_errors else {}),
-        "cpu_reference_probes_per_sec": round(cpu_pps, 1),
-        "oracle_sample_traces": n_cpu,
-        "segment_id_disagreement_vs_cpu_ref": round(disagreement, 4),
-        "near_tie": _near_tie_stats(jax_matcher, traces),
-        "ground_truth": truth,
-        "batch_seconds": round(dt_jax, 3),
-        "tile_source": tile_info["source"],
-        "tile_stats": ts.stats,
     }
+    if primary:
+        p50_latency = primary["p50_latency_s"]
+        p50_mo = primary.get("p50_matcher_only_s")
+        rtt_s = primary.get("link_rtt_s") or link_rtt
+        detail.update({
+            "decode_only_probes_per_sec": round(decode_pps, 1),
+            "e2e_over_decode": round(jax_pps / decode_pps, 3),
+            "p50_single_trace_latency_ms": round(p50_latency * 1e3, 2),
+            "p50_matcher_only_ms": (round(p50_mo * 1e3, 3)
+                                    if p50_mo is not None else None),
+            "link_rtt_ms": round(rtt_s * 1e3, 2),
+            "latency_note": (
+                "CPU fallback — no device link in play" if not tpu_ok
+                else "single-trace p50 is link-RTT-bound "
+                     "(remote-attached chip)"
+                if p50_latency < 4 * rtt_s + 5e-3
+                else "single-trace p50 is compute-bound"),
+            "batch_seconds": primary["batch_seconds"],
+        })
+    if service:
+        curve = service["service_curve"]
+        lvl16 = curve[0]["scheduler"]
+        n_conc = curve[0]["clients"]
+        conc_errors = [e for lvl in curve
+                       for arm in ("scheduler", "legacy")
+                       for e in lvl[arm].get("error_samples", [])]
+        detail.update({
+            f"concurrent{n_conc}_combined_p50_ms": lvl16["p50_ms"],
+            f"concurrent{n_conc}_requests_per_sec": lvl16["req_per_sec"],
+            "service_curve": curve,
+            "service_ab": service["service_ab"],
+            "service_open_loop": service["service_open_loop"],
+            "service_overload_boundary":
+                service["service_overload_boundary"],
+            **({"concurrent_errors": conc_errors[:4]}
+               if conc_errors else {}),
+        })
+    if oracle:
+        detail.update({
+            "cpu_reference_probes_per_sec": round(cpu_pps, 1),
+            "oracle_sample_traces": n_cpu,
+            "segment_id_disagreement_vs_cpu_ref": oracle["disagreement"],
+            "near_tie": oracle["near_tie"],
+            "ground_truth": oracle["truth"],
+        })
+    if ts is not None:
+        detail["tile_source"] = tile_info["source"]
+        detail["tile_stats"] = ts.stats
 
-    # Extra tiles (skipped in manual single-tile runs and in CPU fallback,
-    # where the grid-gather path would take minutes per tile).
-    if not manual and tpu_ok:
-        # -- metro scale (BASELINE config 3: bayarea tables in HBM) -------
-        t0 = time.perf_counter()
-        mts, mtile_info = _cached_tileset("bayarea")
-        mtraces, _ = _cached_fleet(mts, n_traces, n_points)
-        mm, m_pps, m_decode, _ = _throughput(mts, mtraces, repeats=3)
-        m_dis, _, m_n, m_src = _oracle_audit(mts, mm, mtraces, 100)
-        audit[mts.name] = {"traces": m_n, "disagreement": round(m_dis, 4),
-                           "fidelity_source": m_src}
-        detail["metro"] = {
-            "config": f"{len(mtraces)}x{n_points}pt traces, tile={mts.name}",
-            "probes_per_sec_e2e": round(m_pps, 1),
-            "decode_only_probes_per_sec": round(m_decode, 1),
-            "hbm_tile_bytes": int(mts.hbm_bytes()),
-            # round-8 satellite: every tile carries its co-located
-            # attribution so the headline table is link-mood-free
-            "device_compute": _device_compute_probe(mm, mtraces, link_rtt,
-                                                    roofline=False),
-            "tile_source": mtile_info["source"],
-            "tile_stats": mts.stats,
-        }
-        split["metro_s"] = round(time.perf_counter() - t0, 1)
-        del mts                 # matcher + fleet stay for the window-2
-        #                         same-mood re-measure below
+    # ---- extra tiles (full chip composites only) -----------------------
+    if full_run:
+        # -- metro scale (BASELINE config 3: bayarea tables in HBM) ------
+        def _leg_metro():
+            mts, mtile_info = _cached_tileset("bayarea")
+            mtraces, _ = _cached_fleet(mts, n_traces, n_points)
+            mm, m_pps, m_decode, _ = _throughput(mts, mtraces, repeats=3)
+            m_dis, _, m_n, m_src = _oracle_audit(mts, mm, mtraces, 100)
+            live["bayarea"] = (mm, mtraces, 5)
+            return {
+                "audit_key": mts.name,
+                "audit_entry": {"traces": m_n,
+                                "disagreement": round(m_dis, 4),
+                                "fidelity_source": m_src},
+                "block": {
+                    "config": (f"{len(mtraces)}x{n_points}pt traces, "
+                               f"tile={mts.name}"),
+                    "probes_per_sec_e2e": round(m_pps, 1),
+                    "decode_only_probes_per_sec": round(m_decode, 1),
+                    "hbm_tile_bytes": int(mts.hbm_bytes()),
+                    # round-8 satellite: every tile carries its
+                    # co-located attribution so the headline table is
+                    # link-mood-free
+                    "device_compute": _device_compute_probe(
+                        mm, mtraces, link_rtt, roofline=False),
+                    "tile_source": mtile_info["source"],
+                    "tile_stats": mts.stats,
+                }}
 
-        # -- restrictions on (VERDICT r2 #5: realistic ban density) -------
-        t0 = time.perf_counter()
-        rts, rtile_info = _cached_tileset("sf", restricted=True)
-        # same fleet size as the primary: throughput_vs_unrestricted must
-        # isolate the restriction cost, not the batch-overlap difference
-        rtraces, _ = _cached_fleet(rts, n_traces, n_points)
-        # repeats must MATCH the primary's: best-of-5 vs best-of-3 would
-        # bias the ratio below 1 on a ~2x-noise link regardless of cost
-        rm, r_pps, r_decode, _ = _throughput(rts, rtraces, repeats=5)
-        r_dis, _, r_n, r_src = _oracle_audit(rts, rm, rtraces, 150)
-        audit[rts.name] = {"traces": r_n, "disagreement": round(r_dis, 4),
-                           "fidelity_source": r_src}
-        detail["restricted"] = {
-            "config": (f"{len(rtraces)}x{n_points}pt traces, tile={rts.name}"
-                       f" ({int(_RESTRICT_FRACTION * 100)}% junction"
-                       " restriction density)"),
-            "probes_per_sec_e2e": round(r_pps, 1),
-            "decode_only_probes_per_sec": round(r_decode, 1),
-            "throughput_vs_unrestricted": round(r_pps / jax_pps, 3),
-            "reach_rows_growth": round(
-                rts.reach_to.shape[0] / max(ts.reach_to.shape[0], 1), 3),
-            "device_compute": _device_compute_probe(rm, rtraces, link_rtt,
-                                                    roofline=False),
-            "tile_source": rtile_info["source"],
-            "tile_stats": rts.stats,
-        }
-        split["restricted_s"] = round(time.perf_counter() - t0, 1)
-        del rts
+        metro = journal.leg("metro", _leg_metro)
+        if metro:
+            detail["metro"] = metro["block"]
+            audit[metro["audit_key"]] = metro["audit_entry"]
+        split["metro_s"] = journal.seconds("metro")
 
-        # -- realistic-scale HBM envelope (SURVEY §7 "HBM budget") --------
-        # bayarea-xl: ~0.5M directed edges. No oracle leg (the exact-
-        # Dijkstra memo is minutes/trace at this graph size); fidelity is
-        # audited on the three tiles above — this block proves staging,
-        # culling, and throughput at real-metro scale, and records the
-        # replicated-vs-sharded capacity plan.
-        t0 = time.perf_counter()
-        from reporter_tpu.tiles.capacity import plan_staging
+        # -- restrictions on (VERDICT r2 #5: realistic ban density) ------
+        def _leg_restricted():
+            rts, rtile_info = _cached_tileset("sf", restricted=True)
+            # same fleet size as the primary: throughput_vs_unrestricted
+            # must isolate the restriction cost; repeats must MATCH the
+            # primary's (best-of-5) or the ratio biases on a ~2x link
+            rtraces, _ = _cached_fleet(rts, n_traces, n_points)
+            rm, r_pps, r_decode, _ = _throughput(rts, rtraces, repeats=5)
+            r_dis, _, r_n, r_src = _oracle_audit(rts, rm, rtraces, 150)
+            live["sf+r"] = (rm, rtraces, 3)
+            return {
+                "audit_key": rts.name,
+                "audit_entry": {"traces": r_n,
+                                "disagreement": round(r_dis, 4),
+                                "fidelity_source": r_src},
+                "block": {
+                    "config": (f"{len(rtraces)}x{n_points}pt traces, "
+                               f"tile={rts.name} "
+                               f"({int(_RESTRICT_FRACTION * 100)}% "
+                               "junction restriction density)"),
+                    "probes_per_sec_e2e": round(r_pps, 1),
+                    "decode_only_probes_per_sec": round(r_decode, 1),
+                    "throughput_vs_unrestricted": (
+                        round(r_pps / jax_pps, 3) if jax_pps else None),
+                    "reach_rows_growth": round(
+                        rts.reach_to.shape[0]
+                        / max(ts.reach_to.shape[0], 1), 3),
+                    "device_compute": _device_compute_probe(
+                        rm, rtraces, link_rtt, roofline=False),
+                    "tile_source": rtile_info["source"],
+                    "tile_stats": rts.stats,
+                }}
 
-        xts, xtile_info = _cached_tileset("bayarea-xl")
-        xtraces, xtrue = _cached_fleet(xts, 4000, n_points)
-        xm, x_pps, x_decode, _ = _throughput(xts, xtraces, repeats=3)
-        plan = plan_staging(xts)
-        detail["xl"] = {
-            "config": f"{len(xtraces)}x{n_points}pt traces, tile={xts.name}",
-            "probes_per_sec_e2e": round(x_pps, 1),
-            "decode_only_probes_per_sec": round(x_decode, 1),
-            "hbm_tile_bytes": int(xts.hbm_bytes()),
-            "staging_plan": plan.to_json(),
-            # output-sensitivity check: decode slowdown vs sf should stay
-            # far below the edge-count ratio (bbox culling working)
-            "culling": {
-                "edges_vs_sf": round(xts.num_edges / ts.num_edges, 1),
-                "decode_slowdown_vs_sf": round(decode_pps / x_decode, 1),
-            },
-            # VERDICT r3 #5: xl fidelity WITHOUT the (impractical) exact
-            # oracle — synthesis ground truth at 91x sf's edges, plus the
-            # reach-table miss rate where 85% of nodes are truncated
-            "ground_truth": _truth_rates(xts, xm, xtraces, xtrue, n=1000),
-            "reach_audit": _reach_audit_cached(
-                xts, [np.asarray(t.xy, np.float64) for t in xtraces[:15]],
-                label=xts.name),
-            # VERDICT r4 next #3: attribute the xl slowdown — device sweep
-            # vs readback vs host walk vs submit, plus the sweep roofline
-            "device_compute": _device_compute_probe(xm, xtraces, link_rtt),
-            # round-8 tentpole evidence at metro-xl scale: kernel-lever
-            # A/B (subcull / whole-block / mxu) in interleaved windows +
-            # on-chip byte-identity of the three result wires
-            "sweep_ab": _sweep_variants_probe(xm, xtraces, link_rtt),
-            "tile_source": xtile_info["source"],
-            "tile_stats": xts.stats,
-        }
-        split["xl_s"] = round(time.perf_counter() - t0, 1)
-        del xts                 # (host RAM is ample; HBM holds every
-        #                         tile's tables at once — xl's plan says so)
+        restricted = journal.leg("restricted", _leg_restricted)
+        if restricted:
+            detail["restricted"] = restricted["block"]
+            audit[restricted["audit_key"]] = restricted["audit_entry"]
+        split["restricted_s"] = journal.seconds("restricted")
 
-        # -- organic topology (VERDICT r4 #3: every prior perf/fidelity
-        # number came from jittered grids; this tile is a radial metro
-        # with mixed degrees, 30 m-2 km edges, dead ends and a limited-
-        # access spine — netgen/organic.py) --------------------------------
-        t0 = time.perf_counter()
-        ots, otile_info = _cached_tileset("organic")
-        otraces, otrue = _cached_fleet(ots, 8000, n_points)
-        om, o_pps, o_decode, _ = _throughput(ots, otraces, repeats=3)
-        o_dis, _, o_n, o_src = _oracle_audit(ots, om, otraces, 80)
-        audit[ots.name] = {"traces": o_n, "disagreement": round(o_dis, 4),
-                           "fidelity_source": o_src}
-        # VERDICT r4 weak #6: put the residual's attribution in the
-        # ARTIFACT. (a) near-tie density: the population of points whose
-        # distinct-road candidate gap is f32-flippable, organic vs sf;
-        # (b) K-escalation: if the residual were tied-candidate overflow
-        # (the r4 root cause, since fixed), widening K would shrink it.
-        import dataclasses as _dc
+        # -- realistic-scale HBM envelope (SURVEY §7 "HBM budget"):
+        # bayarea-xl, ~0.5M directed edges; no oracle leg (exact
+        # Dijkstra is minutes/trace at this size) — staging, culling,
+        # throughput + the replicated-vs-sharded plan ---------------------
+        def _leg_xl():
+            from reporter_tpu.tiles.capacity import plan_staging
 
-        from reporter_tpu.config import Config as _Config2
-        from reporter_tpu.config import MatcherParams as _MP
+            xts, xtile_info = _cached_tileset("bayarea-xl")
+            xtraces, xtrue = _cached_fleet(xts, 4000, n_points)
+            xm, x_pps, x_decode, _ = _throughput(xts, xtraces, repeats=3)
+            plan = plan_staging(xts)
+            live["bayarea-xl"] = (xm, xtraces, 5)
+            return {"block": {
+                "config": (f"{len(xtraces)}x{n_points}pt traces, "
+                           f"tile={xts.name}"),
+                "probes_per_sec_e2e": round(x_pps, 1),
+                "decode_only_probes_per_sec": round(x_decode, 1),
+                "hbm_tile_bytes": int(xts.hbm_bytes()),
+                "staging_plan": plan.to_json(),
+                # output-sensitivity check: decode slowdown vs sf should
+                # stay far below the edge-count ratio (culling working)
+                "culling": {
+                    "edges_vs_sf": round(xts.num_edges / ts.num_edges, 1),
+                    "decode_slowdown_vs_sf": (
+                        round(decode_pps / x_decode, 1)
+                        if decode_pps else None),
+                },
+                # VERDICT r3 #5: xl fidelity via synthesis ground truth
+                # + the reach-table miss rate (no exact oracle)
+                "ground_truth": _truth_rates(xts, xm, xtraces, xtrue,
+                                             n=1000),
+                "reach_audit": _reach_audit_cached(
+                    xts, [np.asarray(t.xy, np.float64)
+                          for t in xtraces[:15]], label=xts.name),
+                # VERDICT r4 next #3: attribute the xl slowdown
+                "device_compute": _device_compute_probe(xm, xtraces,
+                                                        link_rtt),
+                # round-8 tentpole evidence at metro-xl scale
+                "sweep_ab": _sweep_variants_probe(xm, xtraces, link_rtt),
+                "tile_source": xtile_info["source"],
+                "tile_stats": xts.stats,
+            }}
 
-        cfg12 = _Config2(matcher_backend="jax",
-                         matcher=_dc.replace(_MP(), max_candidates=12))
-        om12 = SegmentMatcher(ots, cfg12)
-        o12_dis, _, _, o12_src = _oracle_audit(ots, om12, otraces, 80,
-                                               config=cfg12)
-        detail["organic_residual_attribution"] = {
-            "near_tie": _near_tie_stats(om, otraces),
-            "near_tie_sf": detail["near_tie"],
-            "disagreement_k8": round(o_dis, 4),
-            "disagreement_k12": round(o12_dis, 4),
-            "k12_fidelity_source": o12_src,
-            "note": ("K-escalation probes tied-candidate overflow; the "
-                     "near-tie fractions bound the f32-flippable "
-                     "population the prose attributes the residual to"),
-        }
-        del om12
-        detail["organic"] = {
-            "config": f"{len(otraces)}x{n_points}pt traces, tile={ots.name}",
-            "probes_per_sec_e2e": round(o_pps, 1),
-            "decode_only_probes_per_sec": round(o_decode, 1),
-            "throughput_vs_sf": round(o_pps / jax_pps, 3),
-            "ground_truth": _truth_rates(ots, om, otraces, otrue, n=1000),
-            "reach_audit": _reach_audit_cached(
-                ots, [np.asarray(t.xy, np.float64) for t in otraces[:20]],
-                label=ots.name),
-            "device_compute": _device_compute_probe(om, otraces, link_rtt,
-                                                    roofline=False),
-            "tile_source": otile_info["source"],
-            "tile_stats": ots.stats,
-        }
-        split["organic_s"] = round(time.perf_counter() - t0, 1)
-        del ots
+        xl = journal.leg("xl", _leg_xl)
+        if xl:
+            detail["xl"] = xl["block"]
+        split["xl_s"] = journal.seconds("xl")
 
-        # -- organic at several-times-metro scale: does the irregular-
-        # topology story hold as the map grows? (~32k nodes / 152k
-        # directed edges, 3.4 km max edges; ground truth + reach audit,
-        # no oracle — same policy as bayarea-xl) ---------------------------
-        t0 = time.perf_counter()
-        oxts, oxtile_info = _cached_tileset("organic-xl")
-        oxtraces, oxtrue = _cached_fleet(oxts, 4000, n_points)
-        oxm, ox_pps, ox_decode, _ = _throughput(oxts, oxtraces, repeats=3)
-        detail["organic_xl"] = {
-            "config": f"{len(oxtraces)}x{n_points}pt traces, "
-                      f"tile={oxts.name}",
-            "probes_per_sec_e2e": round(ox_pps, 1),
-            "decode_only_probes_per_sec": round(ox_decode, 1),
-            "ground_truth": _truth_rates(oxts, oxm, oxtraces, oxtrue,
-                                         n=1000),
-            "reach_audit": _reach_audit_cached(
-                oxts, [np.asarray(t.xy, np.float64)
-                       for t in oxtraces[:8]], label=oxts.name),
-            "device_compute": _device_compute_probe(oxm, oxtraces,
-                                                    link_rtt),
-            "tile_source": oxtile_info["source"],
-            "tile_stats": oxts.stats,
-        }
-        split["organic_xl_s"] = round(time.perf_counter() - t0, 1)
-        del oxts
+        # -- organic topology (VERDICT r4 #3) + residual attribution -----
+        def _leg_organic():
+            import dataclasses as _dc
 
-        # -- non-auto mode fidelity (VERDICT r4 #7): bicycle profile on a
-        # mixed-access sf, audited against the same oracle under the same
-        # bicycle presets ---------------------------------------------------
-        t0 = time.perf_counter()
-        from reporter_tpu.config import Config as _Cfg
+            from reporter_tpu.config import Config as _Config2
+            from reporter_tpu.config import MatcherParams as _MP
 
-        bts, btile_info = _cached_mode_tileset()
-        btraces, _ = _cached_fleet(bts, 2000, n_points)
-        bcfg = _Cfg.for_mode("bicycle", matcher_backend="jax")
-        bm = SegmentMatcher(bts, bcfg)
-        b_dis, _, b_n, b_src = _oracle_audit(
-            bts, bm, btraces, 60, config=bcfg)
-        audit[bts.name] = {"traces": b_n, "disagreement": round(b_dis, 4),
-                           "fidelity_source": b_src, "mode": "bicycle"}
-        detail["bicycle"] = {
-            "config": (f"{b_n} oracle traces, tile={bts.name} "
-                       "(8% bike-only / 5% foot-only ways)"),
-            "tile_source": btile_info["source"],
-            "tile_stats": bts.stats,
-        }
-        split["bicycle_s"] = round(time.perf_counter() - t0, 1)
-        del bm, bts, btraces
+            ots, otile_info = _cached_tileset("organic")
+            otraces, otrue = _cached_fleet(ots, 8000, n_points)
+            om, o_pps, o_decode, _ = _throughput(ots, otraces, repeats=3)
+            o_dis, _, o_n, o_src = _oracle_audit(ots, om, otraces, 80)
+            cfg12 = _Config2(matcher_backend="jax",
+                             matcher=_dc.replace(_MP(),
+                                                 max_candidates=12))
+            om12 = SegmentMatcher(ots, cfg12)
+            o12_dis, _, _, o12_src = _oracle_audit(ots, om12, otraces,
+                                                   80, config=cfg12)
+            del om12
+            live["organic"] = (om, otraces, 5)
+            return {
+                "audit_key": ots.name,
+                "audit_entry": {"traces": o_n,
+                                "disagreement": round(o_dis, 4),
+                                "fidelity_source": o_src},
+                # VERDICT r4 weak #6: the residual's attribution in the
+                # ARTIFACT — near-tie density + K-escalation
+                "residual_attribution": {
+                    "near_tie": _near_tie_stats(om, otraces),
+                    "near_tie_sf": oracle.get("near_tie"),
+                    "disagreement_k8": round(o_dis, 4),
+                    "disagreement_k12": round(o12_dis, 4),
+                    "k12_fidelity_source": o12_src,
+                    "note": ("K-escalation probes tied-candidate "
+                             "overflow; the near-tie fractions bound "
+                             "the f32-flippable population the prose "
+                             "attributes the residual to"),
+                },
+                "block": {
+                    "config": (f"{len(otraces)}x{n_points}pt traces, "
+                               f"tile={ots.name}"),
+                    "probes_per_sec_e2e": round(o_pps, 1),
+                    "decode_only_probes_per_sec": round(o_decode, 1),
+                    "throughput_vs_sf": (round(o_pps / jax_pps, 3)
+                                         if jax_pps else None),
+                    "ground_truth": _truth_rates(ots, om, otraces,
+                                                 otrue, n=1000),
+                    "reach_audit": _reach_audit_cached(
+                        ots, [np.asarray(t.xy, np.float64)
+                              for t in otraces[:20]], label=ots.name),
+                    "device_compute": _device_compute_probe(
+                        om, otraces, link_rtt, roofline=False),
+                    "tile_source": otile_info["source"],
+                    "tile_stats": ots.stats,
+                }}
 
-        audit_total = sum(v["traces"] for v in audit.values())
-        detail["audit"] = {"total_traces": audit_total, "per_tile": audit}
+        organic = journal.leg("organic", _leg_organic)
+        if organic:
+            detail["organic"] = organic["block"]
+            detail["organic_residual_attribution"] = \
+                organic["residual_attribution"]
+            audit[organic["audit_key"]] = organic["audit_entry"]
+        split["organic_s"] = journal.seconds("organic")
 
-        # -- streaming path (BASELINE config 5) ----------------------------
-        # detail.streaming = the COLUMNAR worker (the firehose deployment
-        # shape, r5); the dict worker stays as streaming_dict for the
-        # compat surface. Best of two full pumps: a single multi-second
-        # link stall inside one flush wave once recorded 2.1k pps for a
-        # leg that otherwise reads 50-65k — same best-of-N as every tile.
-        t0 = time.perf_counter()
-        s_runs = [_streaming_columnar_bench(ts, traces, n_stream=2000)
-                  for _ in range(2)]
-        detail["streaming"] = max(s_runs,
-                                  key=lambda r: r["probes_per_sec"])
-        detail["streaming"]["runs_pps"] = [r["probes_per_sec"]
-                                           for r in s_runs]
-        sd_runs = [_streaming_bench(ts, traces, n_stream=2000)
-                   for _ in range(2)]
-        detail["streaming_dict"] = max(sd_runs,
-                                       key=lambda r: r["probes_per_sec"])
-        detail["streaming_dict"]["runs_pps"] = [r["probes_per_sec"]
-                                                for r in sd_runs]
-        w2_runs = [_streaming_two_workers(ts, traces, n_stream=2000)
-                   for _ in range(2)]
-        detail["streaming_2workers"] = max(
-            w2_runs, key=lambda r: r["probes_per_sec"])
-        detail["streaming_2workers"]["runs_pps"] = [
-            r["probes_per_sec"] for r in w2_runs]
-        split["streaming_s"] = round(time.perf_counter() - t0, 1)
+        # -- organic at several-times-metro scale ------------------------
+        def _leg_organic_xl():
+            oxts, oxtile_info = _cached_tileset("organic-xl")
+            oxtraces, oxtrue = _cached_fleet(oxts, 4000, n_points)
+            oxm, ox_pps, ox_decode, _ = _throughput(oxts, oxtraces,
+                                                    repeats=3)
+            live["organic-xl"] = (oxm, oxtraces, 5)
+            return {"block": {
+                "config": (f"{len(oxtraces)}x{n_points}pt traces, "
+                           f"tile={oxts.name}"),
+                "probes_per_sec_e2e": round(ox_pps, 1),
+                "decode_only_probes_per_sec": round(ox_decode, 1),
+                "ground_truth": _truth_rates(oxts, oxm, oxtraces,
+                                             oxtrue, n=1000),
+                "reach_audit": _reach_audit_cached(
+                    oxts, [np.asarray(t.xy, np.float64)
+                           for t in oxtraces[:8]], label=oxts.name),
+                "device_compute": _device_compute_probe(oxm, oxtraces,
+                                                        link_rtt),
+                "tile_source": oxtile_info["source"],
+                "tile_stats": oxts.stats,
+            }}
 
-        # -- streaming capacity grid (r6 tentpole): offer × wave curve the
-        # soak's operating point is chosen from --------------------------
-        t0 = time.perf_counter()
-        detail["streaming_capacity"] = _streaming_capacity(ts, traces,
-                                                           n_stream=2000)
-        split["streaming_capacity_s"] = round(time.perf_counter() - t0, 1)
+        organic_xl = journal.leg("organic_xl", _leg_organic_xl)
+        if organic_xl:
+            detail["organic_xl"] = organic_xl["block"]
+        split["organic_xl_s"] = journal.seconds("organic_xl")
 
-        # -- streaming soak (VERDICT r5 missing #1): ≥30 s held 100k
-        # offer, pipelined worker, end lag drained to 0 -------------------
-        t0 = time.perf_counter()
-        detail["streaming_soak"] = _streaming_soak(ts, traces,
-                                                   n_stream=2000)
-        split["streaming_soak_s"] = round(time.perf_counter() - t0, 1)
+        # -- non-auto mode fidelity (VERDICT r4 #7) ----------------------
+        def _leg_bicycle():
+            from reporter_tpu.config import Config as _Cfg
 
-        # -- latency attribution (ISSUE 5 tentpole): per-stage
-        # probe→report decomposition at the held soak offer, reconciled
-        # against the measured e2e p50, + the tracing-overhead A/B and
-        # the service-face decomposition -----------------------------------
-        t0 = time.perf_counter()
-        detail["latency_attribution"] = _latency_attribution(
-            ts, traces, n_stream=2000, offered_pps=100_000)
-        split["latency_attribution_s"] = round(time.perf_counter() - t0, 1)
+            bts, btile_info = _cached_mode_tileset()
+            btraces, _ = _cached_fleet(bts, 2000, n_points)
+            bcfg = _Cfg.for_mode("bicycle", matcher_backend="jax")
+            bm = SegmentMatcher(bts, bcfg)
+            b_dis, _, b_n, b_src = _oracle_audit(
+                bts, bm, btraces, 60, config=bcfg)
+            return {
+                "audit_key": bts.name,
+                "audit_entry": {"traces": b_n,
+                                "disagreement": round(b_dis, 4),
+                                "fidelity_source": b_src,
+                                "mode": "bicycle"},
+                "block": {
+                    "config": (f"{b_n} oracle traces, tile={bts.name} "
+                               "(8% bike-only / 5% foot-only ways)"),
+                    "tile_source": btile_info["source"],
+                    "tile_stats": bts.stats,
+                }}
 
-        # -- overload soak (VERDICT r5 missing #2): 2× the sustainable
-        # rate against a bounded broker, counted shedding -----------------
-        t0 = time.perf_counter()
-        detail["streaming_overload"] = _streaming_overload(
-            ts, traces, 2000,
-            max(detail["streaming_soak"]["sustained_pps"],
-                detail["streaming_capacity"]["best_held_pps"]))
-        split["streaming_overload_s"] = round(time.perf_counter() - t0, 1)
+        bicycle = journal.leg("bicycle", _leg_bicycle)
+        if bicycle:
+            detail["bicycle"] = bicycle["block"]
+            audit[bicycle["audit_key"]] = bicycle["audit_entry"]
+        split["bicycle_s"] = journal.seconds("bicycle")
 
-        # -- chaos legs (ISSUE 4): fault-injected publisher outage,
-        # kill-and-recover at soak scale (real subprocess SIGKILL), live
-        # 2-process consumer group over one durable broker ----------------
-        _run_chaos_legs(ts, traces, detail, split)
+        # -- streaming path (BASELINE config 5) --------------------------
+        def _leg_streaming():
+            # detail.streaming = the COLUMNAR worker (the firehose
+            # deployment shape, r5); dict worker stays as
+            # streaming_dict for the compat surface. Best of two full
+            # pumps (a single link stall once recorded 2.1k pps for a
+            # leg that otherwise reads 50-65k).
+            out = {}
+            s_runs = [_streaming_columnar_bench(ts, traces,
+                                                n_stream=2000)
+                      for _ in range(2)]
+            out["streaming"] = max(s_runs,
+                                   key=lambda r: r["probes_per_sec"])
+            out["streaming"]["runs_pps"] = [r["probes_per_sec"]
+                                            for r in s_runs]
+            sd_runs = [_streaming_bench(ts, traces, n_stream=2000)
+                       for _ in range(2)]
+            out["streaming_dict"] = max(
+                sd_runs, key=lambda r: r["probes_per_sec"])
+            out["streaming_dict"]["runs_pps"] = [r["probes_per_sec"]
+                                                 for r in sd_runs]
+            w2_runs = [_streaming_two_workers(ts, traces, n_stream=2000)
+                       for _ in range(2)]
+            out["streaming_2workers"] = max(
+                w2_runs, key=lambda r: r["probes_per_sec"])
+            out["streaming_2workers"]["runs_pps"] = [
+                r["probes_per_sec"] for r in w2_runs]
+            return out
 
-        # -- device-only compute (VERDICT r4 #6): makes the "link-bound,
-        # not chip-bound" claim a measured field. Best of two probes:
-        # the submit leg enqueues the infeed over the link, so a stalled
-        # window inflates it ~2x. --------------------------------------
-        t0 = time.perf_counter()
-        d_runs = [_device_compute_probe(jax_matcher, traces, link_rtt)
-                  for _ in range(2)]
-        detail["device_compute"] = max(
-            d_runs, key=lambda r: r["colocated_probes_per_sec"])
-        detail["device_compute"]["runs_colocated_pps"] = [
-            r["colocated_probes_per_sec"] for r in d_runs]
-        split["device_compute_s"] = round(time.perf_counter() - t0, 1)
+        streaming = journal.leg("streaming", _leg_streaming)
+        if streaming:
+            detail.update(streaming)
+        split["streaming_s"] = journal.seconds("streaming")
 
-        # -- round-8 tentpole: sf kernel-lever A/B (same probe as xl's) --
-        t0 = time.perf_counter()
-        detail["sweep_ab"] = _sweep_variants_probe(jax_matcher, traces,
-                                                   link_rtt)
-        split["sweep_ab_s"] = round(time.perf_counter() - t0, 1)
+        # -- streaming capacity grid (r6 tentpole) -----------------------
+        cap = journal.leg("streaming_capacity",
+                          lambda: _streaming_capacity(ts, traces,
+                                                      n_stream=2000))
+        if cap:
+            detail["streaming_capacity"] = cap
+        split["streaming_capacity_s"] = journal.seconds(
+            "streaming_capacity")
 
-        # -- per-tile co-located e2e (round-8 satellite): the README's
-        # headline table — device-only pipeline bound per tile, no remote
-        # link in the denominator, so the number is free of the link's
-        # ~2x mood swings --------------------------------------------------
+        # -- streaming soak (VERDICT r5 missing #1) ----------------------
+        soak = journal.leg("streaming_soak",
+                           lambda: _streaming_soak(ts, traces,
+                                                   n_stream=2000))
+        if soak:
+            detail["streaming_soak"] = soak
+        split["streaming_soak_s"] = journal.seconds("streaming_soak")
+
+    # -- latency attribution (ISSUE 5 tentpole) runs on EVERY composite:
+    # the reconciled per-stage decomposition + the tracing-overhead A/B —
+    # scaled down off-chip so one core serving producer+consumer stays
+    # honest -------------------------------------------------------------
+    def _leg_lattr():
+        if full_run:
+            return _latency_attribution(ts, traces, n_stream=2000,
+                                        offered_pps=100_000)
+        return _latency_attribution(
+            ts, traces, n_stream=min(500, len(traces)),
+            offered_pps=(50_000 if tpu_ok else 2_000), seconds=5.0)
+
+    lattr = (journal.leg("latency_attribution", _leg_lattr)
+             if needs_primary else None)
+    if lattr:
+        detail["latency_attribution"] = lattr
+    split["latency_attribution_s"] = journal.seconds(
+        "latency_attribution")
+
+    if full_run:
+        # -- overload soak (VERDICT r5 missing #2): 2x the sustainable
+        # rate against a bounded broker, counted shedding ----------------
+        def _leg_overload():
+            sustainable = max(
+                (detail.get("streaming_soak") or {}).get(
+                    "sustained_pps") or 0.0,
+                (detail.get("streaming_capacity") or {}).get(
+                    "best_held_pps") or 0.0)
+            return _streaming_overload(ts, traces, 2000, sustainable)
+
+        overload = journal.leg("streaming_overload", _leg_overload)
+        if overload:
+            detail["streaming_overload"] = overload
+        split["streaming_overload_s"] = journal.seconds(
+            "streaming_overload")
+
+    # -- chaos legs (ISSUE 4): publisher outage, kill-and-recover, live
+    # 2-process consumer group — chip composites always; CPU/manual runs
+    # opt in via REPORTER_BENCH_CHAOS=1 ----------------------------------
+    def _leg_chaos():
+        d: dict = {}
+        s: dict = {}
+        _run_chaos_legs(ts, traces, d, s)
+        return {"detail": d, "split": s}
+
+    if ts is not None and (full_run or env_flag(
+            os.environ.get("REPORTER_BENCH_CHAOS"))):
+        chaos = journal.leg("chaos", _leg_chaos)
+        if chaos:
+            detail.update(chaos["detail"])
+            split.update(chaos["split"])
+
+    if full_run:
+        # -- device-only compute (VERDICT r4 #6): best of two probes ----
+        def _leg_device_compute():
+            d_runs = [_device_compute_probe(jax_matcher, traces,
+                                            link_rtt)
+                      for _ in range(2)]
+            best = max(d_runs,
+                       key=lambda r: r["colocated_probes_per_sec"])
+            best["runs_colocated_pps"] = [
+                r["colocated_probes_per_sec"] for r in d_runs]
+            return best
+
+        dc = journal.leg("device_compute", _leg_device_compute)
+        if dc:
+            detail["device_compute"] = dc
+        split["device_compute_s"] = journal.seconds("device_compute")
+
+    # -- sweep-kernel three-arm A/B: on-chip interleaved probe for chip
+    # composites; pallas-interpreter validation (identity bits only) on
+    # every no-chip composite — self-contained there, so
+    # `--legs sweep_ab` fits a short window -------------------------------
+    def _leg_sweep_ab():
+        if full_run:
+            return _sweep_variants_probe(jax_matcher, traces, link_rtt)
+        return _sweep_ab_cpu_validate()
+
+    sweep = journal.leg("sweep_ab", _leg_sweep_ab)
+    if sweep:
+        detail["sweep_ab"] = sweep
+    split["sweep_ab_s"] = journal.seconds("sweep_ab")
+
+    if full_run:
+        # -- per-tile co-located e2e (round-8 satellite): derived from
+        # the assembled detail, not journaled ---------------------------
         detail["colocated_e2e"] = {
             name: blk["device_compute"]["colocated_e2e_probes_per_sec"]
             for name, blk in (("sf", detail),
-                              ("bayarea", detail["metro"]),
-                              ("sf+r", detail["restricted"]),
-                              ("bayarea-xl", detail["xl"]),
-                              ("organic", detail["organic"]),
-                              ("organic-xl", detail["organic_xl"]))
+                              ("bayarea", detail.get("metro", {})),
+                              ("sf+r", detail.get("restricted", {})),
+                              ("bayarea-xl", detail.get("xl", {})),
+                              ("organic", detail.get("organic", {})),
+                              ("organic-xl",
+                               detail.get("organic_xl", {})))
             if blk.get("device_compute", {}).get(
                 "colocated_e2e_probes_per_sec") is not None}
 
-        # Re-measure EVERY tile back-to-back in a SECOND mood window
-        # (~15 min after the first): the link's throughput swings ~1.5-2x
-        # over minutes, so window-1 blocks measured minutes apart sit in
-        # different moods and their ratios mix them (round-4 run 1: the
-        # primary's trough window made the restriction cost look like 40%
-        # when the same-mood ratio is ~12%). Per-tile published number =
-        # best of the two windows (still an honest best-of-N); every
-        # cross-tile RATIO divides two measurements from THIS one window.
-        t0 = time.perf_counter()
-        rtt2 = _link_rtt()      # per-window link mood, recorded with the
-        #                         window it conditions (VERDICT r3 weak #4)
-        w2: dict = {"link_rtt_ms": round(rtt2 * 1e3, 2)}
-        # Window-2 repeats top every tile's cumulative draws up to the
-        # SAME count (8): best-of over unequal sample counts would bias
-        # every cross-tile ratio on a ~2x-noise link (window 1 ran sf and
-        # sf+r at best-of-5, the rest at best-of-3).
-        pairs = [("sf", jax_matcher, traces, 3), ("bayarea", mm, mtraces, 5),
-                 ("sf+r", rm, rtraces, 3), ("bayarea-xl", xm, xtraces, 5),
-                 ("organic", om, otraces, 5),
-                 ("organic-xl", oxm, oxtraces, 5)]
-        w2_pps: dict = {}
-        w2_dec: dict = {}
-        for name, mobj, mtr, reps in pairs:
-            dt2, dt_dec2 = _timed_pair(mobj, mtr, reps)
-            p = sum(len(t.xy) for t in mtr)
-            w2_pps[name], w2_dec[name] = p / dt2, p / dt_dec2
-            w2[name] = {"probes_per_sec_e2e": round(p / dt2, 1),
-                        "decode_only_probes_per_sec": round(p / dt_dec2, 1)}
-        detail["second_window"] = w2
-        # One selection rule for EVERY tile: the window whose e2e won
-        # supplies BOTH that tile's published e2e and decode numbers, so
-        # each tile's pair is mood-consistent and derived ratios divide
-        # same-rule metrics.
-        if w2_pps["sf"] > jax_pps:
-            jax_pps, decode_pps = w2_pps["sf"], w2_dec["sf"]
-            detail["decode_only_probes_per_sec"] = round(decode_pps, 1)
-            detail["e2e_over_decode"] = round(jax_pps / decode_pps, 3)
-            detail["batch_seconds"] = round(
-                n_traces * n_points / jax_pps, 3)
-        for name, key in (("bayarea", "metro"), ("sf+r", "restricted"),
-                          ("bayarea-xl", "xl"), ("organic", "organic"),
-                          ("organic-xl", "organic_xl")):
-            if w2_pps[name] > detail[key]["probes_per_sec_e2e"]:
-                detail[key]["probes_per_sec_e2e"] = round(w2_pps[name], 1)
-                detail[key]["decode_only_probes_per_sec"] = round(
-                    w2_dec[name], 1)
-        # Cross-tile ratios divide the PUBLISHED (best-of-both-windows)
-        # numbers: the link's mood swings ~2x second-to-second (run logs
-        # show sf at 937k and sf+r at 1.20M seconds apart in ONE window),
-        # so single-pass same-mood ratios are noise; best-of-N converges
-        # on the true rate per tile, and ratios of bests estimate the
-        # true ratio. Effects smaller than the residual noise floor
-        # (~±10% at N=5+3... reps) are not resolvable — noted inline.
-        detail["restricted"]["throughput_vs_unrestricted"] = round(
-            detail["restricted"]["probes_per_sec_e2e"] / jax_pps, 3)
-        detail["organic"]["throughput_vs_sf"] = round(
-            detail["organic"]["probes_per_sec_e2e"] / jax_pps, 3)
-        detail["xl"]["culling"]["decode_slowdown_vs_sf"] = round(
-            decode_pps / detail["xl"]["decode_only_probes_per_sec"], 1)
-        detail["ratio_note"] = ("ratios divide best-of-8-draws numbers "
-                                "(equal draw counts per tile, window-"
-                                "paired e2e/decode); link noise ~2x "
-                                "dominates effects under ~10%")
-        split["window2_s"] = round(time.perf_counter() - t0, 1)
+        # -- second mood window (round-4 discipline): re-measure every
+        # tile measured FRESH this run back-to-back; journal-resumed
+        # tiles keep their window-1 numbers -------------------------------
+        def _leg_window2():
+            rtt2 = _link_rtt()      # per-window link mood, recorded
+            #                         with the window it conditions
+            w2: dict = {"link_rtt_ms": round(rtt2 * 1e3, 2)}
+            for name, (mobj, mtr, reps) in live.items():
+                dt2, dt_dec2 = _timed_pair(mobj, mtr, reps)
+                p = sum(len(t.xy) for t in mtr)
+                w2[name] = {
+                    "probes_per_sec_e2e": round(p / dt2, 1),
+                    "decode_only_probes_per_sec": round(p / dt_dec2, 1)}
+            return w2
 
-    # CPU-forced chaos validation: the chaos legs are cheap enough to run
-    # degraded (tiny fleet, CPU grid path) — REPORTER_BENCH_CHAOS=1 on a
-    # fallback run exercises kill/recover + outage end to end without a
-    # chip, writing to BENCH_DETAIL_CPU.json as usual
-    if (manual or not tpu_ok) and env_flag(
-            os.environ.get("REPORTER_BENCH_CHAOS")):
-        _run_chaos_legs(ts, traces, detail, split)
+        w2 = journal.leg("window2", _leg_window2)
+        if w2:
+            detail["second_window"] = w2
+            # One selection rule for EVERY tile: the window whose e2e
+            # won supplies BOTH that tile's published e2e and decode
+            # numbers (mood-consistent pairs; merge is idempotent on
+            # resume because both windows' numbers are journaled).
+            sfw = w2.get("sf")
+            if sfw and jax_pps and sfw["probes_per_sec_e2e"] > jax_pps:
+                jax_pps = sfw["probes_per_sec_e2e"]
+                decode_pps = sfw["decode_only_probes_per_sec"]
+                detail["decode_only_probes_per_sec"] = round(
+                    decode_pps, 1)
+                detail["e2e_over_decode"] = round(jax_pps / decode_pps,
+                                                  3)
+                detail["batch_seconds"] = round(
+                    n_traces * n_points / jax_pps, 3)
+            for name, key in (("bayarea", "metro"),
+                              ("sf+r", "restricted"),
+                              ("bayarea-xl", "xl"),
+                              ("organic", "organic"),
+                              ("organic-xl", "organic_xl")):
+                tw = w2.get(name)
+                if (tw and key in detail
+                        and tw["probes_per_sec_e2e"]
+                        > detail[key]["probes_per_sec_e2e"]):
+                    detail[key]["probes_per_sec_e2e"] = \
+                        tw["probes_per_sec_e2e"]
+                    detail[key]["decode_only_probes_per_sec"] = \
+                        tw["decode_only_probes_per_sec"]
+            # cross-tile ratios divide the PUBLISHED (best-of-both-
+            # windows) numbers; effects under the ~10% residual noise
+            # floor are not resolvable — noted inline
+            if jax_pps and "restricted" in detail:
+                detail["restricted"]["throughput_vs_unrestricted"] = \
+                    round(detail["restricted"]["probes_per_sec_e2e"]
+                          / jax_pps, 3)
+            if jax_pps and "organic" in detail:
+                detail["organic"]["throughput_vs_sf"] = round(
+                    detail["organic"]["probes_per_sec_e2e"] / jax_pps, 3)
+            if decode_pps and "xl" in detail:
+                detail["xl"]["culling"]["decode_slowdown_vs_sf"] = round(
+                    decode_pps
+                    / detail["xl"]["decode_only_probes_per_sec"], 1)
+            detail["ratio_note"] = (
+                "ratios divide best-of-8-draws numbers (equal draw "
+                "counts per tile, window-paired e2e/decode); link "
+                "noise ~2x dominates effects under ~10%")
+        split["window2_s"] = journal.seconds("window2")
 
-    # Latency attribution runs on EVERY composite (chip, manual,
-    # CPU-forced): the acceptance artifact is the reconciled per-stage
-    # decomposition, and the CPU validation capture must carry it too —
-    # scaled down so one core serving producer+consumer stays honest.
-    if "latency_attribution" not in detail:
-        t0 = time.perf_counter()
-        detail["latency_attribution"] = _latency_attribution(
-            ts, traces, n_stream=min(500, len(traces)),
-            offered_pps=(50_000 if tpu_ok else 2_000), seconds=5.0)
-        split["latency_attribution_s"] = round(time.perf_counter() - t0, 1)
+    if audit:
+        detail["audit"] = {
+            "total_traces": sum(v["traces"] for v in audit.values()),
+            "per_tile": audit}
 
-    # Host-prepare micro A/B (ISSUE 7): runs on EVERY composite —
-    # native-vs-Python prepare throughput plus the wire byte-identity
-    # re-proof (the sweep_ab discipline applied to the submit leg).
-    t0 = time.perf_counter()
-    detail["prepare_bench"] = _prepare_bench(ts, traces)
-    split["prepare_bench_s"] = round(time.perf_counter() - t0, 1)
+    # -- host-prepare micro A/B (ISSUE 7): every composite ---------------
+    if needs_primary:
+        prep = journal.leg("prepare_bench",
+                           lambda: _prepare_bench(ts, traces))
+        if prep:
+            detail["prepare_bench"] = prep
+        split["prepare_bench_s"] = journal.seconds("prepare_bench")
 
-    # Sweep-kernel three-arm A/B validation on composites with no chip
-    # (manual / CPU-forced): the acceptance contract — wire byte-identity
-    # across subcull/block/mxu, including through a paging cycle — is
-    # asserted through the pallas interpreter at tiny scale, so EVERY
-    # composite carries the identity bits even when no TPU can time them.
-    if "sweep_ab" not in detail:
-        t0 = time.perf_counter()
-        detail["sweep_ab"] = _sweep_ab_cpu_validate()
-        split["sweep_ab_s"] = round(time.perf_counter() - t0, 1)
+    # -- metro fleet residency (ISSUE 6): every composite; self-contained
+    # (builds its own metros), so `--legs fleet` needs no primary setup --
+    fleet = journal.leg("fleet", lambda: _fleet_bench(tpu_ok))
+    if fleet:
+        detail["fleet"] = fleet
+    # NOT split["fleet_s"] — that key is the trace-FLEET synthesis timing
+    # in setup_seconds' sum
+    split["fleet_residency_s"] = journal.seconds("fleet")
 
-    # Metro fleet residency (ISSUE 6) runs on EVERY composite: N>=8
-    # generated metros served from this one process — steady-state mixed
-    # traffic, a cold-metro promotion storm through a half-size budget,
-    # and the per-metro wire-byte fidelity audit. Chip runs size it up;
-    # manual/CPU runs validate the full leg at tiny scale (the r7
-    # BENCH_DETAIL_CPU.json convention).
-    t0 = time.perf_counter()
-    detail["fleet"] = _fleet_bench(tpu_ok)
-    # NOT split["fleet_s"] — that key is the trace-FLEET synthesis
-    # timing in setup_seconds' sum; clobbering it would silently change
-    # what setup_seconds measures run over run
-    split["fleet_residency_s"] = round(time.perf_counter() - t0, 1)
+    # -- link-health record (round 15): the whole run's window + the
+    # measured probe duty (the <0.5% steady-state claim as a field) ------
+    if link_enabled:
+        _ls = linkhealth.sampler()
+        detail["link_health"] = {
+            **_ls.window(),
+            "probe_duty_pct": _ls.probe_duty_pct(),
+            "probes": _ls.probes_total,
+            "dead_probes": _ls.dead_probes_total,
+        }
+    else:
+        detail["link_health"] = {"rtt_ms": None, "mbps": None,
+                                 "mood": None, "samples": 0,
+                                 "probe_duty_pct": None, "probes": 0,
+                                 "dead_probes": 0}
+    detail["journal"] = journal.to_json()
 
-    detail["setup_split"] = split
+    detail["setup_split"] = {k: v for k, v in split.items()
+                             if v is not None}
     detail["setup_seconds"] = round(
-        split["device_probe_s"] + split["tile_s"] + split["fleet_s"], 1)
+        split.get("device_probe_s", 0.0) + (split.get("tile_s") or 0.0)
+        + (split.get("fleet_s") or 0.0), 1)
     detail["total_seconds"] = round(time.perf_counter() - t_setup, 1)
 
     doc = {
         "metric": "probes_per_sec_e2e",
-        "value": round(jax_pps, 1),
+        "value": (round(jax_pps, 1) if jax_pps else None),
         "unit": "probes/s",
-        "vs_baseline": round(jax_pps / cpu_pps, 2),
-        "provenance": _provenance(tpu_ok),
+        "vs_baseline": (round(jax_pps / cpu_pps, 2)
+                        if jax_pps and cpu_pps else None),
+        "provenance": prov,
         "detail": detail,
     }
     # Full composite detail: a side file + an EARLY stdout line. The
     # driver records only the tail of stdout (round 3's single fat line
     # overran it → BENCH_r03 parsed:null), so the FINAL line below is a
-    # compact summary that always fits the capture window; everything it
-    # drops is in the detail file. ANY CPU composite — env-forced sanity
-    # runs AND unforced tunnel-outage fallbacks — goes to
-    # BENCH_DETAIL_CPU.json, so a degraded run can never clobber the
-    # chip-captured BENCH_DETAIL.json (the round-6 overwrite hazard).
-    detail_name = ("BENCH_DETAIL.json" if tpu_ok
-                   else "BENCH_DETAIL_CPU.json")
+    # compact summary that always fits the capture window. ANY CPU
+    # composite — env-forced sanity runs AND unforced tunnel-outage
+    # fallbacks — goes to BENCH_DETAIL_CPU.json, so a degraded run can
+    # never clobber the chip-captured BENCH_DETAIL.json.
+    full_name = ("BENCH_DETAIL.json" if tpu_ok
+                 else "BENCH_DETAIL_CPU.json")
+    # a --legs SUBSET composite must never clobber the committed FULL
+    # capture (the r6 overwrite-hazard class: a sparse artifact wearing
+    # the full capture's filename) — it gets its own side file
+    detail_name = (full_name if legs_filter is None
+                   else full_name.replace(".json", "_PARTIAL.json"))
+    # regression sentinel (round 15): diff against the committed FULL
+    # capture of the SAME flavor BEFORE any overwrite — every capture
+    # self-reports what moved and whether the link excuses it
+    delta = _bench_delta_tail(doc, _repo_path(full_name))
+    if delta is not None:
+        detail["bench_delta"] = delta
     with open(_repo_path(detail_name), "w") as f:
         json.dump(doc, f, indent=1)
     print(json.dumps(doc))
@@ -3287,7 +3731,10 @@ def _summary_line(doc: dict) -> dict:
     dev = d.get("device")
     if isinstance(dev, str):
         dev = dev.split(" (", 1)[0]
-    tiles_kpps: list = [int(doc["value"] / 1e3)]
+    # value is None on --legs subset composites that skipped the
+    # primary leg — the slot stays None, never a crash
+    tiles_kpps: list = [None if doc.get("value") is None
+                        else int(doc["value"] / 1e3)]
     for key in ("metro", "restricted", "xl", "organic", "organic_xl"):
         v = _g(key, "probes_per_sec_e2e")
         tiles_kpps.append(None if v is None else int(v / 1e3))
@@ -3296,6 +3743,7 @@ def _summary_line(doc: dict) -> dict:
     per_tile = _g("audit", "per_tile", default={})
     fleet_pps = _g("fleet", "mixed", "probes_per_sec")
     fleet_bit = _g("fleet", "fidelity", "wires_bit_identical")
+    regs = _g("bench_delta", "regressions", default=[]) or []
     summary = {
         "metric": doc["metric"],
         "value": doc["value"],
@@ -3307,15 +3755,25 @@ def _summary_line(doc: dict) -> dict:
         "p50_trace_ms": d.get("p50_single_trace_latency_ms"),
         "p50_matcher_ms": d.get("p50_matcher_only_ms"),
         # key names compacted for the 1 KB pin (r8 precedent): xl_bind =
-        # xl binding leg, rtt_ms = [window1, window2] link RTT
-        "xl_bind": _g("xl", "device_compute", "binding_leg"),
+        # xl binding leg ("dev" = device_sweep, "host" = host legs —
+        # r15 compaction, the link/delta tokens needed the bytes),
+        # rtt_ms = [window1, window2] link RTT, whole ms
+        "xl_bind": (None if _g("xl", "device_compute",
+                               "binding_leg") is None
+                    else ("dev" if _g("xl", "device_compute",
+                                      "binding_leg") == "device_sweep"
+                          else "host")),
         "rtt_ms": [
-            d.get("link_rtt_ms"),
-            _g("second_window", "link_rtt_ms")],
+            None if v is None else int(v)
+            for v in (d.get("link_rtt_ms"),
+                      _g("second_window", "link_rtt_ms"))],
+        # audit dis is a fixed-order array now (r15, same r8 compaction:
+        # no room for six tile names twice) — insertion order of the
+        # audit legs [headline, headline-fresh-rot, bayarea, sf+r,
+        # organic, bicycle]; named exact values in detail.audit.per_tile
         "audit": {
             "traces": _g("audit", "total_traces"),
-            "dis": {k: v.get("disagreement")
-                    for k, v in per_tile.items()},
+            "dis": [v.get("disagreement") for v in per_tile.values()],
             "src": sorted({v.get("fidelity_source", "?")
                            for v in per_tile.values()}),
         },
@@ -3338,14 +3796,20 @@ def _summary_line(doc: dict) -> dict:
                         else int(_g("streaming", "probes_per_sec") / 1e3)),
         # dict-pipeline pps + soak p99/offered/duration + the full
         # capacity grid live in the detail file only: the FINAL line must
-        # stay under the driver's ~1 KB tail
-        # cap = best held offer from the capacity grid; rej = counted
-        # producer rejections in the 2x bounded-broker overload soak
-        "soak": {"pps": _g("streaming_soak", "sustained_pps"),
-                 "end_lag": _g("streaming_soak", "end_lag"),
-                 "p50_ms": _g("streaming_soak", "p50_probe_to_report_ms"),
-                 "cap": _g("streaming_capacity", "best_held_pps"),
-                 "rej": _g("streaming_overload", "broker_rejected")},
+        # stay under the driver's ~1 KB tail. Fixed-order array (r15
+        # compaction): [sustained kpps, end lag, p50 probe->report ms,
+        # best held capacity kpps, overload producer rejections] — exact
+        # values in detail.streaming_soak / _capacity / _overload
+        "soak": [
+            (None if _g("streaming_soak", "sustained_pps") is None
+             else int(_g("streaming_soak", "sustained_pps") / 1e3)),
+            _g("streaming_soak", "end_lag"),
+            (None if _g("streaming_soak",
+                        "p50_probe_to_report_ms") is None
+             else int(_g("streaming_soak", "p50_probe_to_report_ms"))),
+            (None if _g("streaming_capacity", "best_held_pps") is None
+             else int(_g("streaming_capacity", "best_held_pps") / 1e3)),
+            _g("streaming_overload", "broker_rejected")],
         # sf submit-vs-device colocated bound, kpps int (same r13
         # compaction; exact value in detail.device_compute)
         "colo_kpps": (
@@ -3417,14 +3881,32 @@ def _summary_line(doc: dict) -> dict:
             _g("fleet", "occupancy", "promotions"),
             _g("fleet", "occupancy", "demotions"),
             None if fleet_bit is None else int(bool(fleet_bit))],
+        # round-15 link-health token: [rtt_ms, mbps, mood] — the run's
+        # window; CPU composites record mood "cpu", never omit the token
+        # (full record incl. measured probe duty in detail.link_health)
+        "link": [
+            (None if _g("link_health", "rtt_ms") is None
+             else int(_g("link_health", "rtt_ms"))),
+            (None if _g("link_health", "mbps") is None
+             else round(_g("link_health", "mbps"), 1)),
+            _g("link_health", "mood")],
+        # round-15 regression sentinel: [regressions, link-attributable,
+        # worst regression %] vs the committed same-flavor capture (full
+        # attributed table in detail.bench_delta)
+        "delta": [_g("bench_delta", "regressions_total"),
+                  _g("bench_delta", "link_attributable_total"),
+                  regs[0]["delta_pct"] if regs else None],
         # first overloaded client level (None = survived the whole curve)
         "svc_edge": _g("service_overload_boundary", "clients"),
         # serving-face A/B headline (full curves + open loop in detail):
         # [clients, scheduler req/s, queue-and-combine req/s, dispatches
-        # at in-flight depth >= 2, errors] — same run, alternated rounds
+        # at in-flight depth >= 2, errors] — same run, alternated
+        # rounds; req/s truncated to ints (r15 compaction)
         "svc": [_g("service_ab", "clients"),
-                _g("service_ab", "scheduler_rps"),
-                _g("service_ab", "legacy_rps"),
+                (None if _g("service_ab", "scheduler_rps") is None
+                 else int(_g("service_ab", "scheduler_rps"))),
+                (None if _g("service_ab", "legacy_rps") is None
+                 else int(_g("service_ab", "legacy_rps"))),
                 _g("service_ab", "inflight_ge2_dispatches"),
                 _g("service_ab", "errors")],
         "total_seconds": d.get("total_seconds"),

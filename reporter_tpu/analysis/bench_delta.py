@@ -1,0 +1,353 @@
+"""Run-over-run bench-composite diff — the regression sentinel.
+
+``python -m reporter_tpu.analysis.bench_delta old.json new.json`` diffs
+two composite captures (the ``BENCH_DETAIL.json`` document shape)
+SCHEMA-AWARE: every shared numeric leaf whose key names a known metric
+direction is compared; keys only one side has are counted (schema
+drift), never treated as regressions; unknown-direction leaves (configs,
+counts, workload sizes) are skipped. Each worse-than-threshold delta is
+then attributed:
+
+  regression          worse beyond the threshold on a metric the link
+                      cannot excuse (device-only numbers, fidelity,
+                      host-side throughput) — or a link-sensitive metric
+                      whose two captures recorded the SAME link mood;
+  link-attributable   a link-sensitive metric (e2e throughput, request
+                      latency, RTT-bound p50s, readback, streaming
+                      rates) whose two captures recorded materially
+                      different link conditions (mood changed, or
+                      rtt/bandwidth moved past the drift band) — the
+                      delta is drift until a same-mood capture says
+                      otherwise (the link's documented ~2x swing,
+                      CLAUDE.md);
+  link-unknown        link-sensitive and worse, but at least one capture
+                      carries no link window (every capture before round
+                      15) — flagged, not blamed.
+
+The sentinel REPORTS (exit 0 always): bench.py's tail runs it against
+the committed capture on every run and embeds the summary, so the
+driver sees "what moved and whether the link excuses it" without a
+human diffing two 100 KB documents. CI never gates on it — a noisy link
+must not turn the perf dashboard into a flaky test.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any
+
+__all__ = ["compare", "summary_token", "render", "classify_direction",
+           "is_link_sensitive", "link_drifted", "main"]
+
+# ---------------------------------------------------------------------------
+# direction classification (suffix rules over the LEAF key, narrow on
+# purpose: an unclassified leaf is skipped, never guessed)
+
+_HIGHER_SUFFIXES = (
+    "probes_per_sec", "probes_per_sec_e2e", "probes_per_sec_wall",
+    "probes_per_sec_active", "probes_per_sec_busy", "per_sec", "_rps",
+    "_pps", "krows_per_s", "speedup", "speedup_2v1", "best_held_pps",
+    "achieved_gbps", "achieved_gflops", "point_edge_rate",
+    "point_segment_rate", "req_per_sec", "device_probes_per_sec",
+    "vs_baseline", "readback_mbps",
+)
+_LOWER_SUFFIXES = (
+    "_ms", "disagreement", "miss_rate", "step_miss_rate", "lag",
+    "end_lag", "max_lag", "lost_reports", "duplicated_reports",
+    "dead_letter_pending_end", "dead_lettered", "errors", "rejected",
+    "dropped_rows", "recovery_seconds", "drain_seconds",
+    "tracing_overhead_pct", "dispatch_timeout",
+)
+# leaf keys that are workload/config/bookkeeping, never a perf claim —
+# matched exactly, skipped before the suffix rules run
+_SKIP_KEYS = {
+    "seconds", "total_seconds", "build_seconds", "wall_seconds",
+    "match_seconds", "host_seconds", "active_seconds", "batch_seconds",
+    "setup_seconds", "offered_pps", "offered_rps", "offered_probes",
+    "samples", "traces", "points", "reports", "steps", "posts", "rows",
+    "clients", "rounds", "workers", "n_metros", "touches", "probes",
+    "value", "bucket", "capacity_bytes", "staged_bytes_total",
+    "hbm_tile_bytes", "wire_bytes_per_slice", "broker_probes",
+    "rotation_index", "latency_samples",
+    # measurement CONDITIONS, not claims: the link window is the
+    # normalizer, never a compared metric
+    "link_rtt_ms", "rtt_ms", "mbps", "link_mood", "probe_duty_pct",
+}
+
+# every throughput/latency number measured THROUGH the remote link is
+# link-sensitive by default; this set names the ones that are not —
+# device-only (link amortized out), host-only, and correctness counts
+# a link mood can never excuse
+_LINK_FREE_TOKENS = re.compile(
+    r"colocated|device_probes_per_sec|device_ms_per_dispatch|krows"
+    r"|disagreement|point_edge|point_segment|matcher_only"
+    r"|cpu_reference|python_|miss_rate|lost|duplicated|dead_letter"
+    r"|errors|rejected|dropped|overhead_pct|speedup|probe_duty",
+    re.IGNORECASE)
+
+
+def classify_direction(key: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 not a compared metric."""
+    k = key.lower()
+    if k in _SKIP_KEYS:
+        return 0
+    for s in _HIGHER_SUFFIXES:
+        if k.endswith(s):
+            return 1
+    for s in _LOWER_SUFFIXES:
+        if k.endswith(s):
+            return -1
+    return 0
+
+
+def is_link_sensitive(path: str) -> bool:
+    """Does the remote link sit in this metric's denominator? Device-only
+    and host-only numbers (and correctness counts) can't hide behind the
+    tunnel's mood; everything else measured end-to-end can."""
+    return not _LINK_FREE_TOKENS.search(path)
+
+
+# ---------------------------------------------------------------------------
+# link windows
+
+def _link_of(doc: dict) -> "dict | None":
+    d = doc.get("detail") or {}
+    lh = d.get("link_health")
+    if isinstance(lh, dict) and "mood" in lh:
+        return lh
+    return None
+
+
+def link_drifted(old: "dict | None", new: "dict | None",
+                 rtt_band: float = 1.5,
+                 mbps_band: float = 1.5) -> "bool | None":
+    """Did the link move enough between the captures to excuse a
+    link-sensitive delta? None = can't say (a side has no window —
+    pre-r15 captures). A mood change always counts; otherwise rtt or
+    bandwidth moving past the band (either direction — a FASTER link in
+    the new capture makes an improvement link-attributable too)."""
+    if not old or not new or old.get("mood") is None \
+            or new.get("mood") is None:
+        return None
+    if old["mood"] != new["mood"]:
+        return True
+    for key, band in (("rtt_ms", rtt_band), ("mbps", mbps_band)):
+        a, b = old.get(key), new.get(key)
+        if a and b and (a / b > band or b / a > band):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the walk
+
+def _numeric(x: Any) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def _walk(old: Any, new: Any, path: str, rows: list,
+          counts: dict) -> None:
+    if isinstance(old, dict) and isinstance(new, dict):
+        # keys stringified for alignment: the NEW doc is in-memory (int
+        # histogram keys), the OLD one round-tripped through JSON (str)
+        o = {str(k): v for k, v in old.items()}
+        n = {str(k): v for k, v in new.items()}
+        for k in sorted(set(o) | set(n)):
+            p = f"{path}.{k}" if path else k
+            if k not in o:
+                counts["only_new"] += 1
+            elif k not in n:
+                counts["only_old"] += 1
+            else:
+                _walk(o[k], n[k], p, rows, counts)
+        return
+    if isinstance(old, list) and isinstance(new, list):
+        for i in range(min(len(old), len(new))):
+            _walk(old[i], new[i], f"{path}[{i}]", rows, counts)
+        if len(old) != len(new):
+            counts["only_old" if len(old) > len(new)
+                   else "only_new"] += abs(len(old) - len(new))
+        return
+    if not (_numeric(old) and _numeric(new)):
+        return
+    leaf = path.rsplit(".", 1)[-1]
+    leaf = re.sub(r"\[\d+\]$", "", leaf)
+    direction = classify_direction(leaf)
+    if direction == 0:
+        return
+    counts["compared"] += 1
+    if old == new:
+        counts["flat"] += 1
+        return
+    # old == 0 has no percentage, but a 0 -> nonzero move on a
+    # lower-is-better counter (errors, lost_reports, dead_lettered: 0
+    # IS the healthy baseline) is exactly what a regression sentinel
+    # exists to surface — delta_pct stays None, the row still
+    # classifies
+    delta_pct = (None if old == 0
+                 else round((new - old) / abs(old) * 100.0, 2))
+    rows.append({"path": path, "old": old, "new": new,
+                 "delta_pct": delta_pct, "direction": direction,
+                 "link_sensitive": is_link_sensitive(path)})
+
+
+def compare(old_doc: dict, new_doc: dict,
+            threshold_pct: float = 10.0) -> dict:
+    """Diff two composite documents. Returns the full row set plus the
+    attributed regression/drift lists; see the module docstring for the
+    verdict semantics."""
+    old_link, new_link = _link_of(old_doc), _link_of(new_doc)
+    drifted = link_drifted(old_link, new_link)
+    rows: "list[dict]" = []
+    counts = {"compared": 0, "flat": 0, "only_old": 0, "only_new": 0}
+    # the headline "value" IS the e2e throughput (doc["metric"]) — walk
+    # it under a classifiable name so it can never be skipped as config
+    _walk({"headline_probes_per_sec_e2e": old_doc.get("value"),
+           "detail": old_doc.get("detail") or {}},
+          {"headline_probes_per_sec_e2e": new_doc.get("value"),
+           "detail": new_doc.get("detail") or {}},
+          "", rows, counts)
+    regressions: "list[dict]" = []
+    link_attrib: "list[dict]" = []
+    improved = 0
+    for r in rows:
+        d = r["delta_pct"]
+        if d is None:
+            # zero baseline: any move is an infinite percentage —
+            # direction decides worse/better, "big" by definition
+            worse = (r["new"] - r["old"]) * r["direction"] < 0
+            big = True
+        else:
+            worse = d * r["direction"] < 0
+            big = abs(d) >= threshold_pct
+        if not big:
+            counts["flat"] += 1
+            continue
+        if not worse:
+            improved += 1
+            r["verdict"] = "improved"
+            continue
+        if r["link_sensitive"]:
+            if drifted is None:
+                r["verdict"] = "link-unknown"
+                link_attrib.append(r)
+            elif drifted:
+                r["verdict"] = "link-drift"
+                link_attrib.append(r)
+            else:
+                r["verdict"] = "regression"
+                regressions.append(r)
+        else:
+            r["verdict"] = "regression"
+            regressions.append(r)
+    # None delta = zero-baseline move = effectively infinite % — most
+    # severe, sorts first
+    def _sev(r):
+        return (0 if r["delta_pct"] is None else 1,
+                -abs(r["delta_pct"] or 0.0))
+
+    regressions.sort(key=_sev)
+    link_attrib.sort(key=_sev)
+    return {
+        "threshold_pct": threshold_pct,
+        "link": {"old": old_link, "new": new_link,
+                 "drifted": drifted},
+        "compared": counts["compared"],
+        "flat": counts["flat"],
+        "improved": improved,
+        "only_old_keys": counts["only_old"],
+        "only_new_keys": counts["only_new"],
+        "regressions": regressions,
+        "link_attributable": link_attrib,
+        "old_provenance": (old_doc.get("provenance") or {}),
+        "new_provenance": (new_doc.get("provenance") or {}),
+    }
+
+
+def summary_token(delta: "dict | None") -> list:
+    """``delta = [regressions, link-attributable, worst regression %]``
+    — the <1 KB summary-line form (None slots when no comparison ran)."""
+    if not delta:
+        return [None, None, None]
+    worst = (delta["regressions"][0]["delta_pct"]
+             if delta["regressions"] else None)
+    return [len(delta["regressions"]), len(delta["link_attributable"]),
+            worst]
+
+
+def compact(delta: dict, top: int = 12) -> dict:
+    """The bounded form bench.py embeds in the detail file: counters +
+    the top-N rows of each attributed list (the full table is one
+    ``bench_delta`` CLI run away — the detail must not double in size
+    because a schema grew)."""
+    slim = dict(delta)
+    slim["regressions"] = delta["regressions"][:top]
+    slim["link_attributable"] = delta["link_attributable"][:top]
+    slim["regressions_total"] = len(delta["regressions"])
+    slim["link_attributable_total"] = len(delta["link_attributable"])
+    return slim
+
+
+def render(delta: dict) -> str:
+    """Human-readable table (the CLI face)."""
+    out = []
+    link = delta["link"]
+    out.append(
+        f"compared {delta['compared']} metric leaves "
+        f"(threshold {delta['threshold_pct']}%): "
+        f"{len(delta['regressions'])} regression(s), "
+        f"{len(delta['link_attributable'])} link-attributable, "
+        f"{delta['improved']} improved, {delta['flat']} flat; "
+        f"schema drift: {delta['only_old_keys']} old-only / "
+        f"{delta['only_new_keys']} new-only keys")
+    op, np_ = delta.get("old_provenance", {}), delta.get("new_provenance", {})
+    out.append(f"old: round={op.get('round')} sha={op.get('git_sha')}  "
+               f"link={link['old']}")
+    out.append(f"new: round={np_.get('round')} sha={np_.get('git_sha')}  "
+               f"link={link['new']}  drifted={link['drifted']}")
+
+    def _table(title, rows):
+        if not rows:
+            out.append(f"{title}: none")
+            return
+        out.append(title + ":")
+        w = max(len(r["path"]) for r in rows)
+        for r in rows:
+            arrow = "^" if r["direction"] > 0 else "v"
+            pct = ("   0->n " if r["delta_pct"] is None
+                   else f"{r['delta_pct']:>+8.1f}")
+            out.append(
+                f"  {r['path']:<{w}}  {r['old']:>14g} -> "
+                f"{r['new']:>14g}  {pct}%  "
+                f"(better={arrow}) [{r.get('verdict', '')}]")
+
+    _table("REGRESSIONS (link cannot excuse)", delta["regressions"])
+    _table("link-attributable drift", delta["link_attributable"])
+    return "\n".join(out)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m reporter_tpu.analysis.bench_delta",
+        description="schema-aware diff of two bench composite captures")
+    ap.add_argument("old", help="baseline composite JSON "
+                               "(e.g. the committed BENCH_DETAIL.json)")
+    ap.add_argument("new", help="candidate composite JSON")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="delta %% below which a move is 'flat' "
+                         "(default 10; the link noise floor is ~10%% "
+                         "at bench draw counts)")
+    args = ap.parse_args(argv)
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    print(render(compare(old, new, threshold_pct=args.threshold)))
+    return 0            # a sentinel reports; it never gates
+
+
+if __name__ == "__main__":          # pragma: no cover - CLI convenience
+    raise SystemExit(main())

@@ -92,6 +92,32 @@ LOCK_ORDER_EDGES: "dict[tuple[str, str], str]" = {
     ("tracer.dump", "tracer.tid"): "2026-08-04 dump() resolves thread ids "
         "while holding the dump lock — tid got its OWN lock for exactly "
         "this nesting (round 10); tid is a leaf",
+    # ---- link health (round 15) ------------------------------------------
+    ("linkhealth.state", "metrics.registry"): "2026-08-04 every recorded "
+        "link sample publishes its gauges to the attached registries in "
+        "the same section (the ring append and the gauge write must see "
+        "one consistent sample); the registry lock is a leaf O(1) dict "
+        "write. Probes themselves NEVER run under linkhealth.state — "
+        "the sampler bounds them with the shared watchdog first and "
+        "records the finished result",
+    ("fleet_router.app_build", "linkhealth.registry"): "2026-08-04 a "
+        "metro app's first-touch construction (under its per-metro "
+        "build lock, the round-11 design) attaches its registry to the "
+        "process link sampler; the registry lock guards one lazy "
+        "construction + a module pointer read, never calls out",
+    ("fleet_router.app_build", "linkhealth.state"): "2026-08-04 same "
+        "first-touch construction: attach/start take the sampler state "
+        "lock for a list append + gauge replay; leaf section (probing "
+        "happens on the sampler's own daemon thread, not here)",
+    ("app.combine", "linkhealth.registry"): "2026-08-04 the legacy "
+        "combine leader holds its lock through the whole dispatch (kept "
+        "r7 A/B design), so a dispatch TIMEOUT's dead-link note "
+        "(linkhealth.note_dispatch_timeout) lands under it; the "
+        "registry lock guards one module-pointer read",
+    ("app.combine", "linkhealth.state"): "2026-08-04 same path: the "
+        "dead-link sample records under the sampler state lock — a "
+        "ring append + leaf gauge writes, the same shape as the "
+        "existing app.combine -> metrics.registry edge",
     # ---- streaming brokers ----------------------------------------------
     ("broker.partitions", "faults.plan"): "2026-08-04 durable append "
         "consults the broker fault site inside the partition lock so an "

@@ -24,7 +24,7 @@ _ENV_PREFIXES = ("RTPU_", "REPORTER_", "DATASTORE_")
 
 def snapshot() -> dict:
     from reporter_tpu import faults
-    from reporter_tpu.utils import tracing
+    from reporter_tpu.utils import linkhealth, tracing
 
     tr = tracing.tracer()
     return {
@@ -35,6 +35,11 @@ def snapshot() -> dict:
         # identity, not equality: `with faults.use(plan)` restores the
         # previous object; a leaked install leaves a different one
         "faults.installed": faults._installed,
+        # the r15 process-global link sampler is swap-installable the
+        # same way (linkhealth.configure); identity again. None -> X is
+        # LEGAL (lazy first construction by ensure_serving); X -> Y or
+        # X -> None is a test leaking its fake into every later test
+        "linkhealth.sampler": linkhealth._global,
         "env": {k: v for k, v in os.environ.items()
                 if k.startswith(_ENV_PREFIXES)},
     }
@@ -52,6 +57,12 @@ def diff(pre: dict, post: dict) -> "list[str]":
         out.append("faults plan left installed "
                    f"({post['faults.installed']!r}) — use "
                    "`with faults.use(plan):` so the restore is scoped")
+    pre_lh = pre.get("linkhealth.sampler")
+    if pre_lh is not None and pre_lh is not post.get("linkhealth.sampler"):
+        out.append("linkhealth sampler swapped and not restored "
+                   "(linkhealth.configure(fake) without restoring the "
+                   "previous sampler in finally) — later tests publish "
+                   "the fake's mood at /metrics and /health")
     pe, qe = pre["env"], post["env"]
     for k in sorted(set(pe) | set(qe)):
         if pe.get(k) != qe.get(k):

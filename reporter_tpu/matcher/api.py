@@ -34,7 +34,7 @@ from reporter_tpu.matcher.segments import (
     reach_route_fn,
 )
 from reporter_tpu.tiles.tileset import TileSet
-from reporter_tpu.utils import tracing
+from reporter_tpu.utils import linkhealth, tracing
 from reporter_tpu.utils import watchdog as watchdog_mod
 from reporter_tpu.utils.metrics import MetricsRegistry
 from reporter_tpu.utils.watchdog import AbandonedThreadWatchdog
@@ -464,6 +464,12 @@ class SegmentMatcher:
         self.metrics.count("dispatch_timeout")
         tracing.post_mortem("dispatch_timeout", failing="device_dispatch",
                             traces=len(traces), timeout_s=timeout)
+        # dead-link signal into the link-health record (round 15): the
+        # watchdog saw the stall minutes before the low-duty probe
+        # would — the sample keeps mood/gauges current; the post-mortem
+        # above is the one dump for this event (linkhealth only dumps
+        # for its OWN probe detections)
+        linkhealth.note_dispatch_timeout("dispatch_timeout")
         return self._degrade(traces, timeout)
 
     def _degrade(self, traces: Sequence[Trace], timeout: float):

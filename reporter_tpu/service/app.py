@@ -38,7 +38,7 @@ from reporter_tpu.service.reports import (
     latest_complete_time,
 )
 from reporter_tpu.tiles.tileset import TileSet
-from reporter_tpu.utils import tracing
+from reporter_tpu.utils import linkhealth, tracing
 
 log = logging.getLogger("reporter_tpu.service")
 
@@ -140,6 +140,12 @@ class ReporterApp:
         self.matcher = (matcher if matcher is not None
                         else SegmentMatcher(tileset, self.config,
                                             mesh=mesh))
+        # link-health gauges (round 15): the process-global sampler
+        # probes the remote-attached link at low duty and publishes
+        # rtpu_link_* into this app's registry — serving carries the
+        # same mood record the bench journal stamps legs with
+        # (RTPU_LINK_PROBE=0 disables; utils/linkhealth.py)
+        linkhealth.ensure_serving(self.matcher.metrics)
         self.cache = PartialTraceCache(ttl=svc.cache_ttl,
                                        max_uuids=svc.cache_max_uuids)
         from reporter_tpu.service.datastore import publisher_kwargs
@@ -347,6 +353,20 @@ class ReporterApp:
             # operators see saturation (admission depth, in-flight
             # batches, padding/deferral counters) without the metrics port
             out["scheduler"] = self.scheduler.snapshot()
+        # link mood (round 15): the latest probe + measured duty, so a
+        # degraded/dead tunnel is visible at the liveness face before
+        # it shows up as dispatch timeouts
+        s = linkhealth.sampler() if linkhealth.enabled() else None
+        last = s.latest() if s is not None else None
+        out["link"] = {
+            "mood": (None if last is None else last.mood),
+            "rtt_ms": (None if last is None or last.rtt_s is None
+                       else round(last.rtt_s * 1e3, 2)),
+            "mbps": (None if last is None or last.mbps is None
+                     else round(last.mbps, 2)),
+            "probe_duty_pct": (None if s is None
+                               else s.probe_duty_pct()),
+        }
         return out
 
     def close(self) -> None:

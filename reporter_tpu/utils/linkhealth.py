@@ -1,0 +1,443 @@
+"""Link-health telemetry — the measurement conditions behind every number.
+
+The chip is remote-attached ("axon"): RTT ~130 ms, ~25 MB/s, throughput
+swinging ~2x run to run, and the tunnel can die outright (a host
+transfer then stalls FOREVER — CLAUDE.md). That mood swing is the single
+largest unexplained variance in every capture (VERDICT r5 weak #2), yet
+until round 15 nothing recorded what the link was doing while a number
+was taken. This module is the recorder:
+
+  LinkHealthSampler   a daemon thread probing RTT + host->device
+                      bandwidth at low duty cycle (default one ~0.25 s
+                      probe per 60 s, <0.5% — the recorded
+                      ``probe_duty_pct`` keeps the claim measured), each
+                      probe bounded by the SHARED watchdog primitive
+                      (utils/watchdog.AbandonedThreadWatchdog — the
+                      matcher-dispatch/fleet-promotion guard, not a
+                      fork), classifying the link's mood:
+
+                        healthy    rtt and bandwidth inside thresholds
+                        degraded   slow but alive (rtt above
+                                   ``degraded_rtt_s`` or bandwidth below
+                                   ``degraded_mbps``)
+                        dead       a probe timed out / raised, or the
+                                   dispatch watchdog reported a timeout
+                        cpu        no device link in play (CPU backend)
+
+  window(since)       the contemporaneous summary every journaled bench
+                      leg is stamped with: median rtt/bandwidth over the
+                      window + the WORST mood seen in it (a leg that
+                      straddled a dead spell must say so even if the
+                      link recovered before the leg ended).
+
+Mood surfaces everywhere the existing observability lives instead of
+growing a parallel system: gauges (``link_rtt_ms`` / ``link_mbps`` /
+``link_mood`` -> ``rtpu_link_*`` at /metrics) publish into every
+attached MetricsRegistry; a dead-link DETECTION (probe timeout or
+transition into "dead") emits a tracer instant + a flight-recorder
+post-mortem through utils/tracing — the same ring the dispatch-watchdog
+and breaker sites dump into; and the matcher's dispatch watchdog feeds
+detections BACK via ``note_dispatch_timeout()`` (its own site already
+post-mortems, so the note only records the sample — one event, one
+dump).
+
+Thread-safety: ``linkhealth.state`` (a named lock — the lockdep gate
+sees it) guards the ring + attached registries; probes run OUTSIDE the
+lock always (a stalled transfer must never wedge readers), results are
+recorded under it, and the gauge publication inside the section is a
+leaf write (contract edge ``linkhealth.state`` -> ``metrics.registry``,
+dated in analysis/concurrency_contract.py).
+
+One process-global sampler (``sampler()`` / ``ensure_serving()``), the
+tracer()/faults.active() discipline: bench and every ReporterApp in the
+process share one probe thread and one mood, not one thread per app.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+import weakref
+from typing import Callable
+
+from reporter_tpu.utils import locks, tracing
+from reporter_tpu.utils.watchdog import TIMED_OUT, AbandonedThreadWatchdog
+
+__all__ = [
+    "LinkSample", "LinkHealthSampler", "sampler", "ensure_serving",
+    "note_dispatch_timeout", "configure", "MOOD_LEVELS",
+]
+
+# mood -> numeric gauge level (rtpu_link_mood); order IS severity — the
+# window summary reports the max level seen, so "dead for one probe in a
+# ten-minute leg" reads dead, never averaged away
+MOOD_LEVELS = {"healthy": 0, "degraded": 1, "dead": 2, "cpu": 3}
+_SEVERITY = {"healthy": 0, "cpu": 0, "degraded": 1, "dead": 2}
+
+_ENV_PROBE = "RTPU_LINK_PROBE"
+_ENV_PERIOD = "RTPU_LINK_PROBE_PERIOD_S"
+_ENV_BYTES = "RTPU_LINK_PROBE_BYTES"
+_ENV_DEGRADED_RTT = "RTPU_LINK_DEGRADED_RTT_MS"
+_ENV_DEGRADED_MBPS = "RTPU_LINK_DEGRADED_MBPS"
+_ENV_DEAD = "RTPU_LINK_DEAD_S"
+
+
+class LinkSample:
+    """One probe (or externally reported) observation."""
+
+    __slots__ = ("t", "rtt_s", "mbps", "mood", "source")
+
+    def __init__(self, t: float, rtt_s: "float | None",
+                 mbps: "float | None", mood: str, source: str = "probe"):
+        self.t = t
+        self.rtt_s = rtt_s
+        self.mbps = mbps
+        self.mood = mood
+        self.source = source
+
+    def to_json(self) -> dict:
+        return {"t": round(self.t, 3),
+                "rtt_ms": (None if self.rtt_s is None
+                           else round(self.rtt_s * 1e3, 2)),
+                "mbps": (None if self.mbps is None
+                         else round(self.mbps, 2)),
+                "mood": self.mood, "source": self.source}
+
+
+_probe_warmed = False
+
+
+def _device_probe(nbytes: int) -> "tuple[float | None, float | None]":
+    """(rtt_s, mbps) through one tiny dispatch+readback and one
+    host->device->host transfer of ``nbytes``. Returns (None, None) on a
+    CPU backend — no link in the loop, the caller records mood "cpu".
+    May stall forever on a dead tunnel; the sampler bounds it with the
+    shared watchdog, never calls it under a lock. The tiny executable is
+    warmed ONCE per process — re-warming every probe doubled the paid
+    RTTs and pushed steady-state duty past the 0.5% budget."""
+    global _probe_warmed
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.devices()[0].platform == "cpu":
+        return None, None
+    tiny = jnp.zeros(8, jnp.float32)
+    if not _probe_warmed:
+        np.asarray(tiny + 1)                 # compile, once per process
+        _probe_warmed = True
+    t0 = time.perf_counter()
+    np.asarray(tiny + 1)
+    rtt = time.perf_counter() - t0
+    buf = np.zeros(max(int(nbytes), 1024), np.uint8)
+    t0 = time.perf_counter()
+    dev = jax.device_put(buf)
+    np.asarray(dev)                          # the only real sync
+    dt = max(time.perf_counter() - t0 - rtt, 1e-6)   # one RTT rides along
+    return rtt, 2 * buf.nbytes / dt / 1e6    # bytes moved both ways
+
+
+class LinkHealthSampler:
+    """Bounded ring of link observations + the probe thread."""
+
+    def __init__(self,
+                 probe: "Callable[[int], tuple] | None" = None,
+                 period_s: "float | None" = None,
+                 probe_bytes: "int | None" = None,
+                 ring: int = 512,
+                 degraded_rtt_s: "float | None" = None,
+                 degraded_mbps: "float | None" = None,
+                 dead_timeout_s: "float | None" = None,
+                 clock: Callable[[], float] = time.monotonic):
+        e = os.environ
+        self.probe = probe if probe is not None else _device_probe
+        # 90 s default: ~2 RTTs + one small transfer per probe is
+        # ~0.3 s on the documented ~130 ms link — ~0.33% steady-state
+        # duty, inside the <0.5% budget with margin (the measured duty
+        # is recorded either way; bench tightens to 30 s for finer
+        # per-leg windows and pays the duty knowingly)
+        self.period_s = float(period_s if period_s is not None
+                              else e.get(_ENV_PERIOD, "90"))
+        self.probe_bytes = int(probe_bytes if probe_bytes is not None
+                               else e.get(_ENV_BYTES, str(256 * 1024)))
+        self.degraded_rtt_s = float(
+            degraded_rtt_s if degraded_rtt_s is not None
+            else float(e.get(_ENV_DEGRADED_RTT, "400")) / 1e3)
+        self.degraded_mbps = float(degraded_mbps if degraded_mbps is not None
+                                   else e.get(_ENV_DEGRADED_MBPS, "5"))
+        self.dead_timeout_s = float(dead_timeout_s
+                                    if dead_timeout_s is not None
+                                    else e.get(_ENV_DEAD, "10"))
+        self.clock = clock
+        self._lock = locks.named_lock("linkhealth.state")
+        self._ring: "collections.deque[LinkSample]" = collections.deque(
+            maxlen=int(ring))
+        self._registries: "list[weakref.ref]" = []
+        self._watchdog = AbandonedThreadWatchdog(
+            cap=4, thread_name="linkhealth-probe")
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self.probe_seconds_total = 0.0
+        self.probes_total = 0
+        self.dead_probes_total = 0
+        self._started_at: "float | None" = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "LinkHealthSampler":
+        """Idempotent; the thread probes once immediately, then every
+        ``period_s`` (jittered by nothing — the probes themselves are the
+        low-duty load)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop = threading.Event()
+            self._started_at = self.clock()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="linkhealth-sampler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sample_once()
+            except Exception:
+                # a probe bug must never kill the sampler thread; the
+                # classifier already maps probe exceptions to "dead", so
+                # anything landing here is a recorder bug — skip the tick
+                pass
+            self._stop.wait(self.period_s)
+
+    # ---- probing ---------------------------------------------------------
+
+    def sample_once(self) -> LinkSample:
+        """One bounded probe -> classify -> record. NEVER called under
+        the state lock: the whole point is that the probe may stall."""
+        if self._watchdog.tripped:
+            # breaker open: cap probes are already wedged on the dead
+            # link — record the dead observation WITHOUT pinning one
+            # more thread + buffer per period (the matcher-dispatch
+            # discipline, api.py's breaker; a weekend-long dead tunnel
+            # must cost bounded memory). A wedged probe that finally
+            # lands un-counts itself and probing resumes.
+            sample = LinkSample(self.clock(), None, None, "dead",
+                                source="probe_breaker_open")
+            self._record(sample)
+            return sample
+        t0 = time.perf_counter()
+        try:
+            out = self._watchdog.run(lambda: self.probe(self.probe_bytes),
+                                     timeout=self.dead_timeout_s)
+        except Exception as exc:
+            # a probe that RAISES (tunnel torn down mid-transfer) is a
+            # dead observation, not a sampler crash
+            out = TIMED_OUT
+            src = f"probe_error:{type(exc).__name__}"
+        else:
+            src = "probe_timeout"
+        dt = time.perf_counter() - t0
+        if out is TIMED_OUT:
+            sample = LinkSample(self.clock(), None, None, "dead",
+                                source=src)
+        else:
+            try:
+                rtt_s, mbps = out
+            except Exception:
+                rtt_s = mbps = None
+            sample = LinkSample(self.clock(), rtt_s, mbps,
+                                self._classify(rtt_s, mbps))
+        self._record(sample, probe_seconds=dt)
+        return sample
+
+    def _classify(self, rtt_s: "float | None",
+                  mbps: "float | None") -> str:
+        if rtt_s is None and mbps is None:
+            return "cpu"
+        if rtt_s is not None and rtt_s > self.degraded_rtt_s:
+            return "degraded"
+        if mbps is not None and mbps < self.degraded_mbps:
+            return "degraded"
+        return "healthy"
+
+    def note_dispatch_timeout(self, reason: str = "dispatch_timeout",
+                              **args) -> None:
+        """External dead-link signal — the matcher's dispatch watchdog
+        (and the fleet's promotion watchdog) observed a stalled transfer
+        the probe thread may be minutes from noticing. The reporting
+        site already post-mortems (dispatch_timeout / breaker_open /
+        fleet_promote), so this only records the sample + gauges: one
+        event, one flight-recorder dump."""
+        self._record(LinkSample(self.clock(), None, None, "dead",
+                                source=reason), post_mortem=False)
+
+    def _record(self, sample: LinkSample, probe_seconds: float = 0.0,
+                post_mortem: bool = True) -> None:
+        with self._lock:
+            prev = self._ring[-1].mood if self._ring else None
+            self._ring.append(sample)
+            self.probes_total += 1
+            self.probe_seconds_total += probe_seconds
+            if sample.mood == "dead":
+                self.dead_probes_total += 1
+            self._publish_locked(sample)
+        if sample.mood == "dead" and post_mortem:
+            # detection (not every dead sample while the link stays
+            # dead): a flapping tunnel must not spam the bounded dump
+            # budget the fault sites share
+            tr = tracing.tracer()
+            tr.instant("link_dead", source=sample.source)
+            if prev != "dead":
+                tr.post_mortem("link_dead", failing="link_probe",
+                               source=sample.source)
+
+    # ---- gauges ----------------------------------------------------------
+
+    def attach(self, registry) -> None:
+        """Publish ``link_*`` gauges into ``registry`` on every sample
+        from now on (weakly held — a closed app's registry just ages
+        out). The latest sample, if any, is published immediately so
+        /metrics carries the series as soon as serving starts."""
+        with self._lock:
+            if not any(r() is registry for r in self._registries):
+                self._registries.append(weakref.ref(registry))
+            last = self._ring[-1] if self._ring else None
+            if last is not None:
+                self._publish_locked(last)
+
+    def _publish_locked(self, sample: LinkSample) -> None:
+        # caller holds self._lock; registry writes are leaf O(1) dict
+        # ops (contract edge linkhealth.state -> metrics.registry)
+        alive = []
+        for ref in self._registries:
+            reg = ref()
+            if reg is None:
+                continue
+            alive.append(ref)
+            if sample.rtt_s is not None:
+                reg.gauge("link_rtt_ms", sample.rtt_s * 1e3)
+            if sample.mbps is not None:
+                reg.gauge("link_mbps", sample.mbps)
+            reg.gauge("link_mood", MOOD_LEVELS[sample.mood])
+            reg.gauge("link_dead_probes", self.dead_probes_total)
+            reg.gauge("link_probes", self.probes_total)
+        self._registries[:] = alive
+
+    # ---- read side -------------------------------------------------------
+
+    def latest(self) -> "LinkSample | None":
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def samples(self) -> "list[LinkSample]":
+        with self._lock:
+            return list(self._ring)
+
+    def probe_duty_pct(self) -> "float | None":
+        """Measured probe duty over the sampler's lifetime — the
+        recorded form of the <0.5% steady-state claim."""
+        with self._lock:
+            if self._started_at is None:
+                return None
+            up = max(self.clock() - self._started_at, 1e-6)
+            return round(100.0 * self.probe_seconds_total / up, 4)
+
+    def window(self, since: "float | None" = None) -> dict:
+        """The contemporaneous link window [since, now] every journaled
+        bench leg is stamped with: median rtt/bandwidth + WORST mood in
+        the window (dead > degraded > healthy/cpu; a leg that straddled
+        a dead spell says so). Falls back to the latest sample when the
+        window itself is empty (long leg gaps between low-duty probes),
+        and to mood None when nothing was ever sampled."""
+        with self._lock:
+            xs = [s for s in self._ring
+                  if since is None or s.t >= since]
+            if not xs and self._ring:
+                xs = [self._ring[-1]]
+        if not xs:
+            return {"rtt_ms": None, "mbps": None, "mood": None,
+                    "samples": 0}
+        rtts = sorted(s.rtt_s for s in xs if s.rtt_s is not None)
+        bws = sorted(s.mbps for s in xs if s.mbps is not None)
+        mood = max(xs, key=lambda s: _SEVERITY[s.mood]).mood
+        return {
+            "rtt_ms": (None if not rtts
+                       else round(rtts[len(rtts) // 2] * 1e3, 2)),
+            "mbps": (None if not bws
+                     else round(bws[len(bws) // 2], 2)),
+            "mood": mood,
+            "samples": len(xs),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-global sampler (the tracer()/faults.active() discipline): bench
+# and every ReporterApp share ONE probe thread + one recorded mood.
+
+_global: "LinkHealthSampler | None" = None
+_global_lock = locks.named_lock("linkhealth.registry")
+
+
+def sampler() -> LinkHealthSampler:
+    """THE process sampler (constructed lazily, env-configured, NOT
+    started — ``ensure_serving``/bench start it)."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = LinkHealthSampler()
+        return _global
+
+
+def configure(s: "LinkHealthSampler | None") -> None:
+    """Swap the process sampler (tests install a fake-probe instance;
+    pass None to reset to lazy construction)."""
+    global _global
+    with _global_lock:
+        _global = s
+
+
+def enabled() -> bool:
+    """``RTPU_LINK_PROBE`` gate, default ON (strict parse: a typo'd
+    lever must raise, not silently probe — the config.py discipline)."""
+    raw = os.environ.get(_ENV_PROBE)
+    if raw is None or not raw.strip():
+        return True
+    return tracing.env_flag(raw, strict=True)
+
+
+def ensure_serving(registry) -> "LinkHealthSampler | None":
+    """Serving-face hook (ReporterApp construction): attach the app's
+    registry to the process sampler and start the probe thread if the
+    env gate allows. Returns the sampler (None when disabled) —
+    /metrics then carries ``rtpu_link_*`` for the app's lifetime."""
+    if not enabled():
+        return None
+    s = sampler()
+    s.attach(registry)
+    s.start()
+    return s
+
+
+def note_dispatch_timeout(reason: str = "dispatch_timeout",
+                          **args) -> None:
+    """Module-level dead-link signal for sites that don't hold a sampler
+    (matcher dispatch watchdog). No-op when no sampler was ever
+    constructed — arming telemetry must never be a prerequisite for
+    dispatching."""
+    with _global_lock:
+        s = _global
+    if s is not None:
+        s.note_dispatch_timeout(reason, **args)

@@ -1,0 +1,205 @@
+"""Round-15 regression sentinel (analysis/bench_delta.py).
+
+Both directions, per the r14 gate discipline: the classifier must FIRE
+on seeded regressions (direction-aware, schema-aware), must NOT blame
+the code for deltas the recorded link mood excuses, and must survive
+real archived captures (the acceptance run: an archived composite vs
+the committed one) without crashing or inventing regressions from
+schema drift.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from reporter_tpu.analysis import bench_delta as bd
+
+_ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _doc(value, link=None, **detail):
+    d = dict(detail)
+    if link is not None:
+        d["link_health"] = link
+    return {"metric": "probes_per_sec_e2e", "value": value,
+            "unit": "probes/s", "vs_baseline": 1.0, "detail": d}
+
+
+HEALTHY = {"mood": "healthy", "rtt_ms": 130.0, "mbps": 25.0}
+DEGRADED = {"mood": "degraded", "rtt_ms": 450.0, "mbps": 8.0}
+
+
+def test_direction_classification():
+    assert bd.classify_direction("probes_per_sec_e2e") == 1
+    assert bd.classify_direction("native_krows_per_s") == 1
+    assert bd.classify_direction("speedup") == 1
+    assert bd.classify_direction("p50_probe_to_report_ms") == -1
+    assert bd.classify_direction("disagreement") == -1
+    assert bd.classify_direction("lost_reports") == -1
+    # config/workload leaves are never compared
+    assert bd.classify_direction("clients") == 0
+    assert bd.classify_direction("seconds") == 0
+    assert bd.classify_direction("rtt_ms") == 0      # a CONDITION
+
+
+def test_link_sensitivity():
+    assert bd.is_link_sensitive("detail.probes_per_sec_e2e")
+    assert bd.is_link_sensitive("detail.streaming_soak.sustained_pps")
+    assert not bd.is_link_sensitive(
+        "detail.colocated_e2e.sf")
+    assert not bd.is_link_sensitive(
+        "detail.sweep_ab.mxu.device_probes_per_sec")
+    assert not bd.is_link_sensitive("detail.audit.sf.disagreement")
+    assert not bd.is_link_sensitive(
+        "detail.prepare_bench.native_krows_per_s")
+
+
+def test_same_mood_regression_is_blamed():
+    old = _doc(1e6, link=HEALTHY)
+    new = _doc(7e5, link=dict(HEALTHY, rtt_ms=132.0))
+    d = bd.compare(old, new)
+    assert [r["path"] for r in d["regressions"]] == [
+        "headline_probes_per_sec_e2e"]
+    assert d["link_attributable"] == []
+    assert bd.summary_token(d) == [1, 0, -30.0]
+
+
+def test_mood_change_attributes_link_sensitive_deltas():
+    old = _doc(1e6, link=HEALTHY,
+               device_compute={"colocated_probes_per_sec": 3e6})
+    new = _doc(7e5, link=DEGRADED,
+               device_compute={"colocated_probes_per_sec": 1.5e6})
+    d = bd.compare(old, new)
+    # the e2e drop rides the degraded link; the DEVICE-ONLY drop cannot
+    assert [r["path"] for r in d["regressions"]] == [
+        "detail.device_compute.colocated_probes_per_sec"]
+    assert [r["path"] for r in d["link_attributable"]] == [
+        "headline_probes_per_sec_e2e"]
+    assert d["link_attributable"][0]["verdict"] == "link-drift"
+
+
+def test_missing_link_window_flags_not_blames():
+    old = _doc(1e6)                      # pre-r15 capture: no window
+    new = _doc(7e5, link=HEALTHY)
+    d = bd.compare(old, new)
+    assert d["link"]["drifted"] is None
+    assert d["regressions"] == []
+    assert d["link_attributable"][0]["verdict"] == "link-unknown"
+
+
+def test_rtt_band_drift_without_mood_change():
+    old = _doc(1e6, link=HEALTHY)
+    new = _doc(7e5, link=dict(HEALTHY, rtt_ms=260.0))   # 2x, same mood
+    d = bd.compare(old, new)
+    assert d["link"]["drifted"] is True
+    assert d["link_attributable"][0]["verdict"] == "link-drift"
+
+
+def test_improvements_and_flats_are_counted_not_listed():
+    old = _doc(1e6, link=HEALTHY, p50_single_trace_latency_ms=120.0)
+    new = _doc(2e6, link=HEALTHY, p50_single_trace_latency_ms=121.0)
+    d = bd.compare(old, new)
+    assert d["improved"] == 1            # value doubled
+    assert d["regressions"] == [] and d["link_attributable"] == []
+
+
+def test_schema_drift_is_counted_never_a_regression():
+    old = _doc(1e6, link=HEALTHY, metro={"probes_per_sec_e2e": 2e6})
+    new = _doc(1e6, link=HEALTHY, fleet={"mixed": {"probes_per_sec": 1e5}})
+    d = bd.compare(old, new)
+    assert d["regressions"] == []
+    assert d["only_old_keys"] >= 1 and d["only_new_keys"] >= 1
+
+
+def test_mixed_key_types_align_after_json_round_trip():
+    # the NEW doc is in-memory (int histogram keys); the OLD one loaded
+    # from disk (str keys) — the walk must align them, not crash
+    old = json.loads(json.dumps(
+        _doc(1e6, link=HEALTHY, hist={2: 5, 3: 7})))
+    new = _doc(1e6, link=HEALTHY, hist={2: 5, 3: 7})
+    d = bd.compare(old, new)
+    assert d["only_old_keys"] == 0 and d["only_new_keys"] == 0
+
+
+def test_compact_bounds_the_embed():
+    old = _doc(1e6, link=HEALTHY,
+               tiles={f"t{i}": {"probes_per_sec_e2e": 1e6}
+                      for i in range(40)})
+    new = _doc(1e6, link=HEALTHY,
+               tiles={f"t{i}": {"probes_per_sec_e2e": 1e5}
+                      for i in range(40)})
+    d = bd.compare(old, new)
+    c = bd.compact(d, top=12)
+    assert len(c["regressions"]) == 12
+    assert c["regressions_total"] == 40
+
+
+def test_summary_token_shape():
+    assert bd.summary_token(None) == [None, None, None]
+
+
+def test_archived_captures_acceptance():
+    """The acceptance run: bench_archive/r7 vs the committed root
+    capture — a correct schema-aware table, no crash, and (these two
+    files being byte-identical captures of the same run) zero invented
+    regressions."""
+    old_p = os.path.join(_ROOT, "bench_archive", "r7",
+                         "BENCH_DETAIL_pre_r8.json")
+    new_p = os.path.join(_ROOT, "BENCH_DETAIL.json")
+    with open(old_p) as f:
+        old = json.load(f)
+    with open(new_p) as f:
+        new = json.load(f)
+    d = bd.compare(old, new)
+    assert d["compared"] > 50            # a real composite's metric set
+    assert d["regressions"] == []        # identical capture content
+    out = bd.render(d)
+    assert "compared" in out and "REGRESSIONS" in out
+
+
+def test_chip_vs_cpu_captures_produce_an_attributed_table():
+    """Cross-flavor diff (the nastiest real input: huge schema drift,
+    no link windows on either side) must classify, not crash."""
+    with open(os.path.join(_ROOT, "BENCH_DETAIL.json")) as f:
+        old = json.load(f)
+    with open(os.path.join(_ROOT, "BENCH_DETAIL_CPU.json")) as f:
+        new = json.load(f)
+    d = bd.compare(old, new)
+    assert d["compared"] > 0
+    # pre-r15 captures carry no link window: link-sensitive drops are
+    # flagged link-unknown, never silently blamed or excused
+    assert all(r["verdict"] == "link-unknown"
+               for r in d["link_attributable"])
+    bd.render(d)                         # table renders
+
+
+def test_cli_runs_and_exits_zero(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "reporter_tpu.analysis.bench_delta",
+         os.path.join(_ROOT, "bench_archive", "r7",
+                      "BENCH_DETAIL_pre_r8.json"),
+         os.path.join(_ROOT, "BENCH_DETAIL.json")],
+        capture_output=True, timeout=120, cwd=_ROOT)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert b"compared" in out.stdout
+
+
+def test_zero_baseline_regression_is_surfaced():
+    """errors=0 -> errors=37 is THE transition a sentinel exists for;
+    a zero baseline has no percentage but must still classify (most
+    severe, sorts first), and 37 -> 0 reads as an improvement."""
+    old = _doc(1e6, link=HEALTHY, publish_outage={"errors": 0})
+    new = _doc(1e6, link=HEALTHY, publish_outage={"errors": 37})
+    d = bd.compare(old, new)
+    assert [r["path"] for r in d["regressions"]] == [
+        "detail.publish_outage.errors"]
+    assert d["regressions"][0]["delta_pct"] is None
+    assert bd.summary_token(d)[0] == 1
+    bd.render(d)                         # None pct renders, no crash
+    healed = bd.compare(new, old)
+    assert healed["regressions"] == [] and healed["improved"] == 1

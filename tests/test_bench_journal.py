@@ -1,0 +1,268 @@
+"""Round-15 capture journal (bench.BenchJournal) — crash-safety + resume.
+
+The journal is what makes a chip capture land-able on a flaky tunnel:
+every completed leg is an atomic append (tmp+fsync+rename, the r9
+checkpoint discipline), ``--resume`` serves journaled legs instead of
+re-measuring, and a torn tail is truncated at reopen, never fatal. The
+acceptance shape (the r9 chaos discipline, applied to the bench itself):
+a SIGKILLed bench run resumed with ``--resume`` yields a composite whose
+pre-kill legs are BYTE-identical to what the killed run journaled.
+
+bench.py's top-level imports are stdlib-only, so loading it here never
+touches jax; the SIGKILL tests run a real subprocess through the real
+journal class.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench_module", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_META = {"config": {"n_traces": 16, "city": "sf", "tpu_ok": False,
+                    "manual": False},
+         "git_sha": "abc123", "round": "r15"}
+
+
+# ---------------------------------------------------------------------------
+# unit: append / resume / filter
+
+
+def test_journal_appends_atomically_and_replays(tmp_path):
+    bench = _load_bench()
+    path = str(tmp_path / "j.jsonl")
+    j = bench.BenchJournal(path, meta=_META)
+    out = j.leg("alpha", lambda: {"pps": 123.4})
+    assert out == {"pps": 123.4}
+    lines = [json.loads(ln) for ln in open(path).read().splitlines()]
+    assert lines[0]["journal"] == "bench"
+    assert lines[0]["config"] == _META["config"]
+    assert lines[1]["leg"] == "alpha"
+    assert lines[1]["result"] == {"pps": 123.4}
+    assert "link" in lines[1] and "captured_at" in lines[1]
+    assert not os.path.exists(path + ".tmp")    # rename completed
+
+    # resume: the leg fn must NOT run again
+    j2 = bench.BenchJournal(path, meta=_META, resume=True)
+
+    def explode():
+        raise AssertionError("journaled leg re-measured on resume")
+
+    assert j2.leg("alpha", explode) == {"pps": 123.4}
+    assert "alpha" in j2.reused
+    # a new leg appends after the replayed one
+    assert j2.leg("beta", lambda: {"x": 1}) == {"x": 1}
+    names = [json.loads(ln).get("leg")
+             for ln in open(path).read().splitlines()]
+    assert names == [None, "alpha", "beta"]
+
+
+def test_journal_legs_filter_skips(tmp_path):
+    bench = _load_bench()
+    j = bench.BenchJournal(str(tmp_path / "j.jsonl"), meta=_META,
+                           only={"beta"})
+    assert j.leg("alpha", lambda: 1) is None    # excluded: never runs
+    assert j.leg("beta", lambda: 2) == 2
+
+
+def test_torn_tail_truncated_at_reopen_not_fatal(tmp_path):
+    bench = _load_bench()
+    path = str(tmp_path / "j.jsonl")
+    j = bench.BenchJournal(path, meta=_META)
+    j.leg("alpha", lambda: {"pps": 1.0})
+    j.leg("beta", lambda: {"pps": 2.0})
+    with open(path, "a") as f:
+        f.write('{"leg": "gamma", "result": {"pp')   # torn append
+    j2 = bench.BenchJournal(path, meta=_META, resume=True)
+    assert set(j2.entries) == {"alpha", "beta"}
+    assert j2.truncated_lines == 1
+    # the reopened journal is clean again (the torn line is gone on disk)
+    for ln in open(path).read().splitlines():
+        json.loads(ln)
+
+
+def test_resume_rejected_on_config_or_sha_change(tmp_path):
+    bench = _load_bench()
+    path = str(tmp_path / "j.jsonl")
+    j = bench.BenchJournal(path, meta=_META)
+    j.leg("alpha", lambda: 1)
+    other = dict(_META, config=dict(_META["config"], n_traces=9999))
+    j2 = bench.BenchJournal(path, meta=other, resume=True)
+    assert j2.resume_rejected and "config" in j2.resume_rejected
+    assert not j2.entries                   # stale legs must not leak in
+
+    path2 = str(tmp_path / "j2.jsonl")
+    j = bench.BenchJournal(path2, meta=_META)
+    j.leg("alpha", lambda: 1)
+    j3 = bench.BenchJournal(path2, meta=dict(_META, git_sha="zzz"),
+                            resume=True)
+    assert j3.resume_rejected and "git_sha" in j3.resume_rejected
+    assert not j3.entries
+
+
+def test_main_wires_every_leg_through_the_journal():
+    """Source pin: each registered leg name must be dispatched via
+    journal.leg(...) in main — a leg that bypasses the journal is
+    invisible to --resume/--legs and zeroes on a tunnel death again."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench.main)
+    for name in bench._ALL_LEGS:
+        assert f'journal.leg("{name}"' in src, name
+    assert "BenchJournal(" in src
+    assert "_staleness_banner()" in src
+    assert "_bench_delta_tail(" in src
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL a bench subprocess between legs, resume, compare bytes
+
+
+_DRIVER = """
+import importlib.util, json, os, sys, time
+spec = importlib.util.spec_from_file_location("bench_module", {bench!r})
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+meta = json.loads({meta!r})
+resume = "--resume" in sys.argv
+j = mod.BenchJournal({path!r}, meta=meta, resume=resume)
+r = {{}}
+r["alpha"] = j.leg("alpha", lambda: {{"pps": 123.25, "cfg": "16x4"}})
+r["beta"] = j.leg("beta", lambda: {{"pps": 77.5}})
+open({marker!r}, "w").write("beta-done")
+if not resume:
+    time.sleep(30)                      # parent SIGKILLs in this gap
+r["gamma"] = j.leg("gamma", lambda: {{"pps": 55.125}})
+print(json.dumps(r))
+"""
+
+
+def test_sigkill_between_legs_then_resume_byte_identical(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    marker = str(tmp_path / "marker")
+    driver = str(tmp_path / "driver.py")
+    with open(driver, "w") as f:
+        f.write(_DRIVER.format(bench=os.path.abspath(_BENCH), path=path,
+                               marker=marker, meta=json.dumps(_META)))
+
+    # the driver's sys.path[0] is tmp_path, not the repo root — the
+    # journal's linkhealth import needs the package on PYTHONPATH
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.abspath(_BENCH))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    # run 1: SIGKILL after beta lands, before gamma (the r9 kill shape:
+    # a real kill -9, no drain, no atexit)
+    proc = subprocess.Popen([sys.executable, driver],
+                            stdout=subprocess.PIPE, env=env)
+    t0 = time.time()
+    while not os.path.exists(marker):
+        assert time.time() - t0 < 60, "driver never reached the marker"
+        assert proc.poll() is None, "driver exited before the kill"
+        time.sleep(0.01)
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+
+    pre_kill = {json.loads(ln)["leg"]: ln
+                for ln in open(path).read().splitlines()[1:]}
+    assert set(pre_kill) == {"alpha", "beta"}   # gamma never landed
+
+    # run 2: --resume completes the composite; the pre-kill legs must be
+    # byte-identical lines (replayed, not re-measured — their results,
+    # link windows, and capture timestamps are the killed run's)
+    out = subprocess.run([sys.executable, driver, "--resume"],
+                         stdout=subprocess.PIPE, timeout=60, check=True,
+                         env=env)
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["alpha"] == {"pps": 123.25, "cfg": "16x4"}
+    assert result["gamma"] == {"pps": 55.125}
+    post = {json.loads(ln)["leg"]: ln
+            for ln in open(path).read().splitlines()[1:]}
+    assert set(post) == {"alpha", "beta", "gamma"}
+    for leg in ("alpha", "beta"):
+        assert post[leg] == pre_kill[leg], (
+            f"pre-kill leg {leg} not byte-identical through resume")
+
+
+def test_sigkill_mid_append_leaves_previous_journal_intact(tmp_path):
+    """The tmp+fsync+rename discipline: a crash BEFORE the rename leaves
+    the old journal byte-identical (simulated as an orphan .tmp — the
+    only intermediate state the writer can die in)."""
+    bench = _load_bench()
+    path = str(tmp_path / "j.jsonl")
+    j = bench.BenchJournal(path, meta=_META)
+    j.leg("alpha", lambda: 1)
+    before = open(path).read()
+    with open(path + ".tmp", "w") as f:
+        f.write('{"journal": "bench"}\n{"leg": "half')  # died pre-rename
+    j2 = bench.BenchJournal(path, meta=_META, resume=True)
+    assert set(j2.entries) == {"alpha"}
+    assert j2.truncated_lines == 0          # main file was never torn
+
+
+# ---------------------------------------------------------------------------
+# the real CLI: --legs subset on the CPU validation path
+
+
+def test_bench_legs_subset_cli_under_three_minutes(tmp_path):
+    """Acceptance: `python bench.py --legs sweep_ab` completes standalone
+    on the no-chip path well inside a short tunnel window, journals the
+    leg with a link window, records mood="cpu" in the summary link
+    token, and writes the PARTIAL detail file (never clobbering the
+    committed full capture)."""
+    env = dict(os.environ)
+    env["REPORTER_BENCH_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    cpu_capture = os.path.join(os.path.dirname(_BENCH),
+                               "BENCH_DETAIL_CPU.json")
+    committed = (open(cpu_capture).read()
+                 if os.path.exists(cpu_capture) else None)
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(_BENCH), "--legs", "sweep_ab"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        timeout=180, env=env, cwd=str(tmp_path))
+    took = time.time() - t0
+    assert out.returncode == 0, out.stdout[-2000:]
+    assert took < 180.0
+    summary = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    assert summary["link"][2] == "cpu"      # mood recorded, not omitted
+    assert summary["sweep_kpps"][3] == 1    # identity bits still proven
+    # the committed full CPU capture was not clobbered by the subset
+    if committed is not None:
+        assert open(cpu_capture).read() == committed
+    journal_path = os.path.join(os.path.dirname(os.path.abspath(_BENCH)),
+                                "bench_journal.jsonl")
+    entries = [json.loads(ln)
+               for ln in open(journal_path).read().splitlines()]
+    legs = {e.get("leg"): e for e in entries[1:]}
+    assert "sweep_ab" in legs
+    assert legs["sweep_ab"]["link"]["mood"] == "cpu"
+    assert entries[0].get("staleness_banner") is None \
+        or "STALE" in entries[0]["staleness_banner"]
+
+
+def test_bench_rejects_unknown_legs():
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(_BENCH), "--legs", "nope"],
+        capture_output=True, timeout=60, env=env)
+    assert out.returncode == 2              # argparse error, pre-probe
+    assert b"unknown legs" in out.stderr

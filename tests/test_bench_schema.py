@@ -415,3 +415,96 @@ def test_service_overload_boundary_rules():
     errs = held[:2] + [lvl(256, 300.0, 900.0, errors=3)]
     assert bench._service_overload_boundary(errs) == {
         "clients": 256, "reason": "errors"}
+
+
+# ---------------------------------------------------------------------------
+# Round 15: capture journal + link-health + regression sentinel pins
+# (extend these sets, never drop — the resumability and attribution
+# stories live in these keys)
+
+
+def test_journal_entry_schema_keys():
+    """Every journaled leg must carry result + provenance (wall time,
+    capture timestamp, link window); the composite's journal block must
+    name what was resumed and what was truncated."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench.BenchJournal.leg)
+    for key in ("leg", "seconds", "captured_at", "link", "result"):
+        assert f'"{key}"' in src, key
+    src_j = inspect.getsource(bench.BenchJournal.to_json)
+    for key in ("resumed_legs", "truncated_lines", "legs"):
+        assert f'"{key}"' in src_j, key
+    # the journal's write path is the r9 atomic discipline
+    src_w = inspect.getsource(bench.BenchJournal._write_all)
+    assert "fsync" in src_w and "os.replace" in src_w
+
+
+def test_link_window_schema_keys():
+    from reporter_tpu.utils.linkhealth import LinkHealthSampler
+    import inspect
+
+    src = inspect.getsource(LinkHealthSampler.window)
+    for key in ("rtt_ms", "mbps", "mood", "samples"):
+        assert f'"{key}"' in src, key
+    bench = _load_bench()
+    src_m = inspect.getsource(bench.main)
+    for key in ("probe_duty_pct", "dead_probes", "link_health"):
+        assert f'"{key}"' in src_m, key
+
+
+def test_delta_report_schema_keys():
+    import inspect
+
+    from reporter_tpu.analysis import bench_delta
+
+    src = inspect.getsource(bench_delta.compare)
+    for key in ("regressions", "link_attributable", "compared", "flat",
+                "improved", "only_old_keys", "only_new_keys",
+                "threshold_pct"):
+        assert f'"{key}"' in src, key
+    src_c = inspect.getsource(bench_delta.compact)
+    for key in ("regressions_total", "link_attributable_total"):
+        assert f'"{key}"' in src_c, key
+
+
+def test_summary_line_carries_link_token():
+    """link = [rtt_ms (int), mbps, mood]; the CPU path records
+    mood="cpu" — the token is never omitted (r15 satellite)."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "link_health": {"rtt_ms": 130.25, "mbps": 25.13,
+                               "mood": "healthy", "samples": 40},
+           }}
+    assert bench._summary_line(doc)["link"] == [130, 25.1, "healthy"]
+    doc["detail"]["link_health"] = {"rtt_ms": None, "mbps": None,
+                                    "mood": "cpu", "samples": 2}
+    assert bench._summary_line(doc)["link"] == [None, None, "cpu"]
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["link"] == [None, None, None]
+
+
+def test_summary_line_carries_delta_token():
+    """delta = [regressions, link-attributable, worst regression %] vs
+    the committed same-flavor capture."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "bench_delta": {
+                   "regressions_total": 3,
+                   "link_attributable_total": 5,
+                   "regressions": [
+                       {"path": "detail.xl.probes_per_sec_e2e",
+                        "delta_pct": -42.7},
+                       {"path": "detail.streaming.probes_per_sec",
+                        "delta_pct": -12.0}]},
+           }}
+    assert bench._summary_line(doc)["delta"] == [3, 5, -42.7]
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["delta"] == [None] * 3

@@ -113,6 +113,14 @@ def _synthetic_doc():
                   "storm": {"promote_p50_ms": 1234.56},
                   "occupancy": {"promotions": 12345, "demotions": 12321},
                   "fidelity": {"wires_bit_identical": True}},
+        "link_health": {"rtt_ms": 1129.22, "mbps": 125.13,
+                        "mood": "degraded", "samples": 123,
+                        "probe_duty_pct": 0.4123},
+        "bench_delta": {"regressions_total": 123,
+                        "link_attributable_total": 123,
+                        "regressions": [
+                            {"path": "detail.xl.probes_per_sec_e2e",
+                             "delta_pct": -123.45}]},
         "total_seconds": 801.5,
     }
     return {"metric": "probes_per_sec_e2e", "value": 2280000.1,

@@ -1,0 +1,374 @@
+"""Round-15 link-health telemetry (utils/linkhealth.py).
+
+The sampler is the measurement-conditions recorder every bench leg and
+/metrics scrape depends on, so both directions get tested: probes
+classify into the right mood (healthy / degraded / dead / cpu), windows
+summarize WORST-mood (a dead spell inside a long leg must not average
+away), gauges land in attached registries under the ``rtpu_link_*``
+names, dead-link DETECTION (transition, not every dead sample) dumps
+one flight-recorder post-mortem, and the matcher's dispatch watchdog
+feeds the sampler without forking the post-mortem site.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from reporter_tpu.utils import linkhealth, locks, tracing
+from reporter_tpu.utils.metrics import MetricsRegistry
+
+
+def _sampler(probe, **kw):
+    kw.setdefault("period_s", 60.0)
+    kw.setdefault("dead_timeout_s", 2.0)
+    return linkhealth.LinkHealthSampler(probe=probe, **kw)
+
+
+# ---------------------------------------------------------------------------
+# classification
+
+
+def test_healthy_degraded_thresholds():
+    s = _sampler(lambda n: (0.13, 25.0))
+    assert s.sample_once().mood == "healthy"
+    slow_rtt = _sampler(lambda n: (0.9, 25.0))
+    assert slow_rtt.sample_once().mood == "degraded"
+    slow_bw = _sampler(lambda n: (0.13, 1.0))
+    assert slow_bw.sample_once().mood == "degraded"
+
+
+def test_probe_exception_classifies_dead():
+    def boom(n):
+        raise RuntimeError("tunnel tore down mid-transfer")
+
+    s = _sampler(boom)
+    x = s.sample_once()
+    assert x.mood == "dead"
+    assert "probe_error" in x.source
+    assert s.dead_probes_total == 1
+
+
+def test_probe_timeout_classifies_dead():
+    def stall(n):
+        time.sleep(0.6)
+        return 0.1, 25.0
+
+    s = _sampler(stall, dead_timeout_s=0.05)
+    x = s.sample_once()
+    assert x.mood == "dead"
+    assert x.source == "probe_timeout"
+
+
+def test_cpu_backend_probe_reports_cpu_mood():
+    # conftest pins the CPU platform: the DEFAULT device probe must
+    # classify "cpu", never pretend a link exists (the satellite:
+    # CPU-forced composites record mood="cpu", not an omitted token)
+    s = linkhealth.LinkHealthSampler(dead_timeout_s=5.0)
+    x = s.sample_once()
+    assert x.mood == "cpu"
+    assert x.rtt_s is None and x.mbps is None
+
+
+# ---------------------------------------------------------------------------
+# window summarization
+
+
+def test_window_reports_worst_mood_and_medians():
+    moods = iter([(0.10, 25.0), (0.12, 24.0), (None, None)])
+
+    def probe(n):
+        rtt, bw = next(moods)
+        if rtt is None:
+            raise RuntimeError("dead spell")
+        return rtt, bw
+
+    s = _sampler(probe)
+    t0 = s.clock()
+    for _ in range(3):
+        s.sample_once()
+    w = s.window(since=t0)
+    assert w["mood"] == "dead"          # worst in window, not latest avg
+    assert w["samples"] == 3
+    assert w["rtt_ms"] == pytest.approx(110.0, abs=15.0)
+
+
+def test_window_falls_back_to_latest_sample():
+    s = _sampler(lambda n: (0.13, 25.0))
+    s.sample_once()
+    w = s.window(since=s.clock() + 100.0)   # empty window (low duty)
+    assert w["samples"] == 1 and w["mood"] == "healthy"
+    empty = _sampler(lambda n: (0.1, 25.0))
+    assert empty.window()["mood"] is None
+
+
+def test_ring_is_bounded():
+    s = _sampler(lambda n: (0.1, 25.0), ring=8)
+    for _ in range(20):
+        s.sample_once()
+    assert len(s.samples()) == 8
+    assert s.probes_total == 20
+
+
+# ---------------------------------------------------------------------------
+# gauges / metrics integration
+
+
+def test_gauges_publish_into_attached_registry():
+    s = _sampler(lambda n: (0.2, 12.5))
+    reg = MetricsRegistry()
+    s.attach(reg)
+    s.sample_once()
+    snap = reg.snapshot()
+    assert snap["link_rtt_ms"] == pytest.approx(200.0)
+    assert snap["link_mbps"] == pytest.approx(12.5)
+    assert snap["link_mood"] == linkhealth.MOOD_LEVELS["healthy"]
+    prom = reg.render_prometheus()
+    for name in ("rtpu_link_rtt_ms", "rtpu_link_mbps", "rtpu_link_mood",
+                 "rtpu_link_probes", "rtpu_link_dead_probes"):
+        assert name in prom, name
+
+
+def test_attach_replays_latest_sample():
+    s = _sampler(lambda n: (0.1, 25.0))
+    s.sample_once()
+    reg = MetricsRegistry()
+    s.attach(reg)                       # no new probe needed
+    assert reg.snapshot()["link_mood"] == 0.0
+
+
+def test_probe_duty_is_measured():
+    def probe(n):
+        time.sleep(0.01)
+        return 0.1, 25.0
+
+    s = _sampler(probe)
+    s.start()
+    try:
+        for _ in range(50):
+            if s.probes_total >= 1:
+                break
+            time.sleep(0.02)
+    finally:
+        s.stop()
+    duty = s.probe_duty_pct()
+    assert duty is not None and duty >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# dead-link detection -> tracer post-mortem (transition-only)
+
+
+def test_dead_transition_dumps_one_post_mortem(tmp_path):
+    from reporter_tpu.analysis import global_state
+
+    pre = global_state.snapshot()
+    tr = tracing.tracer()
+    tr.configure(enabled=True, dump_dir=str(tmp_path))
+    try:
+        calls = iter([(0.1, 25.0), None, None])
+
+        def probe(n):
+            v = next(calls)
+            if v is None:
+                raise RuntimeError("dead")
+            return v
+
+        s = _sampler(probe)
+        s.sample_once()                  # healthy
+        before = tr.dumps_written
+        s.sample_once()                  # healthy -> dead: ONE dump
+        s.sample_once()                  # dead -> dead: no new dump
+        assert tr.dumps_written == before + 1
+        dumps = [p for p in os.listdir(tmp_path) if "link_dead" in p]
+        assert len(dumps) == 1
+        doc = json.load(open(os.path.join(tmp_path, dumps[0])))
+        assert doc["reason"] == "link_dead"
+        assert doc["failing_span"] == "link_probe"
+    finally:
+        tr.configure(enabled=pre["tracer.enabled"],
+                     dump_dir=pre["tracer.dump_dir"])
+    assert global_state.diff(pre, global_state.snapshot()) == []
+
+
+def test_note_dispatch_timeout_records_without_its_own_dump(tmp_path):
+    from reporter_tpu.analysis import global_state
+
+    pre = global_state.snapshot()
+    tr = tracing.tracer()
+    tr.configure(enabled=True, dump_dir=str(tmp_path))
+    try:
+        s = _sampler(lambda n: (0.1, 25.0))
+        s.sample_once()
+        before = tr.dumps_written
+        # the watchdog site already post-mortems; the note must only
+        # record the sample + gauges (one event, one dump)
+        s.note_dispatch_timeout("dispatch_timeout")
+        assert tr.dumps_written == before
+        assert s.latest().mood == "dead"
+        assert s.latest().source == "dispatch_timeout"
+    finally:
+        tr.configure(enabled=pre["tracer.enabled"],
+                     dump_dir=pre["tracer.dump_dir"])
+    assert global_state.diff(pre, global_state.snapshot()) == []
+
+
+def test_matcher_watchdog_is_wired_to_linkhealth():
+    """Source pin (the schema-pin discipline): the dispatch-timeout
+    branch must feed linkhealth — the dead-link signal the ISSUE routes
+    through the EXISTING watchdog site instead of a fork."""
+    import inspect
+
+    from reporter_tpu.matcher import api
+
+    src = inspect.getsource(api.SegmentMatcher._guarded_jax_many)
+    assert "linkhealth.note_dispatch_timeout" in src
+
+
+def test_module_note_forwards_to_installed_sampler():
+    s = _sampler(lambda n: (0.1, 25.0))
+    prev = linkhealth._global
+    linkhealth.configure(s)
+    try:
+        linkhealth.note_dispatch_timeout("dispatch_timeout")
+        assert s.latest() is not None and s.latest().mood == "dead"
+    finally:
+        linkhealth.configure(prev)
+    # and a process with no sampler constructed: a plain no-op
+    linkhealth.configure(None)
+    try:
+        linkhealth.note_dispatch_timeout("dispatch_timeout")
+    finally:
+        linkhealth.configure(prev)
+
+
+# ---------------------------------------------------------------------------
+# env gate / serving integration
+
+
+def test_env_gate_default_on_and_strict(monkeypatch):
+    monkeypatch.delenv("RTPU_LINK_PROBE", raising=False)
+    assert linkhealth.enabled() is True
+    monkeypatch.setenv("RTPU_LINK_PROBE", "0")
+    assert linkhealth.enabled() is False
+    monkeypatch.setenv("RTPU_LINK_PROBE", "bogus")
+    with pytest.raises(ValueError):
+        linkhealth.enabled()            # the typo'd-lever discipline
+
+
+def test_ensure_serving_respects_disable(monkeypatch):
+    monkeypatch.setenv("RTPU_LINK_PROBE", "0")
+    assert linkhealth.ensure_serving(MetricsRegistry()) is None
+
+
+def test_app_metrics_and_health_carry_link(tiny_tiles):
+    from reporter_tpu.config import Config
+    from reporter_tpu.service.app import ReporterApp
+
+    prev = linkhealth._global
+    s = _sampler(lambda n: (0.13, 25.0))
+    linkhealth.configure(s)
+    try:
+        app = ReporterApp(tiny_tiles, Config(matcher_backend="jax"),
+                          transport=lambda u, b: 200)
+        try:
+            # construction attached the app's registry + started the
+            # sampler; force one deterministic sample for the asserts
+            s.sample_once()
+            prom = app.matcher.metrics.render_prometheus()
+            assert "rtpu_link_mood" in prom
+            assert "rtpu_link_rtt_ms" in prom
+            link = app.health()["link"]
+            assert link["mood"] == "healthy"
+            assert link["rtt_ms"] == pytest.approx(130.0)
+        finally:
+            app.close()
+    finally:
+        s.stop()
+        linkhealth.configure(prev)
+
+
+# ---------------------------------------------------------------------------
+# concurrency contract (r14 pattern: seed a synthetic violation for the
+# new lock class so the gate guarding it can't rot vacuous-green)
+
+
+def test_sampler_lock_class_blocking_hold_would_be_flagged():
+    dep = locks.Lockdep()
+    lk = locks.NamedLock("linkhealth.state", dep=dep)
+    with locks.use(dep):
+        with lk:
+            time.sleep(0)               # a probe under the state lock
+    assert any(v["kind"] == "blocking-under-lock"
+               and v["call"] == "time.sleep" for v in dep.violations), (
+        "a blocking probe under linkhealth.state must be a lockdep "
+        "violation — the sampler's design runs probes OUTSIDE the lock")
+
+
+def test_sampler_never_probes_under_its_lock():
+    """Behavioral twin of the seeded test: a real sample_once under the
+    session's armed lockdep must record no violations (the probe runs
+    outside linkhealth.state; only leaf gauge writes nest)."""
+    before = len(locks.global_dep().violations) if locks.armed() else 0
+    s = _sampler(lambda n: (0.1, 25.0))
+    reg = MetricsRegistry()
+    s.attach(reg)
+    s.sample_once()
+    if locks.armed():
+        assert len(locks.global_dep().violations) == before
+
+
+def test_contract_names_the_sampler_edges():
+    from reporter_tpu.analysis import concurrency_contract as contract
+
+    assert ("linkhealth.state",
+            "metrics.registry") in contract.LOCK_ORDER_EDGES
+    contract.validate()                 # still dated + acyclic
+
+
+def test_breaker_open_stops_spawning_probe_threads():
+    """A permanently dead link must cost bounded memory (the matcher
+    dispatch-breaker discipline): once cap probes are wedged, further
+    ticks record dead WITHOUT spawning another thread."""
+    import threading
+
+    hang = threading.Event()
+
+    def stuck(n):
+        hang.wait(10.0)
+        return 0.1, 25.0
+
+    s = _sampler(stuck, dead_timeout_s=0.02)
+    for _ in range(s._watchdog.cap):
+        assert s.sample_once().mood == "dead"
+    assert s._watchdog.tripped
+    before = threading.active_count()
+    x = s.sample_once()
+    assert x.mood == "dead" and x.source == "probe_breaker_open"
+    assert threading.active_count() == before   # no new probe thread
+    hang.set()                                  # release the wedged ones
+
+
+def test_leak_gate_covers_sampler_swap():
+    from reporter_tpu.analysis import global_state
+
+    prev = linkhealth._global
+    s0 = _sampler(lambda n: (0.1, 25.0))
+    linkhealth.configure(s0)
+    try:
+        pre = global_state.snapshot()
+        linkhealth.configure(_sampler(lambda n: (0.2, 10.0)))
+        leaked = global_state.diff(pre, global_state.snapshot())
+        assert any("linkhealth" in line for line in leaked)
+        linkhealth.configure(s0)
+        assert global_state.diff(pre, global_state.snapshot()) == []
+        # lazy first construction (None -> X) stays LEGAL
+        linkhealth.configure(None)
+        pre2 = global_state.snapshot()
+        linkhealth.sampler()
+        assert global_state.diff(pre2, global_state.snapshot()) == []
+    finally:
+        linkhealth.configure(prev)
