@@ -1,7 +1,45 @@
-"""``python -m reporter_tpu.analysis`` — run the repo lint gate from the
-command line (same rules + waiver semantics as the CI gate in
-tests/test_static_analysis.py). Exit 1 on any unwaived finding."""
+"""``python -m reporter_tpu.analysis`` — the repo's static gates, from
+the command line (same rules + waiver semantics as the CI gates in
+tests/test_static_analysis.py and tests/test_device_contract.py).
 
-from reporter_tpu.analysis.lint_rules import main
+  (no args)           AST lint + cross-file rules over reporter_tpu/ +
+                      bench.py (round 14). Exit 1 on any unwaived
+                      finding.
+  --device            device-program contract (round 16): jaxpr audit of
+                      every wire entry × kernel arm × wire layout ×
+                      {single-device, mesh} path, the pinned
+                      compile-shape manifest, and the static SMEM/HBM
+                      budgets. CPU abstract eval only — no device, no
+                      tunnel. Exit 1 on any unwaived finding or manifest
+                      drift.
+  --update-manifest   regenerate analysis/compile_manifest.py's GOLDEN
+                      block from the live constants (the fixtures/
+                      regen.py workflow — run it ONLY for intentional
+                      compile-universe changes and commit the diff).
+"""
 
-raise SystemExit(main())
+import argparse
+
+
+def _main() -> int:
+    ap = argparse.ArgumentParser(prog="python -m reporter_tpu.analysis")
+    ap.add_argument("--device", action="store_true",
+                    help="run the device-program contract gate")
+    ap.add_argument("--update-manifest", action="store_true",
+                    help="regenerate the golden compile-shape manifest")
+    args = ap.parse_args()
+    if args.update_manifest:
+        from reporter_tpu.analysis.compile_manifest import update_golden
+
+        print(f"golden manifest rewritten: {update_golden()}")
+        return 0
+    if args.device:
+        from reporter_tpu.analysis.device_contract import main as device_main
+
+        return device_main()
+    from reporter_tpu.analysis.lint_rules import main as lint_main
+
+    return lint_main()
+
+
+raise SystemExit(_main())

@@ -38,7 +38,8 @@ import re
 from typing import Any
 
 __all__ = ["compare", "summary_token", "render", "classify_direction",
-           "is_link_sensitive", "link_drifted", "main"]
+           "is_link_sensitive", "link_drifted", "schema_coverage",
+           "coverage_findings", "main"]
 
 # ---------------------------------------------------------------------------
 # direction classification (suffix rules over the LEAF key, narrow on
@@ -51,6 +52,10 @@ _HIGHER_SUFFIXES = (
     "achieved_gbps", "achieved_gflops", "point_edge_rate",
     "point_segment_rate", "req_per_sec", "device_probes_per_sec",
     "vs_baseline", "readback_mbps",
+    # r16 coverage sweep: throughput ratios, roofline efficiency, the
+    # tracing-overhead A/B's sustained rates
+    "throughput_vs_sf", "throughput_vs_unrestricted", "_peak",
+    "pps_traced", "pps_untraced",
 )
 _LOWER_SUFFIXES = (
     "_ms", "disagreement", "miss_rate", "step_miss_rate", "lag",
@@ -58,21 +63,77 @@ _LOWER_SUFFIXES = (
     "dead_letter_pending_end", "dead_lettered", "errors", "rejected",
     "dropped_rows", "recovery_seconds", "drain_seconds",
     "tracing_overhead_pct", "dispatch_timeout",
+    # r16 coverage sweep: per-dispatch/per-slice/per-batch leg costs,
+    # oracle disagreements, reach-audit misses, journal torn tails
+    "_ms_per_dispatch", "_ms_per_slice", "_s_per_batch",
+    "disagreement_k8", "disagreement_k12", "disagreement_vs_cpu_ref",
+    "decode_slowdown_vs_sf", "e2e_over_decode", "_missed",
+    "truncated_lines",
 )
+# Whole subtrees that are bookkeeping, measurement conditions, or
+# self-referential analysis — pruned before any leaf is classified (one
+# rule shared by compare() and the coverage gate, so the two can never
+# disagree about what "covered" means). Matched as exact dotted-path
+# SEGMENTS, not substrings.
+_NEUTRAL_SUBTREES = frozenset({
+    "bench_delta",        # the embedded sentinel report (self-diff is noise)
+    "link_health",        # measurement conditions — the normalizer
+    "setup_split",        # where bench wall time went (setup re-runs)
+    "legs_s_per_batch",   # per-leg attribution; the *_per_batch/_per_slice
+    #                       headline keys above carry the claims
+    "tile_stats",         # workload descriptors (edges, cells, compile)
+    "staging_plan",       # capacity-plan echo (tiles/capacity.py)
+    "occupancy",          # fleet paging bookkeeping (kpps carry the claims)
+    "per_metro_kpps",     # leaf keys are metro NAMES; the mixed aggregate
+    #                       kpps is the compared claim
+})
 # leaf keys that are workload/config/bookkeeping, never a perf claim —
-# matched exactly, skipped before the suffix rules run
+# matched exactly, skipped before the suffix rules run. THE explicit
+# neutral list: schema_coverage() checks it BOTH ways (every committed
+# numeric leaf must classify or sit here; every entry here must still
+# exist in the committed schema), so a new metric can never be silently
+# skipped and dead rows cannot accrete.
 _SKIP_KEYS = {
     "seconds", "total_seconds", "build_seconds", "wall_seconds",
-    "match_seconds", "host_seconds", "active_seconds", "batch_seconds",
-    "setup_seconds", "offered_pps", "offered_rps", "offered_probes",
-    "samples", "traces", "points", "reports", "steps", "posts", "rows",
+    "match_seconds", "host_seconds", "batch_seconds",
+    "setup_seconds", "offered_pps", "offered_rps",
+    "samples", "traces", "points", "reports", "steps", "rows",
     "clients", "rounds", "workers", "n_metros", "touches", "probes",
-    "value", "bucket", "capacity_bytes", "staged_bytes_total",
-    "hbm_tile_bytes", "wire_bytes_per_slice", "broker_probes",
+    "bucket", "capacity_bytes", "staged_bytes_total",
+    "hbm_tile_bytes", "wire_bytes_per_slice",
     "rotation_index", "latency_samples",
     # measurement CONDITIONS, not claims: the link window is the
     # normalizer, never a compared metric
-    "link_rtt_ms", "rtt_ms", "mbps", "link_mood", "probe_duty_pct",
+    "link_rtt_ms", "probe_duty_pct",
+    # lint: allow[bench-coverage] 2026-08-04 chip-flavor link-window rows: the committed capture this round is CPU-flavored (rtt/mbps are null there); these entries guard the next chip capture, where bare _ms/_mbps suffixes would otherwise misclassify them
+    "rtt_ms", "mbps",
+    # roofline / culling descriptors (the efficiency *_peak percentages
+    # and kpps rates above are the claims)
+    "block_visits_per_dispatch", "blocks_total", "mean_blocks_per_chunk",
+    "culled_fraction", "hbm_bytes_swept", "pair_flops",
+    # reach-audit population counts (+ node-coverage distribution keys;
+    # the *_miss_rate / *_missed leaves are the compared claims)
+    "pairs_considered", "steps_considered", "pairs_accepted_exact",
+    "steps_accepted_exact", "truncated_nodes", "min", "p50",
+    # scheduler / service-curve bookkeeping (shed/deferred/padding are
+    # by-design nonzero in the overload legs)
+    "padded_traces", "deferred", "shed", "device_batches",
+    "inflight_ge2_dispatches", "requests", "concurrency",
+    # fleet paging counters outside the occupancy subtree (the fidelity
+    # leg's per-metro evict→promote counts — cycle bookkeeping)
+    "demotions", "promotions",
+    # latency-attribution stage names (conditional means partitioning
+    # the request — shifts between stages are attribution, not
+    # regressions; the e2e/request _ms quantiles carry the claims)
+    "sched_queue", "device_match", "publish", "report_build",
+    "stage_sum_over_e2e_p50", "stage_sum_over_request_p50",
+    # streaming soak / worker bookkeeping
+    "consumed_probes", "produced_probes", "hist_rows_nonzero",
+    "hist_segments_flushed", "per_worker_match_seconds",
+    # workload shape echoes
+    "oracle_sample_traces", "total_traces", "trace_window", "wire_mode",
+    "edges_vs_sf", "reach_rows_growth", "exact_tie_fraction",
+    "lt_1cm_fraction", "lt_1m_fraction",
 }
 
 # every throughput/latency number measured THROUGH the remote link is
@@ -99,6 +160,17 @@ def classify_direction(key: str) -> int:
         if k.endswith(s):
             return -1
     return 0
+
+
+def _neutral_key(leaf: str) -> bool:
+    """Explicitly neutral: on the skip list, or a pure-digit key (the
+    histogram/bucket dicts key samples BY NUMBER — "128" is a bucket
+    label, not a metric name)."""
+    return leaf.lower() in _SKIP_KEYS or leaf.isdigit()
+
+
+def _neutral_subtree_segment(key: str) -> bool:
+    return re.sub(r"\[\d+\]$", "", str(key)) in _NEUTRAL_SUBTREES
 
 
 def is_link_sensitive(path: str) -> bool:
@@ -154,6 +226,8 @@ def _walk(old: Any, new: Any, path: str, rows: list,
         o = {str(k): v for k, v in old.items()}
         n = {str(k): v for k, v in new.items()}
         for k in sorted(set(o) | set(n)):
+            if _neutral_subtree_segment(k):
+                continue        # bookkeeping/conditions — never compared
             p = f"{path}.{k}" if path else k
             if k not in o:
                 counts["only_new"] += 1
@@ -175,6 +249,9 @@ def _walk(old: Any, new: Any, path: str, rows: list,
     leaf = re.sub(r"\[\d+\]$", "", leaf)
     direction = classify_direction(leaf)
     if direction == 0:
+        # by the coverage gate (schema_coverage), a direction-0 leaf in
+        # the committed schema is ALWAYS explicitly neutral — never an
+        # unclassified metric silently skipped
         return
     counts["compared"] += 1
     if old == new:
@@ -325,6 +402,134 @@ def render(delta: dict) -> str:
     _table("REGRESSIONS (link cannot excuse)", delta["regressions"])
     _table("link-attributable drift", delta["link_attributable"])
     return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# schema coverage (the r16 "no silently skipped metric" gate)
+
+def _doc_leaves(doc: dict):
+    """(leaf key, dotted path) for every numeric leaf the compare walk
+    would visit, PLUS the ones inside neutral subtrees (coverage's
+    observed set must see them so the reverse check stays honest)."""
+    def rec(x, path, in_neutral):
+        if isinstance(x, dict):
+            for k, v in x.items():
+                k = str(k)
+                rec(v, f"{path}.{k}" if path else k,
+                    in_neutral or _neutral_subtree_segment(k))
+        elif isinstance(x, list):
+            for i, v in enumerate(x):
+                rec(v, f"{path}[{i}]", in_neutral)
+        elif _numeric(x):
+            leaf = re.sub(r"\[\d+\]$", "", path.rsplit(".", 1)[-1])
+            yield_to.append((leaf, path, in_neutral))
+
+    yield_to: "list[tuple[str, str, bool]]" = []
+    rec({"headline_probes_per_sec_e2e": doc.get("value"),
+         "detail": doc.get("detail") or {}}, "", False)
+    return yield_to
+
+
+def schema_coverage(docs: "list[dict]",
+                    ) -> "tuple[list[tuple[str, str]], list[str]]":
+    """Both directions of the coverage contract over the committed bench
+    schema (the r14 env-table rule's shape):
+
+    forward — every numeric leaf outside the neutral subtrees must be
+    suffix-classifiable or explicitly neutral; returns (leaf, example
+    path) per violation. A leaf this misses is a metric bench_delta
+    would silently skip forever.
+
+    reverse — every explicit neutral entry (_SKIP_KEYS) must still name
+    a leaf observed SOMEWHERE in the committed schema; returns the dead
+    entries. (Suffix rules also serve summary-line and historical docs,
+    so only the exact-match list is held to this.)
+    """
+    unclassified: "dict[str, str]" = {}
+    observed: "set[str]" = set()
+    for doc in docs:
+        for leaf, path, in_neutral in _doc_leaves(doc):
+            observed.add(leaf.lower())
+            if in_neutral:
+                continue
+            if classify_direction(leaf) == 0 and not _neutral_key(leaf):
+                unclassified.setdefault(leaf.lower(), path)
+    dead = sorted(k for k in _SKIP_KEYS if k not in observed)
+    return sorted(unclassified.items()), dead
+
+
+def coverage_findings(root: "str | None" = None):
+    """The lint-gate face of schema_coverage: ``Finding``s over the
+    committed BENCH_DETAIL*.json captures, attributed so the r14 waiver
+    grammar applies (dead neutral entries point at their line in THIS
+    file; unclassifiable leaves point at the capture — the fix is to
+    classify or neutral-list, never to waive the capture)."""
+    import os
+
+    from reporter_tpu.analysis.lint_rules import Finding, REPO_ROOT
+
+    root = root or REPO_ROOT
+    docs: "list[tuple[str, dict]]" = []
+    out = []
+    for name in sorted(os.listdir(root)):
+        if not (name.startswith("BENCH_DETAIL") and name.endswith(".json")):
+            continue
+        if "_PARTIAL" in name:
+            # subset-run artifacts are local and gitignored (the r15
+            # no-clobber discipline) — the coverage contract is over the
+            # COMMITTED schema only, or the gate's verdict would depend
+            # on whatever bench legs ran on this machine last
+            continue
+        try:
+            with open(os.path.join(root, name)) as f:
+                docs.append((name, json.load(f)))
+        except (OSError, ValueError) as exc:
+            # a committed capture that fails to parse must be loud — a
+            # silently skipped doc is exactly how this gate would rot
+            # vacuous-green
+            out.append(Finding(
+                "bench-coverage", name, 1,
+                f"committed capture failed to load ({type(exc).__name__}:"
+                f" {exc}) — the coverage contract cannot be checked"))
+    if not docs and not out:
+        out.append(Finding(
+            "bench-coverage", "BENCH_DETAIL.json", 1,
+            "no committed BENCH_DETAIL*.json capture found — the "
+            "coverage contract has nothing to check against (the gate "
+            "must not pass vacuously)"))
+    if not docs:
+        return out
+    unclassified, dead = schema_coverage([d for _, d in docs])
+    for leaf, path in unclassified:
+        out.append(Finding(
+            "bench-coverage", docs[0][0], 1,
+            f"numeric leaf {leaf!r} ({path}) is neither "
+            "suffix-classifiable nor on the explicit neutral list — "
+            "bench_delta would silently skip it; add a direction "
+            "suffix rule or a neutral entry in analysis/bench_delta.py"))
+    src_lines = []
+    try:
+        with open(os.path.join(root, "reporter_tpu", "analysis",
+                               "bench_delta.py")) as f:
+            src_lines = f.read().splitlines()
+    except OSError:
+        pass
+
+    def _line_of(token: str) -> int:
+        pat = f'"{token}"'
+        for i, ln in enumerate(src_lines, 1):
+            if pat in ln:
+                return i
+        return 1
+
+    for key in dead:
+        out.append(Finding(
+            "bench-coverage", "reporter_tpu/analysis/bench_delta.py",
+            _line_of(key),
+            f"neutral-list entry {key!r} names no leaf in any committed "
+            "BENCH_DETAIL*.json — dead row; delete it (or waive with "
+            "the capture flavor it still serves)"))
+    return out
 
 
 def main(argv: "list[str] | None" = None) -> int:

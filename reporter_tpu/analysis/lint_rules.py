@@ -41,6 +41,21 @@ itself a finding. Rules:
                   dropped ~150 ms of jit trace into a measured wave).
   dead-import     unused imports (pyflakes-equivalent; none installed in
                   this image, so the check is implemented here).
+  dead-private    private (single-underscore) module-LEVEL functions,
+                  classes and constants referenced nowhere — liveness is
+                  word occurrence across lint targets + tests/ +
+                  examples/ + the driver hooks, outside the definition's
+                  own lines (round 16; same never-flag-a-live-symbol
+                  stance as dead-import).
+  bench-coverage  every numeric leaf in the committed BENCH_DETAIL*.json
+                  captures must be suffix-classifiable by
+                  analysis/bench_delta.py or explicitly neutral, and
+                  every neutral entry must still name a committed leaf —
+                  drift both ways, like env-table (round 16).
+
+The device-side twin of these gates — jaxpr audit, compile-shape
+manifest, static SMEM/HBM budgets — is analysis/device_contract.py
+(``python -m reporter_tpu.analysis --device``).
 """
 
 from __future__ import annotations
@@ -469,6 +484,101 @@ def _rule_dead_import(mod: _Module) -> "list[Finding]":
 
 
 # ---------------------------------------------------------------------------
+# cross-file rule: dead-private (the dead-import rule's sibling, round 16)
+
+_IDENT = re.compile(r"\b[A-Za-z_][A-Za-z0-9_]*\b")
+
+
+def _private_defs(mod: _Module):
+    """(name, first line incl. decorators, end line) for every private
+    (single-underscore, non-dunder) module-LEVEL function/class/constant
+    definition. Top-level statements only — nested and conditional
+    definitions are out of scope on purpose."""
+    body = getattr(mod.tree, "body", ())
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            name = node.name
+            lo = min([node.lineno]
+                     + [d.lineno for d in node.decorator_list])
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            lo = node.lineno
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            name = node.target.id
+            lo = node.lineno
+        else:
+            continue
+        if not name.startswith("_") or name.startswith("__"):
+            continue
+        yield name, lo, (node.end_lineno or node.lineno)
+
+
+def _token_counts(source: str) -> "dict[str, int]":
+    counts: "dict[str, int]" = {}
+    for tok in _IDENT.findall(source):
+        counts[tok] = counts.get(tok, 0) + 1
+    return counts
+
+
+def _usage_sources(root: str) -> "list[str]":
+    """Sources consulted for liveness BEYOND the lint targets: tests,
+    examples, and the driver hooks legitimately reach into private
+    names (tests import _DENSE_LAYOUT_KEYS; capacity imports _SBLK), so
+    the usage scan must see them or the rule would flag live code."""
+    out = []
+    for rel in ("tests", "examples"):
+        d = os.path.join(root, rel)
+        if not os.path.isdir(d):
+            continue
+        for dirpath, dirnames, filenames in os.walk(d):
+            dirnames[:] = [x for x in dirnames if x != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    for extra in ("__graft_entry__.py",):
+        p = os.path.join(root, extra)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def _rule_dead_private(mods: "list[_Module]",
+                       extra_sources: "list[str]") -> "list[Finding]":
+    """A private module-level function/class/constant no source anywhere
+    references is dead weight. Liveness = WORD OCCURRENCE of the name,
+    in any lint target / test / example / driver hook, outside the
+    definition's own line range — the dead-import discipline: strings,
+    comments, and docstrings count as uses, so the rule can never flag
+    a live symbol (getattr-by-string included); it only catches the
+    truly unreferenced."""
+    total = _token_counts("\n".join(m.source for m in mods))
+    for path in extra_sources:
+        try:
+            with open(path) as f:
+                extra = f.read()
+        except OSError:
+            continue
+        for tok, n in _token_counts(extra).items():
+            total[tok] = total.get(tok, 0) + n
+    out: "list[Finding]" = []
+    for mod in mods:
+        for name, lo, hi in _private_defs(mod):
+            own = "\n".join(mod.lines[lo - 1:hi])
+            own_n = _token_counts(own).get(name, 0)
+            if total.get(name, 0) <= own_n:
+                out.append(Finding(
+                    "dead-private", mod.path, lo,
+                    f"private module-level {name!r} is never referenced "
+                    "outside its own definition (lint targets + tests + "
+                    "examples scanned) — delete it, or waive with why "
+                    "it must stay"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # cross-file rule: env-table
 
 def _rule_env_table(mods: "list[_Module]",
@@ -557,6 +667,8 @@ def lint_source(source: str, path: str = "<synthetic>",
         if rules is not None and rid not in rules:
             continue
         out.extend(fn(mod))
+    if rules is None or "dead-private" in rules:
+        out.extend(_rule_dead_private([mod], []))
     out = _dedupe(out)
     _apply_waivers(mod, out)
     return out
@@ -587,14 +699,30 @@ def run_lint(root: str = REPO_ROOT,
         per_mod = _dedupe(per_mod)
         _apply_waivers(mod, per_mod)
         out.extend(per_mod)
+    by_path = {m.path: m for m in mods}
+    if rules is None or "dead-private" in rules:
+        dead = _rule_dead_private(mods, _usage_sources(root))
+        for f in dead:
+            m = by_path.get(f.path)
+            if m is not None:
+                _apply_waivers(m, [f])
+        out.extend(dead)
     if rules is None or "env-table" in rules:
         table = _rule_env_table(mods, os.path.join(root, "README.md"))
-        by_path = {m.path: m for m in mods}
         for f in table:
             m = by_path.get(f.path)
             if m is not None:
                 _apply_waivers(m, [f])
         out.extend(table)
+    if rules is None or "bench-coverage" in rules:
+        from reporter_tpu.analysis.bench_delta import coverage_findings
+
+        cov = coverage_findings(root)
+        for f in cov:
+            m = by_path.get(f.path)
+            if m is not None:
+                _apply_waivers(m, [f])
+        out.extend(cov)
     return out
 
 

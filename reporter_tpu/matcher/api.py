@@ -39,6 +39,10 @@ from reporter_tpu.utils import watchdog as watchdog_mod
 from reporter_tpu.utils.metrics import MetricsRegistry
 from reporter_tpu.utils.watchdog import AbandonedThreadWatchdog
 
+# padded point-length buckets — one compiled executable per bucket. The
+# bucket set is part of the pinned compiled-shape universe
+# (analysis/compile_manifest.py): changing it requires regenerating the
+# golden manifest (`python -m reporter_tpu.analysis --update-manifest`).
 _BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
 
 

@@ -35,6 +35,7 @@ projection (Meili keeps one candidate per edge).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 from typing import NamedTuple
@@ -650,8 +651,10 @@ def _chunk_block_ids(pts, valid, bbox, radius: float, nchunks: int):
     # whenever hits fit _NJ_CAP — the counts returned here prove it) and
     # in-kernel by the `fresh` skip
     padded = jax.lax.cummax(jnp.where(is_hit, hit_id, -1), axis=1)
+    # dtype pinned: a bool jnp.sum accumulates in the DEFAULT int width,
+    # which under x64 silently widens to i64 (device-contract x64 audit)
     return (jnp.maximum(padded, 0).astype(jnp.int32),
-            jnp.sum(hit, axis=1).astype(jnp.int32))
+            jnp.sum(hit, axis=1, dtype=jnp.int32))
 
 
 def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
@@ -685,7 +688,9 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
     # replace with the chunk's masked mean so they cull like their chunk
     chunks = pts.reshape(nchunks, _P, 2)
     vc = val.reshape(nchunks, _P, 1)
-    cnt = jnp.maximum(jnp.sum(vc, axis=1), 1)
+    # dtype pinned (see _chunk_block_ids): the default-int bool sum would
+    # also drag the mean's division up to f64 under x64
+    cnt = jnp.maximum(jnp.sum(vc, axis=1, dtype=jnp.int32), 1)
     mean = jnp.sum(jnp.where(vc, chunks, 0.0), axis=1) / cnt
     pts = jnp.where(vc, chunks, mean[:, None, :]).reshape(npad, 2)
 
@@ -755,8 +760,7 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
         to 128 columns — cap chunks per pallas_call and sequence groups
         (XLA pipelines consecutive custom calls)."""
         nj = ids_w.shape[1]
-        padded_cols = ((nj + 127) // 128) * 128
-        maxc = max(1, (512 * 1024) // (padded_cols * 4))
+        _, maxc = prefetch_group_cap(nj)
         if nchunks <= maxc:
             # tuple(): the narrow/full cond branches can take different
             # chunking paths here, and lax.cond requires identical output
@@ -812,9 +816,51 @@ def _dense_jnp(points, seg_pack, radius: float, k: int):
     return ec.reshape(npad, k)[:n], oc.reshape(npad, k)[:n], dist
 
 
+# SMEM budget of one pallas_call's scalar-prefetch id list: the whole
+# [nc, nj] i32 array is prefetched, lane-padded to 128 columns, and SMEM
+# is ~1 MB per core — the 512 KB self-cap leaves headroom for the grid
+# indices and compiler-managed scalars. ONE definition: the launcher's
+# chunk grouping below and the static device-contract audit
+# (analysis/device_contract.py) must bound the same bytes.
+SMEM_PREFETCH_BUDGET = 512 * 1024
+SMEM_LANE_PAD = 128
+
+
+def prefetch_group_cap(nj: int) -> "tuple[int, int]":
+    """(lane-padded id-list columns, max chunks per pallas_call) for an
+    id list ``nj`` wide — the shape math that keeps every grouped
+    scalar-prefetch launch inside ``SMEM_PREFETCH_BUDGET``."""
+    padded_cols = ((nj + SMEM_LANE_PAD - 1) // SMEM_LANE_PAD) * SMEM_LANE_PAD
+    return padded_cols, max(1, SMEM_PREFETCH_BUDGET // (padded_cols * 4))
+
+
+def prefetch_smem_bytes(nchunks: int, nj: int) -> int:
+    """Static SMEM footprint bound of the id list for ONE grouped launch
+    over ``nchunks`` point chunks at id-list width ``nj`` (the audit's
+    closed form; the launcher never exceeds it by construction)."""
+    padded_cols, maxc = prefetch_group_cap(nj)
+    return min(nchunks, maxc) * padded_cols * 4
+
+
+_FORCE_PALLAS_TRACE = 0
+
+
+@contextlib.contextmanager
+def pallas_trace_override():
+    """Audit hook (analysis/device_contract.py): make ``_use_pallas()``
+    answer True on a CPU host so ``jax.make_jaxpr`` traces the ACTUAL
+    kernel program — abstract eval only, nothing is lowered or run."""
+    global _FORCE_PALLAS_TRACE
+    _FORCE_PALLAS_TRACE += 1
+    try:
+        yield
+    finally:
+        _FORCE_PALLAS_TRACE -= 1
+
+
 def _use_pallas() -> bool:
-    if _INTERPRET:
-        return True
+    if _INTERPRET or _FORCE_PALLAS_TRACE:
+        return pl is not None
     return pl is not None and jax.default_backend() != "cpu"
 
 

@@ -174,7 +174,10 @@ def viterbi_decode_batched(cands: CandidateSet, points, valid_pt, tables,
         trans = jnp.where(gc <= breakage_distance, trans, BIG)
 
         via = score[:, None] + trans
-        best_prev = jnp.argmin(via, axis=0).astype(jnp.int32)   # [K, B]
+        # index dtype pinned: jnp.argmin indexes in the DEFAULT int width
+        # (i64 under x64) — lax.argmin with an explicit index_dtype is
+        # the same op with the width pinned (device-contract x64 audit)
+        best_prev = jax.lax.argmin(via, 0, jnp.int32)           # [K, B]
         best_cost = jnp.min(via, axis=0)
         connected = best_cost < BIG
 
@@ -209,9 +212,12 @@ def viterbi_decode_batched(cands: CandidateSet, points, valid_pt, tables,
         nxt_choice, nxt_started = carry                 # [B]
         score_t, bp_next, act_t, started_t = inp
         sel = k_iota[:, None] == jnp.maximum(nxt_choice, 0)[None, :]
-        prop = jnp.sum(jnp.where(sel, bp_next, 0), axis=0)
+        # dtype pinned: integer jnp.sum accumulates in the DEFAULT int
+        # width, which under x64 silently widens the scan carry to i64
+        # (the device-contract x64 audit traces exactly this)
+        prop = jnp.sum(jnp.where(sel, bp_next, 0), axis=0, dtype=jnp.int32)
         prop = jnp.where(nxt_choice >= 0, prop, -1)
-        own = jnp.argmin(score_t, axis=0).astype(jnp.int32)
+        own = jax.lax.argmin(score_t, 0, jnp.int32)   # index dtype pinned
         own = jnp.where(jnp.min(score_t, axis=0) < BIG, own, -1)
         terminal = nxt_started | (nxt_choice < 0)
         choice_t = jnp.where(terminal, own, prop)
@@ -228,7 +234,9 @@ def viterbi_decode_batched(cands: CandidateSet, points, valid_pt, tables,
     safe = jnp.maximum(choice, 0)
     matched = choice >= 0
     sel = k_iota[None, :, None] == safe[:, None, :]     # [T, K, B]
-    edge = jnp.where(matched, jnp.sum(jnp.where(sel, ce, 0), axis=1), -1)
+    edge = jnp.where(matched,
+                     jnp.sum(jnp.where(sel, ce, 0), axis=1, dtype=jnp.int32),
+                     -1)
     offset = jnp.where(matched, jnp.sum(jnp.where(sel, co, 0.0), axis=1), 0.0)
 
     # interpolated points ride the matched path (see viterbi_decode)

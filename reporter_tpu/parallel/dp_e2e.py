@@ -37,6 +37,37 @@ from reporter_tpu.parallel.compat import shard_map
 from reporter_tpu.tiles.tileset import TileSet
 
 _IMPLS = {"f32": wire_from_f32, "q16": wire_from_q16, "q8": wire_from_q8}
+_NARGS = {"f32": 2, "q16": 3, "q8": 3}
+
+
+def mesh_wire_fn(mesh: Mesh, kind: str, meta, params: MatcherParams,
+                 spec: "tuple | None", tables_pytree, has_acc: bool):
+    """``jit(shard_map(wire_from_<kind>))`` over ``mesh`` — THE product-
+    path program builder. One spelling, two callers: DpWireMatcher's
+    dispatch cache below, and the device-contract audit
+    (analysis/device_contract.py), which abstractly traces the same
+    callable so the audited mesh program can never drift from the served
+    one. ``tables_pytree`` only shapes the replicated in_specs tree —
+    ShapeDtypeStructs work as well as placed arrays."""
+    impl = _IMPLS[kind]
+    nargs = _NARGS[kind]
+    data = P(tuple(mesh.axis_names))         # rows over ALL mesh axes
+    tbl_specs = jax.tree.map(lambda _: P(), tables_pytree)
+
+    if has_acc:
+        def local(*a):
+            *ins, acc, tbl = a
+            return impl(*ins, tbl, meta, params, acc, spec)
+        in_specs = (data,) * nargs + (data, tbl_specs)
+    else:
+        def local(*a):
+            *ins, tbl = a
+            return impl(*ins, tbl, meta, params, None, spec)
+        in_specs = (data,) * nargs + (tbl_specs,)
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=in_specs, out_specs=data,
+        check_vma=False))   # same constant-carry caveat as parallel/dp
 
 
 class DpWireMatcher:
@@ -81,37 +112,19 @@ class DpWireMatcher:
             if acc is not None:
                 acc = np.concatenate(
                     [acc, np.ones((pad,) + acc.shape[1:], acc.dtype)])
-        fn = self._fn(kind, len(arrays), acc is not None)
+        fn = self._fn(kind, acc is not None)
         args = [jnp.asarray(a) for a in arrays]
         if acc is not None:
             args.append(jnp.asarray(acc))
         return fn(*args, self.tables)
 
-    def _fn(self, kind: str, nargs: int, has_acc: bool):
-        """jit(shard_map(wire_from_*)) — one cached program per (entry
-        kind, accuracy presence); shapes recompile inside the jit cache."""
+    def _fn(self, kind: str, has_acc: bool):
+        """Cached mesh_wire_fn — one program per (entry kind, accuracy
+        presence); shapes recompile inside the jit cache."""
         key = (kind, has_acc)
         cached = self._fns.get(key)
-        if cached is not None:
-            return cached
-        impl = _IMPLS[kind]
-        meta, params, spec = self.meta, self.params, self.spec
-        data = P(tuple(self.mesh.axis_names))    # rows over ALL mesh axes
-        tbl_specs = jax.tree.map(lambda _: P(), self.tables)
-
-        if has_acc:
-            def local(*a):
-                *ins, acc, tbl = a
-                return impl(*ins, tbl, meta, params, acc, spec)
-            in_specs = (data,) * nargs + (data, tbl_specs)
-        else:
-            def local(*a):
-                *ins, tbl = a
-                return impl(*ins, tbl, meta, params, None, spec)
-            in_specs = (data,) * nargs + (tbl_specs,)
-
-        fn = jax.jit(shard_map(
-            local, mesh=self.mesh, in_specs=in_specs, out_specs=data,
-            check_vma=False))   # same constant-carry caveat as parallel/dp
-        self._fns[key] = fn
-        return fn
+        if cached is None:
+            cached = self._fns[key] = mesh_wire_fn(
+                self.mesh, kind, self.meta, self.params, self.spec,
+                self.tables, has_acc)
+        return cached

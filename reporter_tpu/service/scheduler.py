@@ -66,6 +66,9 @@ class ServiceOverloaded(RuntimeError):
 # from a small fixed set. Powers of two keep the worst-case padding waste
 # below 50% and the executable population logarithmic; groups beyond the
 # last rung are already sliced to max_device_batch multiples upstream.
+# The rung set is part of the pinned compiled-shape universe
+# (analysis/compile_manifest.py): changing it requires regenerating the
+# golden manifest (`python -m reporter_tpu.analysis --update-manifest`).
 _TRACE_RUNGS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 

@@ -20,7 +20,10 @@ import jax.numpy as jnp
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _accumulate(hist, rows, bins, ok):
-    upd = jnp.where(ok, 1, 0).astype(jnp.int32)
+    # dtype pinned: where(ok, 1, 0) materializes in the DEFAULT int width
+    # (i64 under x64) before the astype — the bool cast is the same
+    # values with the width pinned (device-contract x64 audit)
+    upd = ok.astype(jnp.int32)
     return hist.at[jnp.maximum(rows, 0), jnp.maximum(bins, 0)].add(upd)
 
 

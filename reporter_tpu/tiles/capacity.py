@@ -56,6 +56,13 @@ def dense_staged_bytes(ts: TileSet) -> tuple[int, int]:
     what parallel/sharded_candidates.shard_tables splits over the mesh;
     fixed — per-edge arrays + node-keyed reach rows, replicated by design
     (every shard's Viterbi needs them).
+
+    Byte-EXACTNESS of this formula against what ``host_tables`` actually
+    builds is CI-pinned (analysis/compile_manifest.hbm_findings, the
+    round-16 device-contract gate): a formula that drifts from the
+    staged layout under-plans silently — the fleet ledger
+    (fleet/residency.py) meters real nbytes, but planning decisions
+    ride this math.
     """
     from reporter_tpu.ops.dense_candidates import (_SBLK, _SUB, SF_NCOMP,
                                                    SP_NCOMP, packed_columns)

@@ -1,0 +1,286 @@
+"""Round-16 device-program contract gates (analysis/device_contract.py +
+analysis/compile_manifest.py) — the r14 pattern, both directions:
+
+  1. the repo gate: zero unwaived findings over the FULL audit matrix
+     (3 wire entries × 3 kernel arms × 3 wire layouts × {single, mesh}),
+     the committed compile-shape manifest pinned (extend-don't-drop),
+     and the static SMEM/HBM budgets satisfied;
+  2. seeded violations: every detector must FIRE on a synthetic bad
+     input (an x64 widening, a host callback in a jitted body, a jit
+     nested in shard_map, a wrong wire dtype, a failed trace, a manifest
+     drift, an over-budget prefetch);
+  3. clean inputs must PASS the same detectors.
+
+Everything here is CPU abstract eval — no device, no tunnel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from reporter_tpu.analysis import compile_manifest, device_contract
+from reporter_tpu.analysis.device_contract import (audit_jaxpr,
+                                                   check_wire_avals)
+
+_SITE = ("tests/synthetic.py", 1)
+
+
+# ---------------------------------------------------------------------------
+# 1. the repo gate (ONE full audit shared by the gate tests — the matrix
+#    walk re-traces every wire program and costs ~12 s of tier-1 budget)
+
+
+_FINDINGS: "list | None" = None
+
+
+def _repo_findings():
+    global _FINDINGS
+    if _FINDINGS is None:
+        _FINDINGS = device_contract.run_device_contract()
+    return _FINDINGS
+
+
+def test_device_contract_zero_unwaived_findings():
+    unwaived = [f for f in _repo_findings() if not f.waived]
+    assert not unwaived, (
+        "device-contract findings (fix the dtype/callback/nesting, or "
+        "waive with `# lint: allow[rule] <dated justification>`):\n"
+        + "\n".join(str(f) for f in unwaived))
+
+
+def test_device_contract_covers_the_full_matrix():
+    cases = device_contract.audit_cases()
+    assert len(cases) == 3 * 3 * 3 * 2
+    labels = {c.label for c in cases}
+    # the acceptance matrix, spot-pinned
+    assert "f32/subcull/compact/single" in labels
+    assert "q8/mxu/packed/mesh" in labels
+    assert "q16/block/full/mesh" in labels
+
+
+def test_compile_manifest_is_pinned():
+    drift = compile_manifest.diff(compile_manifest.GOLDEN,
+                                  compile_manifest.compute_manifest())
+    assert not drift, (
+        "compile-shape universe drifted from the committed manifest — "
+        "an unexpected new compile shape is r12-style bench noise "
+        "waiting to happen; if intentional, regenerate with `python -m "
+        "reporter_tpu.analysis --update-manifest` and commit the diff:\n"
+        + "\n".join(drift))
+
+
+def test_compile_manifest_keeps_its_sections():
+    # extend-don't-drop: a regenerated manifest that loses a section is
+    # a gate regression even though GOLDEN == computed
+    for key in ("scheduler", "matcher", "wire_formats", "dense_sweep",
+                "histogram_scatter", "staged_tables", "envelope"):
+        assert key in compile_manifest.GOLDEN, key
+    assert compile_manifest.GOLDEN["scheduler"]["trace_count_rungs"]
+    assert compile_manifest.GOLDEN["matcher"]["point_buckets"]
+
+
+def test_manifest_generators_match_the_live_rung_functions():
+    from reporter_tpu.matcher.api import _bucket_len
+    from reporter_tpu.service.scheduler import _rung
+
+    rungs = compile_manifest.GOLDEN["scheduler"]["trace_count_rungs"]
+    buckets = compile_manifest.GOLDEN["matcher"]["point_buckets"]
+    for n in (1, 2, 3, 7, 100, 255, 256, 257, 4095, 4096):
+        assert _rung(n) in rungs, n
+    for n in (1, 16, 17, 1000, 1024, 5000):
+        assert _bucket_len(n) in buckets, n
+
+
+def test_static_smem_budget_holds():
+    assert compile_manifest.smem_findings() == []
+
+
+def test_static_smem_bound_every_grouped_launch():
+    # the launcher's own grouping math, at every width from one block to
+    # the envelope: the grouped launch NEVER exceeds the 1 MB bound (or
+    # its own 512 KB self-cap)
+    from reporter_tpu.ops import dense_candidates as dc
+
+    for nj in (1, 7, dc._NJ_CAP, 1184, compile_manifest._envelope_blocks()):
+        bytes_ = dc.prefetch_smem_bytes(10**6, nj)
+        assert bytes_ <= dc.SMEM_PREFETCH_BUDGET, nj
+        assert bytes_ <= compile_manifest.SMEM_BOUND_BYTES, nj
+
+
+def test_static_hbm_budget_cross_checks_capacity(tiny_tiles):
+    assert compile_manifest.hbm_findings(tiny_tiles) == []
+
+
+# ---------------------------------------------------------------------------
+# 2+3. seeded violations + clean twins
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def test_audit_catches_x64_widening():
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(lambda x: jnp.sum(x))(
+            jax.ShapeDtypeStruct((8,), jnp.bool_))
+    found = audit_jaxpr(closed, "synthetic/x64", _SITE)
+    assert "device-x64" in _rules_of(found)
+
+
+def test_audit_pinned_dtypes_pass_under_x64():
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(
+            lambda x: jnp.sum(x, dtype=jnp.int32) * jnp.float32(0.5))(
+            jax.ShapeDtypeStruct((8,), jnp.bool_))
+    assert audit_jaxpr(closed, "synthetic/x64-clean", _SITE) == []
+
+
+def test_audit_weak_python_literals_are_exempt():
+    # bare Python floats trace as weak 64-bit scalars under x64 but
+    # never promote their f32 consumers — the exact class the audit
+    # must NOT flag (the repo is full of `* 0.25`-style literals)
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(lambda x: x * 0.25 + 1.0)(
+            jax.ShapeDtypeStruct((8,), jnp.float32))
+    assert audit_jaxpr(closed, "synthetic/weak", _SITE) == []
+
+
+def test_audit_catches_host_callback():
+    def fn(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct((8,), np.float32),
+            x)
+
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8,), jnp.float32))
+    found = audit_jaxpr(closed, "synthetic/callback", _SITE)
+    assert "device-callback" in _rules_of(found)
+
+
+def test_audit_clean_body_has_no_callback_finding():
+    closed = jax.make_jaxpr(lambda x: x * 2.0)(
+        jax.ShapeDtypeStruct((8,), jnp.float32))
+    assert audit_jaxpr(closed, "synthetic/clean", _SITE) == []
+
+
+def _mesh1():
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.local_devices(backend="cpu")[:1]), ("dp",))
+
+
+def _busy(x):
+    # enough eqns to clear the library-wrapper threshold — a real nested
+    # kernel body is hundreds
+    for _ in range(device_contract._NESTED_JIT_MIN_EQNS + 4):
+        x = x * 1.25 + 0.5
+    return x
+
+
+def test_audit_catches_jit_nested_in_shard_map():
+    from jax.sharding import PartitionSpec as P
+
+    from reporter_tpu.parallel.compat import shard_map
+
+    inner = jax.jit(_busy)
+    fn = shard_map(lambda x: inner(x), mesh=_mesh1(), in_specs=(P("dp"),),
+                   out_specs=P("dp"), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    found = audit_jaxpr(closed, "synthetic/nested-jit", _SITE)
+    assert "device-nested-jit" in _rules_of(found)
+
+
+def test_audit_unnested_shard_map_passes():
+    from jax.sharding import PartitionSpec as P
+
+    from reporter_tpu.parallel.compat import shard_map
+
+    fn = jax.jit(shard_map(_busy, mesh=_mesh1(), in_specs=(P("dp"),),
+                           out_specs=P("dp"), check_vma=False))
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    found = audit_jaxpr(closed, "synthetic/jit-outside", _SITE)
+    assert "device-nested-jit" not in _rules_of(found)
+
+
+def test_wire_dtype_check_fires_and_passes():
+    bad = [jax.ShapeDtypeStruct((2, 3, 16), jnp.uint16)]   # 3 lanes
+    found = check_wire_avals(bad, "compact", "synthetic/wire", _SITE)
+    assert _rules_of(found) == {"device-wire-dtype"}
+    good = [jax.ShapeDtypeStruct((2, 2, 16), jnp.uint16)]
+    assert check_wire_avals(good, "compact", "synthetic/wire", _SITE) == []
+    packed = [jax.ShapeDtypeStruct((2, 1, 16), jnp.uint32)]
+    assert check_wire_avals(packed, "packed", "synthetic/wire", _SITE) == []
+    assert check_wire_avals(packed, "full", "synthetic/wire", _SITE)
+
+
+def test_trace_failure_becomes_a_finding(monkeypatch):
+    def boom(case, ts, tables, mesh):
+        raise TypeError("synthetic trace failure")
+
+    monkeypatch.setattr(device_contract, "_trace_case", boom)
+    monkeypatch.setattr(device_contract, "_audit_histogram", lambda: [])
+    found = device_contract.run_device_contract()
+    assert found and all(f.rule == "device-trace" for f in found)
+    assert any("synthetic trace failure" in f.message for f in found)
+    # one finding per entry def site, NOT one per matrix cell: same-site
+    # findings merge with a case count (54 cells / 3 entries)
+    assert len(found) == 3
+    assert all("more audit case" in f.message for f in found)
+
+
+def test_manifest_drift_is_loud():
+    computed = compile_manifest.compute_manifest()
+    mutated = {**computed,
+               "histogram_scatter": {"cap_rows": 8192}}
+    drift = compile_manifest.diff(computed, mutated)
+    assert any("cap_rows" in d for d in drift)
+    dropped = {k: v for k, v in computed.items() if k != "dense_sweep"}
+    drift = compile_manifest.diff(computed, dropped)
+    assert any("dropped" in d and "dense_sweep" in d for d in drift)
+    assert compile_manifest.diff(computed, computed) == []
+
+
+def test_smem_detector_fires_past_the_envelope(monkeypatch):
+    from reporter_tpu.ops import dense_candidates as dc
+
+    # an id list so wide one chunk-row alone exceeds the bound: the
+    # grouping cap cannot save it, and the detector must say so
+    huge = {**compile_manifest.ENVELOPE,
+            "line_segments": 400_000 * dc._SBLK}
+    monkeypatch.setattr(compile_manifest, "ENVELOPE", huge)
+    assert any("smem" in s for s in compile_manifest.smem_findings())
+
+
+def test_hbm_detector_fires_on_formula_drift(tiny_tiles, monkeypatch):
+    from reporter_tpu.tiles import capacity
+
+    real = capacity.dense_staged_bytes
+
+    def skewed(ts):
+        shardable, fixed = real(ts)
+        return shardable + 4096, fixed
+
+    monkeypatch.setattr(capacity, "dense_staged_bytes", skewed)
+    assert any("shape math drifted" in s
+               for s in compile_manifest.hbm_findings(tiny_tiles))
+
+
+def test_waiver_grammar_applies_to_device_findings(tmp_path, monkeypatch):
+    # a device finding attributed to a waived line is waived exactly like
+    # an AST finding (same grammar, same dated-justification requirement)
+    from reporter_tpu.analysis.lint_rules import _apply_waivers, _load
+
+    src = ("x = 1\n"
+           "# lint: allow[device-x64] 2026-08-04 synthetic reason\n"
+           "y = 2\n")
+    p = tmp_path / "reporter_tpu" / "synthetic_mod.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(src)
+    mod = _load(str(p), str(tmp_path))
+    from reporter_tpu.analysis.lint_rules import Finding
+
+    f = Finding("device-x64", mod.path, 3, "synthetic")
+    _apply_waivers(mod, [f])
+    assert f.waived and "2026-08-04" in f.justification

@@ -407,6 +407,114 @@ def test_lint_capped_pow2_shape_passes():
     assert "jit-shape-len" not in _rules_of(lint_source(good))
 
 
+def test_lint_catches_dead_private():
+    bad = ("_DEAD_CONST = 7\n"
+           "\n"
+           "def _dead_fn(x):\n"
+           "    return x\n"
+           "\n"
+           "_LIVE = 1\n"
+           "print(_LIVE)\n")
+    found = lint_source(bad)
+    dead = {f.message.split("'")[1] for f in found
+            if f.rule == "dead-private"}
+    assert dead == {"_DEAD_CONST", "_dead_fn"}
+
+
+def test_lint_dead_private_live_and_public_pass():
+    good = ("_K = 3\n"
+            "PUBLIC_NEVER_FLAGGED = 9\n"
+            "__dunder_exempt__ = 1\n"
+            "\n"
+            "def use():\n"
+            "    return _K\n")
+    assert "dead-private" not in _rules_of(lint_source(good))
+
+
+def test_lint_dead_private_string_mention_counts_as_use():
+    # the dead-import stance: never flag a live symbol — string/getattr
+    # access keeps a private alive
+    good = ("_HOOK = 1\n"
+            "x = globals()[\"_HOOK\"]\n")
+    assert "dead-private" not in _rules_of(lint_source(good))
+
+
+def test_lint_dead_private_is_waivable():
+    bad = ("# lint: allow[dead-private] 2026-08-04 synthetic keep\n"
+           "_KEPT = 1\n")
+    assert "dead-private" not in _rules_of(lint_source(bad))
+
+
+def test_bench_coverage_catches_unclassifiable_leaf():
+    from reporter_tpu.analysis.bench_delta import schema_coverage
+
+    doc = {"value": 1.0,
+           "detail": {"mystery_metric_xyz": 3.5, "clients": 4}}
+    unclassified, _ = schema_coverage([doc])
+    assert [k for k, _ in unclassified] == ["mystery_metric_xyz"]
+
+
+def test_bench_coverage_classified_and_neutral_leaves_pass():
+    from reporter_tpu.analysis.bench_delta import schema_coverage
+
+    doc = {"value": 1.0,
+           "detail": {"probes_per_sec_e2e": 10.0,   # suffix-classified
+                      "clients": 4,                 # explicit neutral
+                      "inflight_hist": {"2": 5},    # digit bucket key
+                      "setup_split": {"anything_s": 1.0},  # neutral subtree
+                      "flag": True}}                # bools never compared
+    unclassified, dead = schema_coverage([doc])
+    assert unclassified == []
+    assert "clients" not in dead
+
+
+def test_bench_coverage_reverse_detects_dead_neutral_rows():
+    from reporter_tpu.analysis.bench_delta import schema_coverage
+
+    doc = {"value": 1.0, "detail": {"clients": 4}}
+    _, dead = schema_coverage([doc])
+    assert "touches" in dead          # neutral entry absent from the doc
+    assert "clients" not in dead
+
+
+def test_bench_coverage_missing_captures_are_loud(tmp_path):
+    # no committed capture ⇒ a finding, never a vacuous pass
+    from reporter_tpu.analysis.bench_delta import coverage_findings
+
+    found = coverage_findings(root=str(tmp_path))
+    assert any("no committed BENCH_DETAIL" in f.message for f in found)
+
+
+def test_bench_coverage_corrupt_capture_is_loud(tmp_path):
+    from reporter_tpu.analysis.bench_delta import coverage_findings
+
+    (tmp_path / "BENCH_DETAIL.json").write_text("{torn")
+    found = coverage_findings(root=str(tmp_path))
+    assert any("failed to load" in f.message for f in found)
+
+
+def test_bench_coverage_ignores_local_partial_captures(tmp_path):
+    # subset-run *_PARTIAL.json artifacts are gitignored — a local bench
+    # run must not change the gate's verdict (either direction)
+    import json
+
+    from reporter_tpu.analysis.bench_delta import coverage_findings
+
+    clean = {"value": 1.0, "detail": {"clients": 1}}
+    (tmp_path / "BENCH_DETAIL.json").write_text(json.dumps(clean))
+    rogue = {"value": 1.0, "detail": {"mystery_metric_xyz": 2.0}}
+    (tmp_path / "BENCH_DETAIL_CPU_PARTIAL.json").write_text(
+        json.dumps(rogue))
+    found = coverage_findings(root=str(tmp_path))
+    assert not any("mystery_metric_xyz" in f.message for f in found)
+
+
+def test_bench_coverage_repo_gate_is_clean():
+    findings = [f for f in _repo_findings() if f.rule == "bench-coverage"]
+    assert not [f for f in findings if not f.waived], \
+        "\n".join(str(f) for f in findings if not f.waived)
+
+
 def test_lint_catches_dead_import():
     bad = "import os\nimport sys\n\nprint(os.getpid())\n"
     found = lint_source(bad)
