@@ -1854,6 +1854,166 @@ def _sweep_ab_cpu_validate() -> dict:
     return out
 
 
+def _autotune_probe(m, traces, link_rtt: float, K: int = 8,
+                    windows: int = 2) -> dict:
+    """Chip leg (round 17): the dispatch plan the matcher resolved at
+    construction (measured on this metro's staged tables, or served from
+    the plan cache), its per-candidate calibration timings, and a
+    same-mood tuned-vs-default interleaved A/B on ONE staged slice (the
+    sweep_ab window discipline) — the measured value of self-tuning, in
+    every chip capture. Untuned matchers (explicit knobs / timeout
+    degradation) record why instead of a vacuous 1.0x."""
+    import numpy as np
+
+    from reporter_tpu.matcher import autotune
+    from reporter_tpu.ops.match import match_batch_wire_q
+
+    plan = getattr(m, "tuned_plan", None)
+    report = dict(getattr(m, "tuned_report", None) or {})
+    out: dict = {
+        "plan": autotune.plan_json(plan),
+        "source": report.get("source"),
+        "candidates": report.get("candidates"),
+        "calibration_seconds": report.get("calibration_seconds"),
+        "calibration_dispatches": report.get("calibration_dispatches"),
+        "cache_hit": report.get("source") == "cache",
+    }
+    if report.get("errors"):
+        out["arm_errors"] = report["errors"]
+    if plan is None:
+        out["note"] = (f"matcher untuned (source="
+                       f"{report.get('source')!r}) — no A/B to run")
+        return out
+    args, _, sub, T = _stage_uniform_slice(m, traces)
+    spec = getattr(m, "_wire_spec", None)
+    arms = {
+        "tuned": m.params.replace(**plan.params_overrides()),
+        "default": m.params.replace(
+            **autotune.default_plan().params_overrides()),
+    }
+    for p in arms.values():         # compile + one readback, untimed
+        np.asarray(match_batch_wire_q(*args, m._tables, m.ts.meta, p,
+                                      None, spec=spec))
+    best: dict = dict.fromkeys(arms)
+    for _ in range(windows):
+        for a, p in arms.items():
+            t0 = time.perf_counter()
+            for _ in range(K):
+                wire = match_batch_wire_q(*args, m._tables, m.ts.meta,
+                                          p, None, spec=spec)
+            np.asarray(wire)
+            dt = max((time.perf_counter() - t0 - link_rtt) / K, 1e-6)
+            if best[a] is None or dt < best[a]:
+                best[a] = dt
+    probes = len(sub) * T
+    for a in arms:
+        out[a] = {"device_ms_per_dispatch": round(best[a] * 1e3, 2),
+                  "device_probes_per_sec": round(probes / best[a], 1)}
+    out["dispatch_shape"] = f"{len(sub)}x{T}pts"
+    out["tuned_vs_default_speedup"] = round(
+        best["default"] / best["tuned"], 3)
+    return out
+
+
+def _autotune_cpu_validate() -> dict:
+    """No-chip stand-in for _autotune_probe (every CPU-forced / outage
+    composite): the tuner MECHANISM at tiny scale with an injected
+    deterministic timer — zero device access, self-contained (builds its
+    own tiny tile), so ``--legs autotune`` fits a short tunnel window.
+    Validates: the CPU short-circuit on a real SegmentMatcher, arm/rung
+    selection + two-run determinism under synthetic timings, a
+    plan-cache round trip whose hit skips re-measurement, and the
+    staged-layout v3 guard at both injection seams (the r13 stale-dict
+    discipline extended over tuned plans)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from reporter_tpu.config import CompilerParams, Config, MatcherParams
+    from reporter_tpu.matcher import autotune
+    from reporter_tpu.matcher.api import SegmentMatcher
+    from reporter_tpu.netgen.synthetic import generate_city
+    from reporter_tpu.tiles.compiler import compile_network
+
+    ts = compile_network(generate_city("tiny", seed=29), CompilerParams())
+    cfg = Config(matcher_backend="jax")
+    m = SegmentMatcher(ts, cfg)
+    cpu_short_circuit = (m.tuned_plan is None
+                         and m.tuned_report.get("source") == "cpu")
+
+    # synthetic per-candidate cost model (mxu+bf16 fastest, 256 rung
+    # best): selection + determinism under a fully injected timer
+    def timer(plan):
+        base = {"block": 3.0, "subcull": 2.0, "mxu": 1.4}[plan.arm]
+        if plan.lowp == "bf16":
+            base *= 0.9
+        base *= {64: 1.1, 128: 1.0, 256: 0.95}[plan.nj_cap]
+        return base / 1e3
+
+    p1, rep1 = autotune.calibrate(timer)
+    p2, _ = autotune.calibrate(timer)
+
+    dense = MatcherParams(candidate_backend="dense")
+    cache_workdir = tempfile.mkdtemp(prefix="rtpu_autotune_bench_")
+    calls = {"n": 0}
+
+    def counting(plan):
+        calls["n"] += 1
+        return timer(plan)
+
+    try:
+        plan_a, info_a = autotune.resolve_plan(
+            dense, ts, ts.host_tables("dense"), counting,
+            directory=cache_workdir, backend="tpu", devkey="validate")
+        measured_calls = calls["n"]
+        plan_b, info_b = autotune.resolve_plan(
+            dense, ts, ts.host_tables("dense"), counting,
+            directory=cache_workdir, backend="tpu", devkey="validate")
+        cache_hit = (info_b.get("source") == "cache"
+                     and calls["n"] == measured_calls)
+        # label comparison: the cache round-trip changes only the
+        # source tag, the plan point itself must be identical
+        cache_identical = (plan_a is not None and plan_b is not None
+                           and plan_a.label == plan_b.label)
+    finally:
+        shutil.rmtree(cache_workdir, ignore_errors=True)
+
+    # staged-layout v3 guard at both seams: a v2 dict (no tuned_plan)
+    # must refuse loudly at construction AND at the restage/promote seam
+    stale = dict(ts.host_tables("dense"), staged_layout=np.int32(2))
+    stale.pop("tuned_plan")
+    try:
+        SegmentMatcher(ts, cfg, staged_tables=stale)
+        v2_refused_construct = False
+    except ValueError:
+        v2_refused_construct = True
+    try:
+        m.restage_tables(stale)
+        v2_refused_restage = False
+    except ValueError:
+        v2_refused_restage = True
+
+    mechanism_ok = bool(cpu_short_circuit and p1 == p2
+                        and cache_identical and cache_hit
+                        and info_a.get("source") == "measured"
+                        and v2_refused_construct and v2_refused_restage)
+    return {
+        "config": (f"injected-timer validation, tile={ts.name} "
+                   "(no chip — plan selection, cache, layout guard)"),
+        "source": "cpu-validate",
+        "plan": autotune.plan_json(p1),
+        "candidates": rep1.get("candidates"),
+        "cpu_short_circuit": cpu_short_circuit,
+        "deterministic": p1 == p2,
+        "cache_hit": cache_hit,
+        "plan_from_cache_identical": cache_identical,
+        "v2_refused_at_construction": v2_refused_construct,
+        "v2_refused_at_restage": v2_refused_restage,
+        "mechanism_ok": mechanism_ok,
+    }
+
+
 def _service_overload_boundary(curve: list, arm: str = "scheduler") -> dict:
     """First client level where the serving face shows overload — errors,
     p99 blowup, or req/s REGRESSION vs the previous level (queue growth
@@ -2469,6 +2629,11 @@ def _fleet_bench(tpu_ok: bool, n_metros: int = 8) -> dict:
             "wire_identical_after_paging": got == pre_wires[name],
             "promotions": occ_m["promotions"],
             "demotions": occ_m["demotions"],
+            # round 17: the self-tuned plan serving this metro (None on
+            # CPU composites — the short-circuit — or explicit knobs);
+            # identity above already held THROUGH the plan, extending
+            # the sweep_ab contract over tuned fleets
+            "tuned_plan": occ_m["tuned_plan"],
         }
         del dedicated
     occ = fr.occupancy()
@@ -2609,11 +2774,13 @@ _ALL_LEGS = (
     "metro", "restricted", "xl", "organic", "organic_xl", "bicycle",
     "streaming", "streaming_capacity", "streaming_soak",
     "latency_attribution", "streaming_overload", "chaos",
-    "device_compute", "sweep_ab", "window2", "prepare_bench", "fleet",
+    "device_compute", "sweep_ab", "autotune", "window2", "prepare_bench",
+    "fleet",
 )
-_SELF_CONTAINED_LEGS = {"fleet"}        # + sweep_ab when no chip is in
-#                                         play (_sweep_ab_cpu_validate
-#                                         compiles its own tiny tile)
+_SELF_CONTAINED_LEGS = {"fleet"}        # + sweep_ab / autotune when no
+#                                         chip is in play (their
+#                                         *_cpu_validate stand-ins
+#                                         compile their own tiny tiles)
 
 
 class BenchJournal:
@@ -2927,7 +3094,7 @@ def main() -> None:
     requested = set(legs_filter) if legs_filter is not None \
         else set(_ALL_LEGS)
     self_contained = set(_SELF_CONTAINED_LEGS) | (
-        set() if tpu_ok else {"sweep_ab"})
+        set() if tpu_ok else {"sweep_ab", "autotune"})
     needs_primary = bool(requested - self_contained)
 
     cur_round = _current_round()
@@ -3522,6 +3689,20 @@ def main() -> None:
         detail["sweep_ab"] = sweep
     split["sweep_ab_s"] = journal.seconds("sweep_ab")
 
+    # -- per-metro self-tuning (round 17): the resolved plan +
+    # per-candidate calibration timings + tuned-vs-default A/B on chip;
+    # injected-timer mechanism validation on every no-chip composite
+    # (self-contained there, so `--legs autotune` fits a short window) --
+    def _leg_autotune():
+        if full_run:
+            return _autotune_probe(jax_matcher, traces, link_rtt)
+        return _autotune_cpu_validate()
+
+    tune = journal.leg("autotune", _leg_autotune)
+    if tune:
+        detail["autotune"] = tune
+    split["autotune_s"] = journal.seconds("autotune")
+
     if full_run:
         # -- per-tile co-located e2e (round-8 satellite): derived from
         # the assembled detail, not journaled ---------------------------
@@ -3842,6 +4023,15 @@ def _summary_line(doc: dict) -> dict:
         # evict→promote paging re-harvest — to be True; 0 = some bit
         # False; None = nothing recorded)
         "mxu": _mxu_token(_g),
+        # round-17 self-tuning token: [chosen plan label, tuned-vs-
+        # default dispatch speedup (chip probe; None on CPU validation),
+        # plan source, mechanism bit (CPU validation; None on chip)] —
+        # full leg in detail.autotune
+        "tune": [_g("autotune", "plan", "label"),
+                 _g("autotune", "tuned_vs_default_speedup"),
+                 _g("autotune", "source"),
+                 (None if _g("autotune", "mechanism_ok") is None
+                  else int(bool(_g("autotune", "mechanism_ok"))))],
         # chaos headline (full legs in detail.recovery /
         # detail.publish_outage / detail.streaming_soak_mp): [recovery
         # seconds after a SIGKILL, duplicated reports (the at-least-once

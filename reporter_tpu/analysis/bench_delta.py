@@ -107,6 +107,11 @@ _SKIP_KEYS = {
     "link_rtt_ms", "probe_duty_pct",
     # lint: allow[bench-coverage] 2026-08-04 chip-flavor link-window rows: the committed capture this round is CPU-flavored (rtt/mbps are null there); these entries guard the next chip capture, where bare _ms/_mbps suffixes would otherwise misclassify them
     "rtt_ms", "mbps",
+    # autotune leg (round 17): chosen-plan/bookkeeping fields — the
+    # candidate timings (device_ms_per_dispatch) and the
+    # tuned_vs_default_speedup carry the compared claims
+    # lint: allow[bench-coverage] 2026-08-04 r17 calibration_* rows are chip-probe fields (the committed capture this round is the CPU-validation flavor, whose mechanism leg has no real calibration cost to record); they guard the next chip capture. nj_cap is live in the r17 capture's plan block
+    "nj_cap", "calibration_seconds", "calibration_dispatches",
     # roofline / culling descriptors (the efficiency *_peak percentages
     # and kpps rates above are the claims)
     "block_visits_per_dispatch", "blocks_total", "mean_blocks_per_chunk",

@@ -56,8 +56,9 @@ SMEM_BOUND_BYTES = 1024 * 1024
 
 def compute_manifest() -> "dict[str, Any]":
     """The compiled-shape universe, derived from the live constants."""
-    from reporter_tpu.config import MatcherParams, ServiceConfig
-    from reporter_tpu.matcher import api
+    from reporter_tpu.config import (SWEEP_NJ_CAP_RUNGS, MatcherParams,
+                                     ServiceConfig)
+    from reporter_tpu.matcher import api, autotune
     from reporter_tpu.ops import dense_candidates as dc
     from reporter_tpu.ops import match
     from reporter_tpu.service import scheduler
@@ -67,6 +68,9 @@ def compute_manifest() -> "dict[str, Any]":
     rungs = list(scheduler._TRACE_RUNGS)
     buckets = list(api._BUCKETS)
     nsub = dc._SBLK // dc._SUB if dc._SUB and dc._SBLK % dc._SUB == 0 else 1
+    cap_rungs = list(SWEEP_NJ_CAP_RUNGS)
+    arms = [autotune.TunedPlan(arm=a, lowp=l).label.split("@")[0]
+            for a, l in autotune.CANDIDATE_ARMS]
     return {
         "manifest_version": 1,
         "scheduler": {
@@ -101,6 +105,10 @@ def compute_manifest() -> "dict[str, Any]":
             "nsub_per_block": nsub,
             "chunk_sub_bboxes": dc._NSUB,
             "narrow_grid_cap": dc._NJ_CAP,
+            # round 17: the cap is plan-selectable from this fixed
+            # ladder only (config.SWEEP_NJ_CAP_RUNGS) — the compiled-
+            # shape universe stays finite; exact at any rung
+            "nj_cap_rungs": cap_rungs,
             "split_len_m": dc.SPLIT_LEN,
             "pack_rows": dc.SP_NCOMP,
             "feat_rows": dc.SF_NCOMP,
@@ -110,6 +118,25 @@ def compute_manifest() -> "dict[str, Any]":
         },
         "histogram_scatter": {
             "cap_rows": SpeedHistogram._CAP,
+        },
+        # round 17: the per-metro self-tuning plan space — the cap-rung
+        # × kernel-arm matrix the tuner may pick from, fully enumerated
+        # so per-metro tuning can never grow the executable population
+        # past this block (matcher/autotune.py)
+        "autotune": {
+            "plan_version": autotune.PLAN_VERSION,
+            "arms": arms,
+            "nj_cap_rungs": cap_rungs,
+            "plans_bound": len(arms) * len(cap_rungs),
+            "cal_dispatches": autotune.CAL_DISPATCHES,
+            "cal_batch_shape": list(autotune.CAL_BATCH_SHAPE),
+            # two-phase calibration: every arm at the default rung +
+            # the winner across the remaining rungs — the per-tile
+            # compile cost of measuring, bounded
+            "calibration_executables_per_tile_bound":
+                len(arms) + len(cap_rungs) - 1,
+            "staged_member": "tuned_plan",
+            "nj_cap_default": MatcherParams().sweep_nj_cap,
         },
         "staged_tables": {
             "layout_version": tileset.STAGED_LAYOUT_VERSION,
@@ -123,9 +150,23 @@ def compute_manifest() -> "dict[str, Any]":
 # --- BEGIN GOLDEN MANIFEST (generated; do not hand-edit — run
 #     `python -m reporter_tpu.analysis --update-manifest`) ---
 GOLDEN: "dict[str, Any]" = \
-{'dense_sweep': {'chunk_sub_bboxes': 8,
+{'autotune': {'arms': ['subcull',
+                       'subcull+bf16',
+                       'block',
+                       'mxu',
+                       'mxu+bf16'],
+              'cal_batch_shape': [128, 64],
+              'cal_dispatches': 4,
+              'calibration_executables_per_tile_bound': 7,
+              'nj_cap_default': 128,
+              'nj_cap_rungs': [64, 128, 256],
+              'plan_version': 1,
+              'plans_bound': 15,
+              'staged_member': 'tuned_plan'},
+ 'dense_sweep': {'chunk_sub_bboxes': 8,
                  'feat_rows': 8,
                  'narrow_grid_cap': 128,
+                 'nj_cap_rungs': [64, 128, 256],
                  'nsub_per_block': 4,
                  'pack_rows': 8,
                  'point_chunk': 256,
@@ -165,7 +206,7 @@ GOLDEN: "dict[str, Any]" = \
                                          'seg_sub',
                                          'seg_feat'],
                    'hbm_budget_bytes': 12884901888,
-                   'layout_version': 2},
+                   'layout_version': 3},
  'wire_formats': {'compact_max_edges': 16384,
                   'infeed_dtypes': {'f32': 'float32',
                                     'q16': 'int16',
@@ -227,16 +268,20 @@ def _envelope_blocks() -> int:
 def smem_findings() -> "list[str]":
     """Assert every grouped scalar-prefetch launch's id list fits the
     SMEM budget at every id-list width reachable inside the envelope:
-    the narrow-grid cap, the envelope metro's full block count, and the
+    EVERY narrow-grid ladder rung (round 17 — the tuner may select any
+    of them per metro), the envelope metro's full block count, and the
     degenerate single-block tile."""
+    from reporter_tpu.config import SWEEP_NJ_CAP_RUNGS
     from reporter_tpu.ops import dense_candidates as dc
 
     out: "list[str]" = []
     nblocks = _envelope_blocks()
     huge_chunks = -(-ENVELOPE["directed_edges"] // dc._P) * 4  # any cap
-    for label, nj in (("narrow", min(nblocks, dc._NJ_CAP)),
-                      ("full-envelope", nblocks),
-                      ("single-block", 1)):
+    cases = [(f"rung-{r}", min(nblocks, r)) for r in SWEEP_NJ_CAP_RUNGS]
+    cases += [("default-cap", min(nblocks, dc._NJ_CAP)),
+              ("full-envelope", nblocks),
+              ("single-block", 1)]
+    for label, nj in cases:
         bytes_ = dc.prefetch_smem_bytes(huge_chunks, nj)
         if bytes_ > SMEM_BOUND_BYTES:
             out.append(

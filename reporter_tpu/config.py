@@ -15,6 +15,18 @@ from dataclasses import dataclass, field
 from typing import Any
 
 
+# The narrow-grid launch width LADDER (round 17): ``sweep_nj_cap`` may
+# only take these rungs, so the dense sweep's compiled-shape universe
+# stays finite and manifest-pinned (analysis/compile_manifest.py
+# enumerates ladder × kernel arm). Results are EXACT at any rung — hits
+# sort first in the culled id list and the kernel falls back to the
+# full-width launch whenever a chunk's hits exceed the cap (the round-5
+# lax.cond) — so rung choice is a pure perf decision the per-metro
+# autotuner (matcher/autotune.py) measures. Lives HERE (not in
+# ops/dense_candidates) so config stays jax-import-free.
+SWEEP_NJ_CAP_RUNGS = (64, 128, 256)
+
+
 @dataclass(frozen=True)
 class MatcherParams:
     """HMM map-matching parameters (the meili section of valhalla.json).
@@ -62,6 +74,28 @@ class MatcherParams:
                                    # sweep_subcull=True. Default off
                                    # pending chip numbers (bench sweep_ab
                                    # measures it every run).
+    sweep_nj_cap: int = 128        # dense sweep: narrow-grid launch width
+                                   # (max culled blocks per chunk before
+                                   # the lax.cond falls back to the full-
+                                   # width grid — ops/dense_candidates).
+                                   # Must be a SWEEP_NJ_CAP_RUNGS rung
+                                   # (finite compiled-shape universe);
+                                   # exact at any rung, so the autotuner
+                                   # may retune it per metro.
+    sweep_autotune: bool = True    # per-metro self-tuning (round 17,
+                                   # matcher/autotune.py): at staging
+                                   # time measure real dispatches per
+                                   # (kernel arm, lowp dtype, nj-cap
+                                   # rung) on the metro's own tables and
+                                   # serve the fastest plan — legal
+                                   # because every arm is wire-byte-
+                                   # identical (detail.sweep_ab). Only
+                                   # acts on accelerator backends with
+                                   # the dense sweep resolved and every
+                                   # sweep lever still at its default
+                                   # (explicit knobs ALWAYS win); CPU
+                                   # short-circuits to the grid/auto
+                                   # choice. False = static defaults.
     breakage_distance: float = 2000.0  # consecutive points farther apart break the HMM chain
     max_route_distance_factor: float = 5.0  # route dist > factor*gc ⇒ transition disallowed
     interpolation_distance: float = 10.0    # points closer than this are interpolated, not matched
@@ -141,6 +175,28 @@ class MatcherParams:
             except ValueError:
                 raise ValueError(
                     f"RTPU_SWEEP_MXU={e['RTPU_SWEEP_MXU']!r}: "
+                    "use 0/1") from None
+        if "RTPU_NJ_CAP" in e:
+            try:
+                cap = int(e["RTPU_NJ_CAP"])
+            except ValueError:
+                raise ValueError(
+                    f"RTPU_NJ_CAP={e['RTPU_NJ_CAP']!r}: use one of "
+                    f"{SWEEP_NJ_CAP_RUNGS}") from None
+            if cap not in SWEEP_NJ_CAP_RUNGS:
+                # off-ladder caps would grow the compiled-shape universe
+                # past the committed manifest — reject, don't round
+                raise ValueError(
+                    f"RTPU_NJ_CAP={cap}: not a ladder rung "
+                    f"{SWEEP_NJ_CAP_RUNGS}")
+            kw["sweep_nj_cap"] = cap
+        if "RTPU_SWEEP_AUTOTUNE" in e:
+            try:
+                kw["sweep_autotune"] = env_flag(e["RTPU_SWEEP_AUTOTUNE"],
+                                                strict=True)
+            except ValueError:
+                raise ValueError(
+                    f"RTPU_SWEEP_AUTOTUNE={e['RTPU_SWEEP_AUTOTUNE']!r}: "
                     "use 0/1") from None
         if "RTPU_DISPATCH_TIMEOUT_S" in e:
             t = float(e["RTPU_DISPATCH_TIMEOUT_S"])
@@ -413,6 +469,12 @@ class Config:
             raise ValueError(
                 "matcher.sweep_mxu=True requires sweep_subcull=True — "
                 "the whole-block kernel has no matmul coarse pass")
+        if self.matcher.sweep_nj_cap not in SWEEP_NJ_CAP_RUNGS:
+            raise ValueError(
+                f"matcher.sweep_nj_cap ({self.matcher.sweep_nj_cap}) is "
+                f"not a ladder rung {SWEEP_NJ_CAP_RUNGS} — off-ladder "
+                "caps grow the compiled-shape universe past the "
+                "committed manifest")
         if (self.matcher.candidate_backend == "grid"
                 and self.compiler.index_radius < self.matcher.search_radius):
             raise ValueError(

@@ -273,7 +273,13 @@ class FleetResidency:
                 n: {"resident": m.resident, "pinned": m.pinned,
                     "staged_bytes": m.staged_bytes,
                     "promotions": m.promotions, "demotions": m.demotions,
-                    "leases": m.leases, "last_used_seq": m.last_used}
+                    "leases": m.leases, "last_used_seq": m.last_used,
+                    # round 17: the self-tuned dispatch plan serving this
+                    # metro (None = untuned: cold, CPU, or explicit knobs)
+                    "tuned_plan": (m.matcher.tuned_plan.label
+                                   if m.matcher is not None
+                                   and m.matcher.tuned_plan is not None
+                                   else None)}
                 for n, m in sorted(self._metros.items())}
             occ = self._resident_bytes
         cap = self.fleet.max_resident_bytes
@@ -405,6 +411,13 @@ class FleetResidency:
                     with self.metrics.stage("fleet_stage"):
                         host = m.tileset.host_tables(
                             cfg_m.matcher.candidate_backend)
+                        # (round 17: no plan-cache lookup HERE — the
+                        # matcher built after the guarded device_put
+                        # does it. device_key()'s jax.devices() may be
+                        # the process's FIRST backend init, which on a
+                        # dead axon tunnel hangs forever outside any
+                        # watchdog; after _device_put_guarded the
+                        # backend exists and the link just worked.)
                 finally:
                     self._lock.acquire()
                 m.host = host
@@ -483,6 +496,16 @@ class FleetResidency:
                 with tracing.span("fleet_promote", metro=m.name,
                                   bytes=m.staged_bytes):
                     tables = self._device_put_guarded(m, fleet)
+                    # round 17: keep the tuned_plan leaf HOST-readable
+                    # through the device dict — the plan seam reads it
+                    # without a device readback (the staged_layout
+                    # value-check discipline), so a pre-tuned host dict
+                    # promotes without re-measuring even with no disk
+                    # cache. The leaf is an unused 20 B wire argument;
+                    # a host-backed copy costs nothing per dispatch.
+                    if m.host is not None and "tuned_plan" in m.host:
+                        tables = dict(tables)
+                        tables["tuned_plan"] = m.host["tuned_plan"]
                     # paging cost = the transfer (+ pointer restage);
                     # first-touch matcher CONSTRUCTION is metered apart
                     # (fleet_matcher_build) so the promote histogram
@@ -494,6 +517,17 @@ class FleetResidency:
                                 m.tileset,
                                 self._configs.get(m.name, self.config),
                                 staged_tables=tables)
+                        # write the freshly resolved plan back into the
+                        # host-pinned dict: every LATER promotion pages
+                        # already-tuned tables (one device_put, never a
+                        # re-measure). Values only — the plan leaf is an
+                        # unused wire argument, so fleet wire bytes stay
+                        # bit-identical through evict→promote regardless
+                        # of plan (the r11 contract, unchanged).
+                        arr = m.matcher.tuned_plan_array()
+                        if arr is not None and m.host is not None \
+                                and "tuned_plan" in m.host:
+                            m.host["tuned_plan"] = arr
                     else:
                         m.matcher.restage_tables(tables)
                         dt = time.perf_counter() - t0

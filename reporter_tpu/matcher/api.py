@@ -238,6 +238,11 @@ class SegmentMatcher:
         self.metrics = metrics or MetricsRegistry()
         backend = self.config.matcher_backend
         self._native_walker = None
+        # per-metro self-tuned dispatch plan (round 17): resolved below
+        # for the single-device jax path; None everywhere else (mesh /
+        # reference_cpu / CPU short-circuit / explicit knobs)
+        self.tuned_plan = None
+        self.tuned_report: dict = {}
         # dispatch-watchdog degradation state (jax backend): the fallback
         # oracle matcher is built lazily on the FIRST timeout — a healthy
         # deployment never pays for it
@@ -311,6 +316,17 @@ class SegmentMatcher:
             # None ⇒ per-trace Python fallback.
             from reporter_tpu.matcher.native_walk import make_native_walker
             self._native_walker = make_native_walker(tileset)
+            if mesh is None:
+                # per-metro self-tuning (round 17, matcher/autotune.py):
+                # staged-dict plan → on-disk plan cache → a short
+                # bounded calibration of real dispatches on THIS metro's
+                # staged tables — every arm is wire-byte-identical
+                # (detail.sweep_ab), so the pick is pure perf. Runs at
+                # construction so the first served batch already rides
+                # the tuned executables; the fleet's first promotion
+                # lands here too (fleet/residency.py copies the plan
+                # back into the host-pinned dict).
+                self._autotune_resolve(wire_params)
         elif backend == "reference_cpu":
             if staged_tables is not None:
                 raise ValueError(
@@ -371,6 +387,80 @@ class SegmentMatcher:
         check_staged_layout(tables)
         self._tables = tables
         self._wire.tables = tables
+
+    # ---- per-metro self-tuning (round 17) --------------------------------
+
+    def _autotune_resolve(self, wire_params: MatcherParams) -> None:
+        """Resolve and APPLY this metro's dispatch plan (see
+        matcher/autotune.py for the resolution order). The calibration
+        measure times ``CAL_DISPATCHES`` chained ``match_batch_wire_q``
+        dispatches per candidate with ONE host sync (the CLAUDE.md link
+        discipline) on a deterministic synthetic batch over the metro's
+        own geometry; each candidate runs under the shared dispatch
+        watchdog so a dead tunnel degrades to the static default plan
+        instead of hanging construction/promotion."""
+        from reporter_tpu.matcher import autotune
+
+        state: dict = {}
+
+        def measure(plan: "autotune.TunedPlan") -> float:
+            import time as _time
+
+            import jax
+
+            from reporter_tpu.ops.match import match_batch_wire_q
+
+            if not state:
+                pts_q, origins, lens = autotune.calibration_batch(self.ts)
+                state["args"] = (jax.device_put(pts_q),
+                                 jax.device_put(origins),
+                                 jax.device_put(lens))
+                np.asarray(state["args"][0][0, 0])      # sync the uploads
+            args = state["args"]
+            p = wire_params.replace(**plan.params_overrides())
+            wire = match_batch_wire_q(*args, self._tables, self.ts.meta,
+                                      p, None, spec=self._wire_spec)
+            np.asarray(wire)        # compile + first readback, untimed
+            t0 = _time.perf_counter()
+            for _ in range(autotune.CAL_DISPATCHES):
+                wire = match_batch_wire_q(*args, self._tables,
+                                          self.ts.meta, p, None,
+                                          spec=self._wire_spec)
+            np.asarray(wire)        # ONE sync for the whole chain
+            return (_time.perf_counter() - t0) / autotune.CAL_DISPATCHES
+
+        plan, info = autotune.resolve_plan(self.params, self.ts,
+                                           self._tables, measure,
+                                           watchdog=self._watchdog)
+        self.tuned_report = info
+        if plan is None or plan.source in ("default", "timeout"):
+            # nothing to apply: the params already ARE the static
+            # default (the degradation target); the report says why
+            return
+        import dataclasses as _dc
+
+        tuned = self.params.replace(**plan.params_overrides())
+        self.params = tuned
+        # mirror into self.config (the env-override discipline: anything
+        # introspecting the matcher's config must see the levers that
+        # actually serve)
+        self.config = _dc.replace(self.config, matcher=tuned)
+        # wire statics follow; watchdog knobs stay stripped (r9)
+        self._wire.params = tuned.replace(dispatch_timeout_s=0.0,
+                                          dispatch_fallback="retry")
+        self.tuned_plan = plan
+        self.metrics.count(f"autotune_{plan.source}_total")
+
+    def tuned_plan_array(self) -> "np.ndarray | None":
+        """The resolved plan as the staged-layout ``tuned_plan`` i32
+        member, or None when untuned — the fleet promotion path copies
+        it back into the host-pinned dict so every later promotion pages
+        already-tuned tables (fleet/residency.py)."""
+        if self.tuned_plan is None:
+            return None
+        from reporter_tpu.matcher import autotune
+
+        return autotune.plan_array(self.tuned_plan)
 
     def _require_staged(self) -> None:
         """A paged-out matcher must fail loudly, not with a shape error

@@ -111,11 +111,18 @@ _SUB = int(os.environ.get("RTPU_SUB", "128"))
 #                   that can hold an in-radius pair. Must divide _SBLK.
 _NSUB = 8         # chunk sub-bboxes — 32 points per sub-bbox, the same
 #                   culling tightness as the old 128/4 (results identical)
-_NJ_CAP = 128     # narrow-grid width: max culled blocks per chunk before
-#                   the sweep falls back to the full-width launch grid
-#                   (Morton-sorted fleet chunks typically hit ~6-11 blocks;
-#                   the cap kills the per-slot launch overhead that cost
-#                   bayarea-xl ~45% of its dispatch at 1184 blocks)
+_NJ_CAP = 128     # narrow-grid width DEFAULT rung: max culled blocks per
+#                   chunk before the sweep falls back to the full-width
+#                   launch grid (Morton-sorted fleet chunks typically hit
+#                   ~6-11 blocks; the cap kills the per-slot launch
+#                   overhead that cost bayarea-xl ~45% of its dispatch at
+#                   1184 blocks). Round 17: callers may override per
+#                   dispatch via find_candidates_dense(nj_cap=...) —
+#                   MatcherParams.sweep_nj_cap, restricted to the
+#                   config.SWEEP_NJ_CAP_RUNGS ladder so the compiled-
+#                   shape universe stays manifest-pinned; this module
+#                   constant is the rung served when no param rides in
+#                   (and the compile-manifest's committed default).
 SPLIT_LEN = 256.0  # long-segment pre-split span (shared with tiles/capacity)
 
 
@@ -659,7 +666,10 @@ def _chunk_block_ids(pts, valid, bbox, radius: float, nchunks: int):
 
 def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
                   k: int, subcull: bool = True, lowp: str = "off",
-                  mxu: bool = False):
+                  mxu: bool = False, nj_cap: "int | None" = None):
+    # resolved at CALL time so the interpret-parity tests' module-global
+    # monkeypatch keeps working; params-driven callers pass the rung
+    nj_cap = _NJ_CAP if nj_cap is None else int(nj_cap)
     pack, bbox = seg_pack[0], seg_pack[1]
     sub = seg_pack[2] if len(seg_pack) > 2 else None
     feat = seg_pack[3] if len(seg_pack) > 3 else None
@@ -776,14 +786,14 @@ def _dense_pallas(points, valid, seg_pack: "SegPack | tuple", radius: float,
     # runs nblocks steps per chunk and big metros pay megasteps of empty
     # launches — bayarea-xl's 1184-block table spent ~45% of its dispatch
     # on culled slots (~85 ns each). Hits sort first, so truncating the
-    # id list to _NJ_CAP columns is EXACT whenever every chunk hits at
-    # most _NJ_CAP blocks (typical max is tens; the culling stats prove
+    # id list to nj_cap columns is EXACT whenever every chunk hits at
+    # most nj_cap blocks (typical max is tens; the culling stats prove
     # it per dispatch) — one traced cond falls back to the full-width
     # sweep for the rare spread-out batch.
-    if ids.shape[1] > _NJ_CAP:
+    if ids.shape[1] > nj_cap:
         edge, off, dist = jax.lax.cond(
-            jnp.max(nhits) <= _NJ_CAP,
-            lambda: sweep(ids[:, :_NJ_CAP]),
+            jnp.max(nhits) <= nj_cap,
+            lambda: sweep(ids[:, :nj_cap]),
             lambda: sweep(ids))
     else:
         edge, off, dist = sweep(ids)
@@ -868,7 +878,8 @@ def find_candidates_dense(points, seg_pack, radius: float,
                           max_candidates: int,
                           valid=None, subcull: bool = True,
                           lowp: str = "off",
-                          mxu: bool = False) -> CandidateSet:
+                          mxu: bool = False,
+                          nj_cap: "int | None" = None) -> CandidateSet:
     """points f32 [N, 2] → CandidateSet with [N, K] fields (flat batch).
 
     seg_pack: a SegPack (or (pack, bbox[, sub[, feat]]) tuple of
@@ -887,13 +898,18 @@ def find_candidates_dense(points, seg_pack, radius: float,
     to the whole-block kernel and the jnp reference by construction
     (interpret-mode test-asserted): coarse passes only ever SKIP
     provably-out-of-radius work, refinement is exact f32.
+
+    nj_cap (round 17): the narrow-grid launch width rung
+    (MatcherParams.sweep_nj_cap; None = this module's _NJ_CAP default).
+    Exact at any width — the lax.cond full-width fallback is unchanged —
+    so the per-metro autotuner may select it freely.
     """
     if valid is None:
         valid = jnp.ones(points.shape[0], bool)
     if _use_pallas():
         edge, off, dist = _dense_pallas(points, valid, seg_pack, radius,
                                         max_candidates, subcull=subcull,
-                                        lowp=lowp, mxu=mxu)
+                                        lowp=lowp, mxu=mxu, nj_cap=nj_cap)
     else:
         edge, off, dist = _dense_jnp(points, seg_pack, radius, max_candidates)
     return CandidateSet(edge=edge, offset=off, dist=dist, valid=edge >= 0)

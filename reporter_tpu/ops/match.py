@@ -68,7 +68,8 @@ def batch_candidates(points, valid_pt, tables, meta,
             valid=valid_pt.reshape(B * T),
             subcull=getattr(params, "sweep_subcull", True),
             lowp=getattr(params, "sweep_lowp", "off"),
-            mxu=getattr(params, "sweep_mxu", False))
+            mxu=getattr(params, "sweep_mxu", False),
+            nj_cap=getattr(params, "sweep_nj_cap", None))
         return CandidateSet(*(x.reshape(B, T, -1) for x in flat))
     if backend != "grid":
         raise ValueError(

@@ -64,7 +64,12 @@ PACK_NCOMP = 8
 # restage time instead of shipping an incomplete layout to the kernel.
 #   v2 (round 13): + seg_feat (MXU quadratic feature rows) next to the
 #   round-8 seg_sub quads. Pre-tag dicts (≤ r12) carry no tag at all.
-STAGED_LAYOUT_VERSION = 2
+#   v3 (round 17): + tuned_plan (matcher/autotune.py — the per-metro
+#   self-tuned dispatch plan as an i32[5] vector; host_tables stamps the
+#   static default, the tuner or the on-disk plan cache overwrites it at
+#   staging time). Rides the dense layout only — the grid backend has no
+#   kernel arms to tune.
+STAGED_LAYOUT_VERSION = 3
 
 # every SegPack member the dense layout must stage as of this version —
 # check_staged_layout cross-checks the member set, not just the tag, so
@@ -101,6 +106,12 @@ def check_staged_layout(tables) -> None:
                 "TileSet.host_tables()/device_tables()")
     if "seg_pack" in tables:
         missing = [k for k in _DENSE_LAYOUT_KEYS if k not in tables]
+        # tuned_plan (layout v3) rides the dense layout too, but stays
+        # OUT of _DENSE_LAYOUT_KEYS: it is plan metadata, not a swept
+        # table, and the staged-layout lint's "members stage together"
+        # rule must not force every sweep consumer to name it
+        if "tuned_plan" not in tables:
+            missing.append("tuned_plan")
         if missing:
             raise ValueError(
                 f"staged dense layout is missing {missing} despite a "
@@ -335,6 +346,13 @@ class TileSet:
             # per-column MXU feature rows: the matmul-form coarse pass
             # (round 13) — same [8, S_pad] footprint as seg_pack
             out["seg_feat"] = np.asarray(sp.feat)
+            # per-metro dispatch plan (round 17, layout v3): the static
+            # default here; the autotuner / on-disk plan cache overwrite
+            # this host leaf at staging time (matcher/autotune.py). An
+            # unused wire argument on device — a plan change can never
+            # change wire bytes.
+            from reporter_tpu.matcher.autotune import default_plan_array
+            out["tuned_plan"] = default_plan_array()
         return out
 
     def device_tables(self, candidate_backend: str = "both",

@@ -1,0 +1,477 @@
+"""Round-17 per-metro self-tuning (matcher/autotune.py) + the staged-
+layout v3 bump.
+
+The tuner's contract, pinned here:
+
+  - plan selection is DETERMINISTIC under an injected timer and picks
+    the measured-fastest legal (arm, lowp, nj-cap rung) candidate, tie-
+    breaking toward the static default;
+  - a watchdog timeout (the dead-tunnel shape) degrades calibration to
+    the static default plan instead of hanging;
+  - the on-disk plan cache round-trips and a hit SKIPS re-measurement
+    (zero measure calls — the fleet re-promotion requirement);
+  - a measured/cached plan already riding the staged dict resolves
+    without any measurement;
+  - explicit knobs always win, CPU short-circuits, off is off;
+  - staged-layout v3: pre-v3 dicts refuse loudly at BOTH injection
+    seams (SegmentMatcher(staged_tables=), restage_tables);
+  - the narrow-grid cap is a validated ladder rung end to end
+    (MatcherParams / RTPU_NJ_CAP / find_candidates_dense), and rung
+    choice stays exact (interpret parity, both cond branches).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import (SWEEP_NJ_CAP_RUNGS, CompilerParams, Config,
+                                 MatcherParams)
+from reporter_tpu.matcher import autotune
+from reporter_tpu.matcher.autotune import (CANDIDATE_ARMS, CalibrationAborted,
+                                           TunedPlan)
+
+
+@pytest.fixture(scope="module")
+def ts():
+    from reporter_tpu.netgen.synthetic import generate_city
+    from reporter_tpu.tiles.compiler import compile_network
+
+    return compile_network(generate_city("tiny", seed=31),
+                           CompilerParams(reach_radius=400.0))
+
+
+def _timer(costs_ms):
+    """Injected deterministic timer: label → ms (missing = 1.0)."""
+
+    def measure(plan):
+        return costs_ms.get(plan.label, 1.0) / 1e3
+
+    return measure
+
+
+# ---------------------------------------------------------------------------
+# plan encoding (the staged i32 member)
+
+
+def test_plan_array_round_trip():
+    for arm, lowp in CANDIDATE_ARMS:
+        for cap in SWEEP_NJ_CAP_RUNGS:
+            p = TunedPlan(arm=arm, lowp=lowp, nj_cap=cap,
+                          source="measured")
+            assert autotune.plan_from_array(autotune.plan_array(p)) == p
+
+
+def test_plan_from_array_rejects_foreign_leaves():
+    good = autotune.plan_array(TunedPlan(source="measured"))
+    assert autotune.plan_from_array(good) is not None
+    # device-backed / non-numpy leaves read as "not host-readable"
+    assert autotune.plan_from_array(None) is None
+    assert autotune.plan_from_array(good.tolist()) is None
+    # wrong version, malformed shape, off-ladder rung, illegal combo
+    bad_v = good.copy()
+    bad_v[0] = autotune.PLAN_VERSION + 1
+    assert autotune.plan_from_array(bad_v) is None
+    assert autotune.plan_from_array(good[:4]) is None
+    bad_cap = good.copy()
+    bad_cap[3] = 100
+    assert autotune.plan_from_array(bad_cap) is None
+    bad_combo = autotune.plan_array(TunedPlan(source="measured"))
+    bad_combo[1] = 0        # block...
+    bad_combo[2] = 1        # ...+bf16: not a legal candidate
+    assert autotune.plan_from_array(bad_combo) is None
+
+
+def test_default_plan_matches_matcher_param_defaults():
+    """TunedPlan() IS the degradation target: its overrides applied to
+    default params must be a no-op."""
+    p = MatcherParams()
+    assert p.replace(**TunedPlan().params_overrides()) == p
+
+
+# ---------------------------------------------------------------------------
+# calibration
+
+
+def test_calibrate_picks_fastest_and_is_deterministic():
+    costs = {"mxu+bf16@128": 0.4, "mxu+bf16@256": 0.3, "mxu+bf16@64": 0.5,
+             "subcull@128": 0.8, "block@128": 2.0}
+    p1, rep1 = autotune.calibrate(_timer(costs))
+    p2, _ = autotune.calibrate(_timer(costs))
+    assert p1 == p2 == TunedPlan(arm="mxu", lowp="bf16", nj_cap=256,
+                                 source="measured")
+    assert rep1["winner"] == "mxu+bf16@256"
+    # phase 1 measured every arm at the default rung, phase 2 only the
+    # winner's remaining rungs — the bounded budget
+    assert rep1["measured"] == len(CANDIDATE_ARMS) + len(
+        SWEEP_NJ_CAP_RUNGS) - 1
+    assert "device_ms_per_dispatch" in rep1["candidates"]["block@128"]
+
+
+def test_calibrate_arm_selection_follows_the_timings():
+    block_wins = {f"block@{c}": 0.1 for c in SWEEP_NJ_CAP_RUNGS}
+    p, _ = autotune.calibrate(_timer(block_wins))
+    assert (p.arm, p.lowp) == ("block", "off")
+    rung64 = dict(block_wins, **{"block@64": 0.05})
+    p, _ = autotune.calibrate(_timer(rung64))
+    assert p.nj_cap == 64
+
+
+def test_calibrate_ties_break_toward_the_default_arm():
+    p, _ = autotune.calibrate(_timer({}))      # every candidate 1.0 ms
+    assert p == TunedPlan(source="measured")   # subcull@128, the default
+
+
+def test_calibrate_skips_failing_candidates():
+    costs = {"mxu+bf16@128": 0.1, "subcull@128": 0.5}
+
+    def measure(plan):
+        if plan.arm == "mxu":
+            raise RuntimeError("mosaic lowering failed")
+        return _timer(costs)(plan)
+
+    p, rep = autotune.calibrate(measure)
+    assert p.arm == "subcull"              # best of what survived
+    assert "mxu+bf16@128" in rep["errors"]
+
+
+def test_calibrate_all_failed_degrades_to_default():
+    def measure(plan):
+        raise RuntimeError("boom")
+
+    p, rep = autotune.calibrate(measure)
+    assert p == TunedPlan()                # source "default"
+    assert "static default" in rep["note"]
+
+
+def test_watchdog_timeout_degrades_to_static_default(ts):
+    """The dead-tunnel shape: a measure that stalls past the per-
+    candidate bound aborts the WHOLE calibration to the default plan
+    (source 'timeout') — promotion degrades, never hangs."""
+    from reporter_tpu.utils.watchdog import AbandonedThreadWatchdog
+
+    wd = AbandonedThreadWatchdog(cap=4, thread_name="test-autotune-wd")
+    calls = {"n": 0}
+
+    def stalling(plan):
+        calls["n"] += 1
+        time.sleep(0.5)
+        return 0.001
+
+    plan, info = autotune.resolve_plan(
+        MatcherParams(candidate_backend="dense"), ts, {}, stalling,
+        watchdog=wd, timeout_s=0.05, backend="tpu", devkey="t")
+    assert plan is not None and plan.source == "timeout"
+    assert calls["n"] == 1                 # aborted at the first stall
+    assert "aborted" in info.get("note", "")
+
+
+def test_watchdog_open_breaker_skips_measuring(ts):
+    from reporter_tpu.utils.watchdog import AbandonedThreadWatchdog
+
+    wd = AbandonedThreadWatchdog(cap=0)    # breaker already open
+
+    def never(plan):                       # must not be called
+        raise AssertionError("measured through an open breaker")
+
+    plan, info = autotune.resolve_plan(
+        MatcherParams(candidate_backend="dense"), ts, {}, never,
+        watchdog=wd, backend="tpu", devkey="t")
+    assert plan is not None and plan.source == "timeout"
+
+
+# ---------------------------------------------------------------------------
+# the plan cache + resolution order
+
+
+def test_cache_round_trip_and_corruption_misses(tmp_path, ts):
+    d = str(tmp_path)
+    fp = autotune.tile_fingerprint(ts)
+    plan = TunedPlan(arm="mxu", lowp="bf16", nj_cap=64, source="measured")
+    autotune.store_cached_plan(plan, {"candidates": {}}, fp, "dev:x", d)
+    got = autotune.load_cached_plan(fp, "dev:x", d)
+    assert got is not None and got.label == plan.label
+    assert got.source == "cache"
+    # other device / other tile = miss
+    assert autotune.load_cached_plan(fp, "dev:y", d) is None
+    assert autotune.load_cached_plan("feedbeef", "dev:x", d) is None
+    # corrupt file = miss, never an error
+    path = autotune._cache_path(d, fp, "dev:x")
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert autotune.load_cached_plan(fp, "dev:x", d) is None
+
+
+def test_resolve_measures_once_then_serves_the_cache(tmp_path, ts):
+    d = str(tmp_path)
+    params = MatcherParams(candidate_backend="dense")
+    calls = {"n": 0}
+
+    def counting(plan):
+        calls["n"] += 1
+        return _timer({"block@128": 0.1, "block@64": 0.05})(plan)
+
+    host = ts.host_tables("dense")
+    p1, i1 = autotune.resolve_plan(params, ts, host, counting,
+                                   directory=d, backend="tpu", devkey="v")
+    assert i1["source"] == "measured" and p1.label == "block@64"
+    measured = calls["n"]
+    assert measured == len(CANDIDATE_ARMS) + len(SWEEP_NJ_CAP_RUNGS) - 1
+    # the staged host dict was stamped with the measured plan
+    staged = autotune.plan_from_array(host["tuned_plan"])
+    assert staged is not None and staged.label == "block@64"
+
+    # a FRESH staging (new host dict, same tile/device): cache hit,
+    # zero additional measure calls — the fleet re-promotion shape
+    host2 = ts.host_tables("dense")
+    p2, i2 = autotune.resolve_plan(params, ts, host2, counting,
+                                   directory=d, backend="tpu", devkey="v")
+    assert i2["source"] == "cache" and p2.label == p1.label
+    assert calls["n"] == measured
+    assert autotune.plan_from_array(host2["tuned_plan"]).label == p1.label
+
+
+def test_resolve_staged_plan_wins_without_measuring(ts, tmp_path):
+    plan = TunedPlan(arm="mxu", lowp="bf16", nj_cap=256, source="measured")
+    tables = {"tuned_plan": autotune.plan_array(plan)}
+
+    def boom(_):
+        raise AssertionError("measured despite a staged plan")
+
+    got, info = autotune.resolve_plan(
+        MatcherParams(candidate_backend="dense"), ts, tables, boom,
+        backend="tpu", devkey="v")
+    assert info["source"] == "staged"
+    assert (got.arm, got.lowp, got.nj_cap) == ("mxu", "bf16", 256)
+    # a DEFAULT-stamped leaf (a fresh host_tables dict) is not "already
+    # tuned" — it must fall through toward cache/measure
+    fresh = {"tuned_plan": autotune.default_plan_array()}
+    got2, info2 = autotune.resolve_plan(
+        MatcherParams(candidate_backend="dense"), ts, fresh,
+        _timer({"subcull@64": 0.01}), backend="tpu", devkey="v",
+        directory=str(tmp_path / "fresh-cache"))
+    assert info2["source"] == "measured" and got2.nj_cap == 64
+
+
+def test_resolve_gates_off_explicit_and_cpu(ts):
+    def boom(_):
+        raise AssertionError("tuner acted when gated off")
+
+    off = MatcherParams(candidate_backend="dense", sweep_autotune=False)
+    assert autotune.resolve_plan(off, ts, {}, boom, backend="tpu") \
+        == (None, {"source": "off"})
+    for knobs in (dict(sweep_mxu=True, sweep_lowp="bf16"),
+                  dict(sweep_subcull=False),
+                  dict(sweep_lowp="bf16"),
+                  dict(sweep_nj_cap=64)):
+        explicit = MatcherParams(candidate_backend="dense", **knobs)
+        plan, info = autotune.resolve_plan(explicit, ts, {}, boom,
+                                           backend="tpu")
+        assert plan is None and info["source"] == "explicit", knobs
+    # CPU short-circuit: auto resolves to grid, and even explicit dense
+    # on a cpu backend must not measure (interpret timings lie)
+    for params in (MatcherParams(),
+                   MatcherParams(candidate_backend="dense")):
+        plan, info = autotune.resolve_plan(params, ts, {}, boom,
+                                           backend="cpu")
+        assert plan is None and info["source"] == "cpu"
+
+
+def test_offline_cold_tier_stamp(tmp_path, ts):
+    """The offline pre-staging helper: a cached plan lands in a
+    host-pinned dict so matchers built on it resolve from the staged
+    member (external table-cache builders; the fleet promotion path
+    deliberately avoids it — device_key can hang a first backend
+    init on a dead tunnel)."""
+    d = str(tmp_path)
+    plan = TunedPlan(arm="subcull", lowp="bf16", nj_cap=256,
+                     source="measured")
+    autotune.store_cached_plan(plan, {}, autotune.tile_fingerprint(ts),
+                               autotune.device_key(), d)
+    host = ts.host_tables("dense")
+    got = autotune.stamp_cached_plan(ts, host, MatcherParams(), d)
+    assert got is not None and got.label == plan.label
+    assert autotune.plan_from_array(host["tuned_plan"]).label == plan.label
+    # explicit knobs: the hook must not touch the dict
+    host2 = ts.host_tables("dense")
+    before = host2["tuned_plan"].copy()
+    assert autotune.stamp_cached_plan(
+        ts, host2, MatcherParams(sweep_nj_cap=64), d) is None
+    assert np.array_equal(host2["tuned_plan"], before)
+
+
+def test_fleet_promotion_keeps_the_plan_leaf_host_readable(ts):
+    """The r17 fleet handoff: promotion device_puts the host dict but
+    hands the matcher a HOST-backed tuned_plan leaf alongside the
+    device tables — the staged-plan seam must be able to read a
+    pre-tuned dict with zero device readback (and the post-build
+    write-back must land the resolved plan in the host-pinned dict).
+    On CPU the tuner short-circuits, so the leaf stays the default —
+    what is pinned here is the host-readability of the seam itself."""
+    from reporter_tpu.fleet import FleetResidency
+
+    # dense layout explicitly: on CPU the "auto" fleet stages the grid
+    # layout, which carries no plan member at all
+    fr = FleetResidency([ts], Config(
+        matcher_backend="jax",
+        matcher=MatcherParams(candidate_backend="dense")))
+    with fr.lease(ts.name) as m:
+        pass
+    metro = fr._metros[ts.name]
+    leaf = m._tables.get("tuned_plan")
+    assert isinstance(leaf, np.ndarray), type(leaf)
+    assert autotune.plan_from_array(leaf) is not None
+    # the host-pinned dict and the served dict agree on the plan leaf
+    assert np.array_equal(leaf, metro.host["tuned_plan"])
+
+
+def test_calibration_batch_is_deterministic_and_q16_safe(ts):
+    a = autotune.calibration_batch(ts)
+    b = autotune.calibration_batch(ts)
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+    pts_q, origins, lens = a
+    B, T = autotune.CAL_BATCH_SHAPE
+    assert pts_q.shape == (B, T, 2) and pts_q.dtype == np.int16
+    assert origins.shape == (B, 2) and lens.shape == (B,)
+    assert (np.abs(pts_q.astype(np.int64)) < 32768).all()
+    assert (pts_q[:, 0] == 0).all()        # origin = the first point
+
+
+# ---------------------------------------------------------------------------
+# matcher integration
+
+
+def test_matcher_cpu_short_circuit(ts):
+    from reporter_tpu.matcher.api import SegmentMatcher
+
+    m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    assert m.tuned_plan is None
+    assert m.tuned_report == {"source": "cpu"}
+    assert m.tuned_plan_array() is None
+
+
+def test_matcher_applies_a_resolved_plan(ts, monkeypatch):
+    """When resolution yields a plan, construction applies it to
+    params, the mirrored config, AND the wire statics — the serving
+    path must ride the tuned executables, not just report them."""
+    from reporter_tpu.matcher.api import SegmentMatcher
+
+    plan = TunedPlan(arm="mxu", lowp="bf16", nj_cap=256, source="cache")
+    monkeypatch.setattr(autotune, "resolve_plan",
+                        lambda *a, **k: (plan, {"source": "cache"}))
+    m = SegmentMatcher(ts, Config(matcher_backend="jax"))
+    assert m.tuned_plan == plan
+    assert m.params.sweep_mxu and m.params.sweep_lowp == "bf16"
+    assert m.params.sweep_nj_cap == 256
+    assert m.config.matcher == m.params
+    assert m._wire.params.sweep_mxu
+    # watchdog knobs stay stripped from the wire statics (r9)
+    assert m._wire.params.dispatch_timeout_s == 0.0
+    got = autotune.plan_from_array(m.tuned_plan_array())
+    assert got is not None and got.label == plan.label
+    assert int(m.metrics.value("autotune_cache_total")) == 1
+
+
+def test_staged_layout_v3_refused_at_both_seams(ts):
+    """Pre-v3 dicts (no tuned_plan / v2 tag) fail loudly at
+    SegmentMatcher(staged_tables=) and restage_tables — the r13
+    stale-dict discipline extended over tuned plans."""
+    from reporter_tpu.matcher.api import SegmentMatcher
+
+    good = ts.host_tables("dense")
+    assert "tuned_plan" in good and int(good["staged_layout"]) == 3
+
+    v2 = dict(good, staged_layout=np.int32(2))
+    v2.pop("tuned_plan")
+    cfg = Config(matcher_backend="jax")
+    with pytest.raises(ValueError, match="layout v2"):
+        SegmentMatcher(ts, cfg, staged_tables=v2)
+    # fresh tag but a hand-assembled dict missing the plan member
+    torn = dict(good)
+    torn.pop("tuned_plan")
+    with pytest.raises(ValueError, match="tuned_plan"):
+        SegmentMatcher(ts, cfg, staged_tables=torn)
+
+    m = SegmentMatcher(ts, cfg)
+    with pytest.raises(ValueError, match="layout v2"):
+        m.restage_tables(v2)
+    with pytest.raises(ValueError, match="tuned_plan"):
+        m.restage_tables(torn)
+    m.restage_tables(good)                 # the real builder passes
+
+
+# ---------------------------------------------------------------------------
+# the nj-cap ladder end to end
+
+
+def test_nj_cap_env_and_validation():
+    p = MatcherParams().with_env_overrides({"RTPU_NJ_CAP": "64"})
+    assert p.sweep_nj_cap == 64
+    with pytest.raises(ValueError, match="ladder rung"):
+        MatcherParams().with_env_overrides({"RTPU_NJ_CAP": "100"})
+    with pytest.raises(ValueError, match="RTPU_NJ_CAP"):
+        MatcherParams().with_env_overrides({"RTPU_NJ_CAP": "lots"})
+    p = MatcherParams().with_env_overrides({"RTPU_SWEEP_AUTOTUNE": "0"})
+    assert p.sweep_autotune is False
+    with pytest.raises(ValueError, match="RTPU_SWEEP_AUTOTUNE"):
+        MatcherParams().with_env_overrides({"RTPU_SWEEP_AUTOTUNE": "ja"})
+    with pytest.raises(ValueError, match="ladder rung"):
+        Config(matcher=MatcherParams(sweep_nj_cap=96)).validate()
+    Config(matcher=MatcherParams(sweep_nj_cap=256)).validate()
+
+
+def test_nj_cap_rung_interpret_parity(ts, monkeypatch):
+    """Rung choice is exact: an explicit nj_cap (narrow path) and the
+    module-default fallback produce the jnp reference's candidates bit
+    for bit — both cond branches live (the round-5 exactness argument,
+    re-pinned for the params-selectable cap)."""
+    import jax.numpy as jnp
+
+    import reporter_tpu.ops.dense_candidates as dc
+    from reporter_tpu.ops.dense_candidates import build_seg_pack
+
+    monkeypatch.setattr(dc, "_INTERPRET", True)
+    monkeypatch.setattr(dc, "_SBLK", 128)
+    monkeypatch.setattr(dc, "_SUB", 64)
+    monkeypatch.setattr(dc, "_NJ_CAP", 1)  # module default → fallback
+
+    sp = build_seg_pack(ts.seg_a, ts.seg_b, ts.seg_edge, ts.seg_off,
+                        ts.seg_len, block=128)
+    assert sp.bbox.shape[0] >= 2
+    packs = (jnp.asarray(sp.pack), jnp.asarray(sp.bbox),
+             jnp.asarray(sp.sub), jnp.asarray(sp.feat))
+    rng = np.random.default_rng(5)
+    lo = ts.node_xy.min(0)
+    pts = jnp.asarray(
+        (lo + rng.uniform(0, 60.0, (64, 2))).astype(np.float32))
+    ref = dc._dense_jnp(pts, (packs[0], None), 50.0, 8)
+    # explicit rung wide enough for the clustered batch: narrow executes
+    narrow = dc.find_candidates_dense(pts, packs, 50.0, 8,
+                                      nj_cap=sp.bbox.shape[0] - 1)
+    # None → the monkeypatched module default (1): fallback executes
+    fallback = dc.find_candidates_dense(pts, packs, 50.0, 8, nj_cap=None)
+    for got in (narrow, fallback):
+        assert (np.asarray(got.edge) == np.asarray(ref[0])).all()
+        assert np.allclose(np.asarray(got.dist), np.asarray(ref[2]),
+                           rtol=1e-5, atol=1e-2)
+
+
+def test_manifest_enumerates_the_plan_space():
+    from reporter_tpu.analysis import compile_manifest
+
+    g = compile_manifest.GOLDEN
+    assert g["autotune"]["nj_cap_rungs"] == list(SWEEP_NJ_CAP_RUNGS)
+    assert g["autotune"]["arms"] == [
+        TunedPlan(arm=a, lowp=l).label.split("@")[0]
+        for a, l in CANDIDATE_ARMS]
+    assert g["autotune"]["plans_bound"] == (
+        len(CANDIDATE_ARMS) * len(SWEEP_NJ_CAP_RUNGS))
+    assert g["dense_sweep"]["nj_cap_rungs"] == list(SWEEP_NJ_CAP_RUNGS)
+    assert g["staged_tables"]["layout_version"] == 3
+    # the calibration dispatch shape reuses pinned (rung, bucket) cells
+    B, T = g["autotune"]["cal_batch_shape"]
+    assert B in g["scheduler"]["trace_count_rungs"]
+    assert T in g["matcher"]["point_buckets"]

@@ -259,6 +259,38 @@ def test_bench_legs_subset_cli_under_three_minutes(tmp_path):
         or "STALE" in entries[0]["staleness_banner"]
 
 
+def test_bench_legs_autotune_cli(tmp_path):
+    """Round-17 acceptance: `python bench.py --legs autotune` is the
+    driver's short-window harness — self-contained on the no-chip path,
+    journals the leg, records the mechanism bits and the tune summary
+    token, and writes the PARTIAL detail file only."""
+    env = dict(os.environ)
+    env["REPORTER_BENCH_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    cpu_capture = os.path.join(os.path.dirname(_BENCH),
+                               "BENCH_DETAIL_CPU.json")
+    committed = (open(cpu_capture).read()
+                 if os.path.exists(cpu_capture) else None)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(_BENCH), "--legs", "autotune"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        timeout=180, env=env, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stdout[-2000:]
+    summary = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    assert summary["tune"][2] == "cpu-validate"
+    assert summary["tune"][3] == 1          # mechanism_ok proven
+    assert summary["tune"][0]               # a plan was chosen
+    if committed is not None:               # no-clobber (r15 discipline)
+        assert open(cpu_capture).read() == committed
+    journal_path = os.path.join(os.path.dirname(os.path.abspath(_BENCH)),
+                                "bench_journal.jsonl")
+    entries = [json.loads(ln)
+               for ln in open(journal_path).read().splitlines()]
+    legs = {e.get("leg"): e for e in entries[1:]}
+    assert "autotune" in legs
+    assert legs["autotune"]["result"]["mechanism_ok"] is True
+
+
 def test_bench_rejects_unknown_legs():
     env = dict(os.environ)
     out = subprocess.run(

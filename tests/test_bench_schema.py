@@ -342,6 +342,62 @@ def test_summary_line_carries_lattr_token():
     assert empty["lattr"] == [None] * 3
 
 
+AUTOTUNE_PROBE_KEYS = (
+    "plan", "source", "candidates", "calibration_seconds",
+    "calibration_dispatches", "cache_hit", "tuned", "default",
+    "tuned_vs_default_speedup", "dispatch_shape",
+)
+
+AUTOTUNE_VALIDATE_KEYS = (
+    "cpu_short_circuit", "deterministic", "cache_hit",
+    "plan_from_cache_identical", "v2_refused_at_construction",
+    "v2_refused_at_restage", "mechanism_ok",
+)
+
+
+def test_autotune_leg_schema_keys():
+    """Pin detail.autotune (round 17): the chosen plan, per-candidate
+    timings, tuned-vs-default A/B (chip) and the mechanism bits (CPU
+    validation) must stay recorded fields — extend, never drop."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._autotune_probe)
+    for key in AUTOTUNE_PROBE_KEYS:
+        assert f'"{key}"' in src, key
+    src_v = inspect.getsource(bench._autotune_cpu_validate)
+    for key in AUTOTUNE_VALIDATE_KEYS:
+        assert f'"{key}"' in src_v, key
+
+
+def test_summary_line_carries_tune_token():
+    """tune = [chosen plan label, tuned-vs-default speedup, source,
+    mechanism bit (CPU validation; None on chip)]."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "autotune": {
+                   "plan": {"arm": "mxu", "lowp": "bf16", "nj_cap": 128,
+                            "source": "measured",
+                            "label": "mxu+bf16@128"},
+                   "tuned_vs_default_speedup": 1.183,
+                   "source": "measured",
+               },
+           }}
+    line = bench._summary_line(doc)
+    assert line["tune"] == ["mxu+bf16@128", 1.183, "measured", None]
+    # the CPU-validation composite carries the mechanism bit instead
+    doc["detail"]["autotune"] = {
+        "plan": {"label": "mxu+bf16@256"}, "source": "cpu-validate",
+        "mechanism_ok": True}
+    assert bench._summary_line(doc)["tune"] == [
+        "mxu+bf16@256", None, "cpu-validate", 1]
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["tune"] == [None] * 4
+
+
 def test_fleet_leg_schema_keys():
     """Pin detail.fleet's occupancy/paging block (ISSUE 6): the
     capture's fleet story — metros served, mixed kpps, promotion
@@ -356,7 +412,8 @@ def test_fleet_leg_schema_keys():
                 "touches", "promote_p50_ms", "promote_p99_ms",
                 "promote_to_first_report_p50_ms", "occupancy",
                 "wires_bit_identical", "wires_identical_to_dedicated",
-                "wires_identical_after_paging", "per_metro"):
+                "wires_identical_after_paging", "per_metro",
+                "tuned_plan"):
         assert f'"{key}"' in src, key
     # the occupancy report itself (fleet/residency.py) feeds /health and
     # the bench artifact — same extend-don't-drop discipline
@@ -365,7 +422,7 @@ def test_fleet_leg_schema_keys():
     src_o = inspect.getsource(FleetResidency.occupancy)
     for key in ("capacity_bytes", "evict_watermark", "resident_bytes",
                 "occupancy_frac", "resident_metros", "registered_metros",
-                "promotions", "demotions", "metros"):
+                "promotions", "demotions", "metros", "tuned_plan"):
         assert f'"{key}"' in src_o, key
 
 

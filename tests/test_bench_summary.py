@@ -113,6 +113,14 @@ def _synthetic_doc():
                   "storm": {"promote_p50_ms": 1234.56},
                   "occupancy": {"promotions": 12345, "demotions": 12321},
                   "fidelity": {"wires_bit_identical": True}},
+        "autotune": {
+            "plan": {"arm": "mxu", "lowp": "bf16", "nj_cap": 256,
+                     "source": "measured", "label": "mxu+bf16@256"},
+            "source": "measured",
+            "tuned_vs_default_speedup": 12.345,
+            "candidates": {"subcull@128":
+                           {"device_ms_per_dispatch": 138.113}},
+        },
         "link_health": {"rtt_ms": 1129.22, "mbps": 125.13,
                         "mood": "degraded", "samples": 123,
                         "probe_duty_pct": 0.4123},

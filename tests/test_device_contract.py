@@ -76,10 +76,14 @@ def test_compile_manifest_keeps_its_sections():
     # extend-don't-drop: a regenerated manifest that loses a section is
     # a gate regression even though GOLDEN == computed
     for key in ("scheduler", "matcher", "wire_formats", "dense_sweep",
-                "histogram_scatter", "staged_tables", "envelope"):
+                "histogram_scatter", "staged_tables", "envelope",
+                "autotune"):
         assert key in compile_manifest.GOLDEN, key
     assert compile_manifest.GOLDEN["scheduler"]["trace_count_rungs"]
     assert compile_manifest.GOLDEN["matcher"]["point_buckets"]
+    # the r17 plan space stays enumerated: arms × nj-cap ladder
+    assert compile_manifest.GOLDEN["autotune"]["arms"]
+    assert compile_manifest.GOLDEN["autotune"]["nj_cap_rungs"]
 
 
 def test_manifest_generators_match_the_live_rung_functions():
