@@ -2014,6 +2014,295 @@ def _autotune_cpu_validate() -> dict:
     }
 
 
+def _quality_overhead_ab(m, batches, default_rate: float,
+                         forced_audits: int = 2) -> dict:
+    """Shadow-audit overhead at the DEFAULT sampling rate, two ways
+    (shared by the chip probe and the CPU validation so the capture
+    always carries the acceptance number):
+
+      - a direct off-vs-default A/B over the same batch list (audits
+        drain inside the timed window — on a one-core host the audit
+        thread's cost IS steady-wave host cost);
+      - the per-audited-batch oracle cost from FORCED audits
+        (rate=1.0), which prices the implied steady-state overhead
+        ``default_rate × audit_s_per_batch / wave_s`` — deterministic
+        where the direct A/B at a 1/256 rate is noise-dominated over a
+        bench-sized batch count.
+
+    ``audit_overhead_pct`` — the headline, acceptance <2% — is the
+    implied steady-state projection BOUNDED BY THE ENFORCED LIMITS
+    (audits/s = min(rate / wave_s, 1 / min_interval_s), then the
+    measured-duty cap): the auditor really sheds past both, counted, so
+    the bound is enforcement, not assumption. The raw direct A/B rides
+    along unheadlined (at a 1/256 rate over a bench-sized batch count
+    it is one-core noise). The process auditor is swapped per arm and
+    restored — the r10 global-state discipline."""
+    from reporter_tpu.quality import audit as quality_audit
+
+    prev = quality_audit._global
+    arms: dict = {}
+    try:
+        m.match_many(batches[0])    # warm: both arms must time steady
+        #                             waves, not first-compile (the r10
+        #                             warm-arm discipline)
+        for name, rate in (("off", 0.0), ("on", default_rate)):
+            a = quality_audit.ShadowAuditor(rate=rate,
+                                            duty_pct_cap=100.0)
+            quality_audit.configure(a)
+            t0 = time.perf_counter()
+            for b in batches:
+                m.match_many(b)
+            a.drain(60.0)
+            arms[name] = (time.perf_counter() - t0, a.stats())
+            a.stop()
+        # forced arm prices ONE audit (min_interval_s=0: this arm
+        # measures per-audit cost, not the production schedule)
+        forced = quality_audit.ShadowAuditor(rate=1.0,
+                                             duty_pct_cap=100.0,
+                                             min_interval_s=0.0)
+        quality_audit.configure(forced)
+        for b in batches[:forced_audits]:
+            # the in-path hook samples (rate 1.0) — no explicit call,
+            # or every batch would audit twice
+            m.match_many(b)
+        forced.drain(120.0)
+        fstats = forced.stats()
+        forced.stop()
+    finally:
+        quality_audit.configure(prev)
+    defaults = quality_audit.ShadowAuditor(rate=default_rate)
+    probes = sum(len(t.xy) for b in batches for t in b)
+    dt_off, _ = arms["off"]
+    dt_on, on_stats = arms["on"]
+    wave_s = dt_off / max(len(batches), 1)
+    audited = fstats["audited_batches"]
+    s_per_audit = (fstats["audit_seconds"] / audited if audited
+                   else None)
+    if s_per_audit is None or wave_s <= 0:
+        implied = capped = None
+    else:
+        audits_per_s = min(default_rate / wave_s,
+                           1.0 / max(defaults.min_interval_s, 1e-9))
+        implied = 100.0 * audits_per_s * s_per_audit
+        capped = min(implied, defaults.duty_pct_cap)
+    direct = 100.0 * (dt_on - dt_off) / dt_off if dt_off else None
+    return {
+        "off_pps": round(probes / dt_off, 1) if dt_off else None,
+        "on_pps": round(probes / dt_on, 1) if dt_on else None,
+        "audit_rate": default_rate,
+        "min_interval_s": defaults.min_interval_s,
+        "duty_pct_cap": defaults.duty_pct_cap,
+        "audited_batches": int(on_stats["audited_batches"]),
+        "audit_s_per_batch": (None if s_per_audit is None
+                              else round(s_per_audit, 4)),
+        "direct_overhead_pct": (None if direct is None
+                                else round(direct, 3)),
+        "uncapped_overhead_pct": (None if implied is None
+                                  else round(implied, 3)),
+        "audit_overhead_pct": (None if capped is None
+                               else round(capped, 3)),
+        # the bar gates on the UNCAPPED projection: min(x, duty_cap=1)
+        # can never exceed 2, so a bar on the capped number would be
+        # vacuous green for any measurement (the r13 mxu-token rule —
+        # an acceptance bit must be able to fail); the duty cap stays
+        # reported as the separate enforcement bound
+        "meets_2pct_bar": (None if implied is None
+                           else bool(implied < 2.0)),
+    }
+
+
+def _quality_probe(m, traces, n_batches: int = 6,
+                   batch_traces: int = 256) -> dict:
+    """Chip leg (round 18, reporter_tpu/quality/): steady-wave quality
+    signals on the primary tile's matcher (the same per-metro window
+    serving reads at /health), a forced shadow-oracle audit's measured
+    disagreement, the audit-overhead A/B at the default sampling rate
+    (acceptance: recorded and <2% of steady-wave host cost), and the
+    drift-sentinel state after the leg's waves. Host-side only — the
+    wire programs and compile manifest are untouched by construction
+    (the r16 device-contract suite re-proves that every CI run)."""
+    from reporter_tpu.quality import audit as quality_audit
+
+    k = min(batch_traces, len(traces))
+    batches = [traces[i * k:(i + 1) * k]
+               for i in range(max(1, min(n_batches,
+                                         len(traces) // max(k, 1))))]
+    batches = [b for b in batches if b]
+    # the SHIPPED default rate, not the env view: main() pins the
+    # process env rate to 0 so audits can't poison other legs, and the
+    # overhead claim is about the rate a default deployment serves at
+    default_rate = quality_audit._DEFAULT_RATE
+    overhead = _quality_overhead_ab(m, batches, default_rate)
+
+    # a forced audit's measured disagreement (the production gt_edge
+    # proxy, on this tile's real traffic)
+    prev = quality_audit._global
+    try:
+        forced = quality_audit.ShadowAuditor(rate=1.0,
+                                             duty_pct_cap=100.0,
+                                             min_interval_s=0.0)
+        quality_audit.configure(forced)
+        m.match_many(batches[0])    # the in-path hook audits at rate 1
+        forced.drain(120.0)
+        audit_stats = forced.stats()
+        forced.stop()
+    finally:
+        quality_audit.configure(prev)
+
+    agg = m.quality.window_rates()
+    health = m.quality.health()
+    return {
+        "config": (f"{len(batches)}x{k} trace waves, tile={m.ts.name}, "
+                   f"default audit rate {default_rate:.4f}"),
+        "signals": {
+            **{name: (None if agg[name] is None
+                      else round(agg[name], 4))
+               for name in agg},
+            "window_waves": health["window_waves"],
+        },
+        "audit": {
+            "audited_batches": audit_stats["audited_batches"],
+            "audited_traces": audit_stats["audited_traces"],
+            "audit_timeouts": audit_stats["audit_timeouts"],
+            "audit_seconds": audit_stats["audit_seconds"],
+            "disagreement_rate": audit_stats["disagreement_rate"],
+        },
+        "audit_overhead": overhead,
+        "drift": {"drift_events": health["drift_events"]},
+    }
+
+
+def _quality_cpu_validate() -> dict:
+    """No-chip stand-in for _quality_probe (every CPU-forced / outage
+    composite, the r17 autotune pattern): the quality MECHANISM at tiny
+    scale, self-contained (builds its own tile/fleet), so ``--legs
+    quality`` fits a short tunnel window. Validates: signal extraction
+    + per-metro publication on real matcher output, the deterministic
+    seeded audit schedule, a real shadow-oracle audit round trip, the
+    audit-overhead A/B shape (recorded at tiny scale), and the
+    quality_drift chaos contract — an injected ``quality`` fault rule
+    fires EXACTLY one post-mortem and a clean twin run fires none."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from reporter_tpu.config import CompilerParams, Config
+    from reporter_tpu.matcher.api import SegmentMatcher, Trace
+    from reporter_tpu.netgen.synthetic import generate_city
+    from reporter_tpu.netgen.traces import synthesize_fleet
+    from reporter_tpu.quality import audit as quality_audit
+    from reporter_tpu.tiles.compiler import compile_network
+    from reporter_tpu.utils import tracing
+    from reporter_tpu.utils.metrics import labeled
+
+    ts = compile_network(generate_city("tiny", seed=31), CompilerParams())
+    fleet = synthesize_fleet(ts, 6, num_points=40, seed=6)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype(np.float32),
+                    times=p.times) for p in fleet]
+    cfg = Config(matcher_backend="jax")
+    m = SegmentMatcher(ts, cfg)
+    batches = [traces] * 4
+    # shipped default, not the env view (see _quality_probe)
+    default_rate = quality_audit._DEFAULT_RATE
+    overhead = _quality_overhead_ab(m, batches, default_rate,
+                                    forced_audits=1)
+    signals_recorded = bool(
+        m.quality.health()["window_waves"] >= len(batches) * 2
+        and m.metrics.value(labeled("quality_batches",
+                                    metro=ts.name)) > 0)
+
+    # deterministic seeded schedule (the faults.py replay discipline)
+    seqs = []
+    for _ in range(2):
+        a = quality_audit.ShadowAuditor(rate=0.3, seed=17)
+        seqs.append([a._rng.random() < a.rate for _ in range(64)])
+        a.stop()
+    sampler_deterministic = seqs[0] == seqs[1]
+
+    # one real audit round trip against the exact oracle
+    prev = quality_audit._global
+    try:
+        forced = quality_audit.ShadowAuditor(rate=1.0, max_traces=2,
+                                             duty_pct_cap=100.0,
+                                             min_interval_s=0.0)
+        quality_audit.configure(forced)
+        m.match_many(traces)        # the in-path hook audits at rate 1
+        forced.drain(60.0)
+        audit_stats = forced.stats()
+        forced.stop()
+    finally:
+        quality_audit.configure(prev)
+    # exactly one batch served while configured ⇒ exactly one audit —
+    # proving the HOOK fired, not a hand-called auditor
+    audit_ran = (audit_stats["audited_batches"] == 1
+                 and audit_stats["disagreement_rate"] is not None)
+
+    # drift chaos: injected rule -> ONE post-mortem; clean twin -> none
+    tr = tracing.tracer()
+    prev_tr = (tr.enabled, tr.dump_dir, tr.capacity, tr.max_dumps)
+    prev_written = tr.dumps_written
+    workdir = tempfile.mkdtemp(prefix="rtpu_quality_bench_")
+    try:
+        tr.configure(enabled=True, dump_dir=workdir, max_dumps=4)
+
+        def drive():
+            dm = SegmentMatcher(ts, cfg)
+            dm.quality.min_waves = 99       # isolate the injected path
+            for _ in range(3):
+                dm.match_many(traces)
+            return dm
+
+        from reporter_tpu import faults
+        with faults.use(faults.FaultPlan.parse("quality:fail@1")):
+            chaos_m = drive()
+        dumps = sorted(os.listdir(workdir))
+        one_event_one_dump = (
+            chaos_m.quality.drift_events == 1
+            and len([d for d in dumps if "quality_drift" in d]) == 1)
+        twin_m = drive()
+        dumps2 = sorted(os.listdir(workdir))
+        clean_twin_ok = (twin_m.quality.drift_events == 0
+                         and dumps2 == dumps)
+    finally:
+        tr.configure(enabled=prev_tr[0], dump_dir=prev_tr[1],
+                     capacity=prev_tr[2], max_dumps=prev_tr[3])
+        tr.dumps_written = prev_written
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    agg = m.quality.window_rates()
+    mechanism_ok = bool(signals_recorded and sampler_deterministic
+                        and audit_ran and one_event_one_dump
+                        and clean_twin_ok
+                        and overhead["audit_overhead_pct"] is not None)
+    return {
+        "config": (f"tiny-scale mechanism validation, tile={ts.name} "
+                   "(no chip — signals, audit, sampler, drift chaos)"),
+        "source": "cpu-validate",
+        "signals": {
+            **{name: (None if agg[name] is None
+                      else round(agg[name], 4))
+               for name in agg},
+            "window_waves": m.quality.health()["window_waves"],
+        },
+        "audit": {
+            "audited_batches": audit_stats["audited_batches"],
+            "audited_traces": audit_stats["audited_traces"],
+            "audit_timeouts": audit_stats["audit_timeouts"],
+            "audit_seconds": audit_stats["audit_seconds"],
+            "disagreement_rate": audit_stats["disagreement_rate"],
+        },
+        "audit_overhead": overhead,
+        "drift": {"drift_events": 1},   # the injected event, by contract
+        "signals_recorded": signals_recorded,
+        "sampler_deterministic": sampler_deterministic,
+        "audit_ran": audit_ran,
+        "one_event_one_dump": one_event_one_dump,
+        "clean_twin_ok": clean_twin_ok,
+        "mechanism_ok": mechanism_ok,
+    }
+
+
 def _service_overload_boundary(curve: list, arm: str = "scheduler") -> dict:
     """First client level where the serving face shows overload — errors,
     p99 blowup, or req/s REGRESSION vs the previous level (queue growth
@@ -2774,13 +3063,14 @@ _ALL_LEGS = (
     "metro", "restricted", "xl", "organic", "organic_xl", "bicycle",
     "streaming", "streaming_capacity", "streaming_soak",
     "latency_attribution", "streaming_overload", "chaos",
-    "device_compute", "sweep_ab", "autotune", "window2", "prepare_bench",
-    "fleet",
+    "device_compute", "sweep_ab", "autotune", "quality", "window2",
+    "prepare_bench", "fleet",
 )
-_SELF_CONTAINED_LEGS = {"fleet"}        # + sweep_ab / autotune when no
-#                                         chip is in play (their
-#                                         *_cpu_validate stand-ins
-#                                         compile their own tiny tiles)
+_SELF_CONTAINED_LEGS = {"fleet"}        # + sweep_ab / autotune /
+#                                         quality when no chip is in
+#                                         play (their *_cpu_validate
+#                                         stand-ins compile their own
+#                                         tiny tiles)
 
 
 class BenchJournal:
@@ -3032,6 +3322,17 @@ def main() -> None:
     t_setup = time.perf_counter()
     split: dict = {}
 
+    # Pin the PROCESS-GLOBAL shadow auditor's default sampling off for
+    # the composite (r18, the tests/conftest.py discipline): one
+    # default-schedule exact-oracle audit landing inside a measured
+    # window starves the one-core closed loop for seconds (observed:
+    # the legacy service arm collapsing 392→70 req/s when an audit hit
+    # its round). The audit subsystem is measured under controlled
+    # conditions by detail.quality's explicit auditors — which override
+    # this via constructor args — not by randomly poisoning other legs.
+    # An operator-set value wins (A/B runs can re-enable on purpose).
+    os.environ.setdefault("RTPU_QUALITY_AUDIT_RATE", "0")
+
     n_arg, city, resume, legs_filter = _parse_args(sys.argv[1:])
     manual = n_arg is not None
 
@@ -3094,7 +3395,7 @@ def main() -> None:
     requested = set(legs_filter) if legs_filter is not None \
         else set(_ALL_LEGS)
     self_contained = set(_SELF_CONTAINED_LEGS) | (
-        set() if tpu_ok else {"sweep_ab", "autotune"})
+        set() if tpu_ok else {"sweep_ab", "autotune", "quality"})
     needs_primary = bool(requested - self_contained)
 
     cur_round = _current_round()
@@ -3703,6 +4004,21 @@ def main() -> None:
         detail["autotune"] = tune
     split["autotune_s"] = journal.seconds("autotune")
 
+    # -- online match-quality telemetry (round 18): steady-wave quality
+    # signals + the shadow-audit overhead A/B at the default rate on
+    # chip; tiny-scale mechanism validation (signals, audit, sampler
+    # determinism, drift chaos) on every no-chip composite — self-
+    # contained there, so `--legs quality` fits a short window ---------
+    def _leg_quality():
+        if full_run:
+            return _quality_probe(jax_matcher, traces)
+        return _quality_cpu_validate()
+
+    qual = journal.leg("quality", _leg_quality)
+    if qual:
+        detail["quality"] = qual
+    split["quality_s"] = journal.seconds("quality")
+
     if full_run:
         # -- per-tile co-located e2e (round-8 satellite): derived from
         # the assembled detail, not journaled ---------------------------
@@ -3887,6 +4203,25 @@ def _mxu_token(_g) -> list:
             None if not bits else int(all(bits))]
 
 
+def _qual_token(_g) -> list:
+    """qual = [empty-match bp, speed-violation bp, audit-disagreement
+    bp, audit overhead % of steady-wave host cost (acceptance <2),
+    drift events, mechanism bit (CPU validation; None on chip)] — the
+    round-18 quality leg's headline (full leg in detail.quality).
+    Rates ride as BASIS-POINT ints (the r18 compaction; exact values
+    stay in the detail file)."""
+    def bp(v):
+        return None if v is None else int(round(v * 1e4))
+
+    mech = _g("quality", "mechanism_ok")
+    return [bp(_g("quality", "signals", "empty_match_rate")),
+            bp(_g("quality", "signals", "violation_rate")),
+            bp(_g("quality", "audit", "disagreement_rate")),
+            _g("quality", "audit_overhead", "audit_overhead_pct"),
+            _g("quality", "drift", "drift_events"),
+            None if mech is None else int(bool(mech))]
+
+
 def _summary_line(doc: dict) -> dict:
     """Compact (<1 KB, CI-pinned by tests/test_bench_summary.py)
     machine-readable round summary: headline value, per-tile throughput,
@@ -3933,7 +4268,10 @@ def _summary_line(doc: dict) -> dict:
         "device": dev,
         "tiles_kpps": tiles_kpps,
         "e2e_over_decode": d.get("e2e_over_decode"),
-        "p50_trace_ms": d.get("p50_single_trace_latency_ms"),
+        # whole ms (r18 compaction; exact value stays in the detail)
+        "p50_trace_ms": (None
+                         if d.get("p50_single_trace_latency_ms") is None
+                         else int(d["p50_single_trace_latency_ms"])),
         "p50_matcher_ms": d.get("p50_matcher_only_ms"),
         # key names compacted for the 1 KB pin (r8 precedent): xl_bind =
         # xl binding leg ("dev" = device_sweep, "host" = host legs —
@@ -3948,28 +4286,38 @@ def _summary_line(doc: dict) -> dict:
             None if v is None else int(v)
             for v in (d.get("link_rtt_ms"),
                       _g("second_window", "link_rtt_ms"))],
-        # audit dis is a fixed-order array now (r15, same r8 compaction:
-        # no room for six tile names twice) — insertion order of the
-        # audit legs [headline, headline-fresh-rot, bayarea, sf+r,
-        # organic, bicycle]; named exact values in detail.audit.per_tile
+        # audit dis is a fixed-order array (r15, same r8 compaction: no
+        # room for six tile names twice) of BASIS-POINT ints (r18
+        # compaction — the qual token needed the bytes; 0.0123 rides as
+        # 123) — insertion order of the audit legs [headline,
+        # headline-fresh-rot, bayarea, sf+r, organic, bicycle]; named
+        # exact values in detail.audit.per_tile
         "audit": {
             "traces": _g("audit", "total_traces"),
-            "dis": [v.get("disagreement") for v in per_tile.values()],
+            "dis_bp": [None if v.get("disagreement") is None
+                       else int(round(v["disagreement"] * 1e4))
+                       for v in per_tile.values()],
             "src": sorted({v.get("fidelity_source", "?")
                            for v in per_tile.values()}),
         },
         # fixed-order arrays (the r8 kpps compaction, applied here when
-        # the lattr token needed the bytes back): gt_edge = point-on-
-        # edge rate for [headline tile, bayarea-xl, organic, organic-xl],
+        # the lattr token needed the bytes back): gt_pm = point-on-edge
+        # rate in PER-MILLE ints (r18 compaction: 0.9444 rides as 944)
+        # for [headline tile, bayarea-xl, organic, organic-xl],
         # reach_miss = step miss rate for [bayarea-xl, organic,
         # organic-xl]; named exact values stay in detail.*.ground_truth /
         # detail.*.reach_audit
-        "gt_edge": [_g(*path, "point_edge_rate") for path in
-                    (("ground_truth",), ("xl", "ground_truth"),
-                     ("organic", "ground_truth"),
-                     ("organic_xl", "ground_truth"))],
-        "reach_miss": [_g(k, "reach_audit", "step_miss_rate")
-                       for k in ("xl", "organic", "organic_xl")],
+        "gt_pm": [None if v is None else int(round(v * 1e3))
+                  for v in (_g(*path, "point_edge_rate") for path in
+                            (("ground_truth",), ("xl", "ground_truth"),
+                             ("organic", "ground_truth"),
+                             ("organic_xl", "ground_truth")))],
+        # basis-point ints (r18 compaction; exact rates stay in
+        # detail.*.reach_audit)
+        "reach_miss_bp": [
+            None if v is None else int(round(v * 1e4))
+            for v in (_g(k, "reach_audit", "step_miss_rate")
+                      for k in ("xl", "organic", "organic_xl"))],
         # kpps int (r13: the mxu token needed the bytes — the r8
         # tiles_kpps compaction applied here; exact value in
         # detail.streaming.probes_per_sec)
@@ -4032,6 +4380,8 @@ def _summary_line(doc: dict) -> dict:
                  _g("autotune", "source"),
                  (None if _g("autotune", "mechanism_ok") is None
                   else int(bool(_g("autotune", "mechanism_ok"))))],
+        # round-18 quality token (see _qual_token)
+        "qual": _qual_token(_g),
         # chaos headline (full legs in detail.recovery /
         # detail.publish_outage / detail.streaming_soak_mp): [recovery
         # seconds after a SIGKILL, duplicated reports (the at-least-once
@@ -4043,10 +4393,13 @@ def _summary_line(doc: dict) -> dict:
                 _g("publish_outage", "dead_letter_pending_end"),
                 _g("streaming_soak_mp", "speedup_2v1")],
         # latency attribution headline (full decomposition in
-        # detail.latency_attribution): [e2e p50 ms at the held offer,
-        # sum-of-stage-p50s / e2e-p50 (1.0 = perfect reconciliation),
-        # tracing-overhead % from the traced-vs-untraced A/B]
-        "lattr": [_g("latency_attribution", "e2e_p50_ms"),
+        # detail.latency_attribution): [e2e p50 ms at the held offer
+        # (whole ms — r18 compaction), sum-of-stage-p50s / e2e-p50
+        # (1.0 = perfect reconciliation), tracing-overhead % from the
+        # traced-vs-untraced A/B]
+        "lattr": [(None if _g("latency_attribution",
+                              "e2e_p50_ms") is None
+                   else int(_g("latency_attribution", "e2e_p50_ms"))),
                   _g("latency_attribution", "stage_sum_over_e2e_p50"),
                   _g("latency_attribution", "tracing_overhead_pct")],
         # host-prepare A/B headline (full leg in detail.prepare_bench):
@@ -4062,12 +4415,14 @@ def _summary_line(doc: dict) -> dict:
              else int(bool(_g("prepare_bench", "bytes_identical"))))],
         # fleet residency headline (full leg in detail.fleet): [metros
         # served from one process, mixed-traffic kpps, storm promotion
-        # p50 ms, total promotions, total demotions, fleet wires
-        # byte-identical through paging (must be 1)]
+        # p50 whole ms (r18 compaction), total promotions, total
+        # demotions, fleet wires byte-identical through paging (must
+        # be 1)]
         "fleet": [
             _g("fleet", "n_metros"),
             None if fleet_pps is None else int(fleet_pps / 1e3),
-            _g("fleet", "storm", "promote_p50_ms"),
+            (None if _g("fleet", "storm", "promote_p50_ms") is None
+             else int(_g("fleet", "storm", "promote_p50_ms"))),
             _g("fleet", "occupancy", "promotions"),
             _g("fleet", "occupancy", "demotions"),
             None if fleet_bit is None else int(bool(fleet_bit))],

@@ -69,6 +69,13 @@ _LOWER_SUFFIXES = (
     "disagreement_k8", "disagreement_k12", "disagreement_vs_cpu_ref",
     "decode_slowdown_vs_sf", "e2e_over_decode", "_missed",
     "truncated_lines",
+    # r18 quality leg: every online quality rate is worse when UP, as
+    # is the shadow-audit's measured disagreement / overhead / timeout
+    # count and the drift-sentinel event count
+    "empty_match_rate", "breakage_rate", "discontinuity_rate",
+    "violation_rate", "rejection_rate", "unmatched_point_rate",
+    "disagreement_rate", "overhead_pct", "audit_timeouts",
+    "drift_events",
 )
 # Whole subtrees that are bookkeeping, measurement conditions, or
 # self-referential analysis — pruned before any leaf is classified (one
@@ -135,6 +142,17 @@ _SKIP_KEYS = {
     # streaming soak / worker bookkeeping
     "consumed_probes", "produced_probes", "hist_rows_nonzero",
     "hist_segments_flushed", "per_worker_match_seconds",
+    # quality leg (round 18): window/sample-count + audit-cost
+    # bookkeeping — the *_rate leaves and audit_overhead_pct above
+    # carry the compared claims; direct_overhead_pct is the raw
+    # off-vs-on A/B at a 1/256 sampling rate, noise-dominated by
+    # design (the implied audit_overhead_pct is the claim)
+    # lint: allow[bench-coverage] 2026-08-04 r18 detail.quality rows land with this round's capture (the leg is new; no committed composite carries it yet) — they guard the next committed capture, CPU and chip flavors alike
+    "window_waves", "audit_rate", "audited_batches", "audited_traces",
+    # lint: allow[bench-coverage] 2026-08-04 same r18 detail.quality rows as the line above (new leg, lands with this round's capture)
+    "audit_seconds", "direct_overhead_pct",
+    # lint: allow[bench-coverage] 2026-08-04 same r18 detail.quality rows (the auditor's enforced-bound echoes; audit_overhead_pct carries the claim)
+    "min_interval_s", "duty_pct_cap",
     # workload shape echoes
     "oracle_sample_traces", "total_traces", "trace_window", "wire_mode",
     "edges_vs_sf", "reach_rows_growth", "exact_tie_fraction",

@@ -118,6 +118,25 @@ LOCK_ORDER_EDGES: "dict[tuple[str, str], str]" = {
         "dead-link sample records under the sampler state lock — a "
         "ring append + leaf gauge writes, the same shape as the "
         "existing app.combine -> metrics.registry edge",
+    # ---- quality telemetry (round 18) ------------------------------------
+    ("app.combine", "quality.monitor"): "2026-08-04 the legacy combine "
+        "leader holds its lock through match_many (kept r7 A/B design), "
+        "so the harvest's quality-window append lands under it; the "
+        "monitor lock is a LEAF by contract (guards the window deque "
+        "only — publication/fault-plan/post-mortem all run outside it)",
+    ("app.combine", "quality.audit"): "2026-08-04 same combine-leader "
+        "path: the shadow-audit sampling decision (one counted seeded "
+        "draw + a bounded enqueue) lands under the leader lock; the "
+        "audit lock is a leaf — the oracle runs on the auditor's own "
+        "daemon thread, never here",
+    ("app.combine", "quality.registry"): "2026-08-04 same path: "
+        "quality_audit.auditor()'s lazy one-shot construction guard "
+        "(the faults.registry shape, already edged above)",
+    # (NOTE r18: oracle instances — the watchdog fallback and the
+    # shadow-audit oracle — run with their quality telemetry DISABLED,
+    # so no matcher.fallback -> quality/faults/tracer nesting exists;
+    # the shadow audit also runs a DEDICATED oracle instance and never
+    # takes matcher.fallback at all)
     # ---- streaming brokers ----------------------------------------------
     ("broker.partitions", "faults.plan"): "2026-08-04 durable append "
         "consults the broker fault site inside the partition lock so an "

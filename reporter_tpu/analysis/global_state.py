@@ -24,6 +24,7 @@ _ENV_PREFIXES = ("RTPU_", "REPORTER_", "DATASTORE_")
 
 def snapshot() -> dict:
     from reporter_tpu import faults
+    from reporter_tpu.quality import audit as quality_audit
     from reporter_tpu.utils import linkhealth, tracing
 
     tr = tracing.tracer()
@@ -40,6 +41,11 @@ def snapshot() -> dict:
         # LEGAL (lazy first construction by ensure_serving); X -> Y or
         # X -> None is a test leaking its fake into every later test
         "linkhealth.sampler": linkhealth._global,
+        # the r18 process-global shadow auditor follows the same
+        # swap-install shape (quality/audit.configure); identity, and
+        # None -> X lazy first construction is legal exactly like the
+        # link sampler's
+        "quality.auditor": quality_audit._global,
         "env": {k: v for k, v in os.environ.items()
                 if k.startswith(_ENV_PREFIXES)},
     }
@@ -63,6 +69,12 @@ def diff(pre: dict, post: dict) -> "list[str]":
                    "(linkhealth.configure(fake) without restoring the "
                    "previous sampler in finally) — later tests publish "
                    "the fake's mood at /metrics and /health")
+    pre_qa = pre.get("quality.auditor")
+    if pre_qa is not None and pre_qa is not post.get("quality.auditor"):
+        out.append("quality shadow auditor swapped and not restored "
+                   "(quality.audit.configure(fake) without restoring "
+                   "the previous auditor in finally) — later tests "
+                   "sample audits on the fake's schedule and budget")
     pe, qe = pre["env"], post["env"]
     for k in sorted(set(pe) | set(qe)):
         if pe.get(k) != qe.get(k):

@@ -32,6 +32,12 @@ Injection SITES (each consults the active plan at one seam):
               inside the guarded device_put body, so an injected hang
               stalls a promotion exactly where a dead tunnel would
               (bounded by ``FleetConfig.promote_timeout_s``)
+  quality     drift-sentinel evaluation (quality/monitor.py) — a rule
+              covering the evaluation's call index forces the window
+              comparison to read DRIFTED (consultation via ``check()``,
+              the broker-torn pattern: the monitor acts, the plan only
+              schedules), driving the ``quality_drift`` post-mortem
+              deterministically in chaos tests and the bench leg
 
 Rules are windows over a per-site CALL COUNTER (0-based), so a plan is
 deterministic run to run regardless of wall clock; the optional ``p``
@@ -59,7 +65,8 @@ from dataclasses import dataclass, field
 
 from reporter_tpu.utils import locks
 
-SITES = ("publish", "checkpoint", "broker", "dispatch", "fleet_promote")
+SITES = ("publish", "checkpoint", "broker", "dispatch", "fleet_promote",
+         "quality")
 KINDS = ("fail", "crash", "hang", "torn")
 
 

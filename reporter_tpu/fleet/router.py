@@ -155,6 +155,18 @@ class FleetRouter(MetroRouter):
             "status": "ok",
             "unroutable": int(self.metrics.value("router_unroutable")),
             "fleet": self.residency.occupancy(),
+            # fleet-level quality roll-up (round 18): which metros'
+            # windows are drifted right now and the total sentinel
+            # events, without digging through N per-metro blocks (each
+            # metro's full window rides its app health below)
+            "quality": {
+                "drifted_metros": sorted(
+                    n for n, a in apps.items()
+                    if a.matcher.quality.drifted),
+                "drift_events": sum(
+                    a.matcher.quality.health()["drift_events"]
+                    for n, a in apps.items()),
+            },
             # only metros that have seen traffic have an app to report;
             # the fleet block above covers every REGISTERED metro
             "metros": {n: a.health() for n, a in apps.items()},

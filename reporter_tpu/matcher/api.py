@@ -16,6 +16,7 @@ batch.
 
 from __future__ import annotations
 
+import threading
 from collections.abc import Sequence as _SequenceABC
 from dataclasses import dataclass
 from typing import Any, Sequence
@@ -236,6 +237,16 @@ class SegmentMatcher:
             self.config = _dc.replace(self.config, matcher=params)
         self.params: MatcherParams = params
         self.metrics = metrics or MetricsRegistry()
+        # online quality telemetry (round 18, reporter_tpu/quality/):
+        # per-metro signal window + drift sentinel over every
+        # match_many harvest — host-side only, so the compiled-shape
+        # manifest and wire programs are untouched by construction
+        from reporter_tpu.quality.monitor import QualityMonitor
+        self.quality = QualityMonitor(tileset.name, self.metrics)
+        # per-thread unmatched-point count from the latest jax harvest
+        # (match_many runs concurrently under the scheduler — a plain
+        # attribute would cross-talk between batches)
+        self._quality_tl = threading.local()
         backend = self.config.matcher_backend
         self._native_walker = None
         # per-metro self-tuned dispatch plan (round 17): resolved below
@@ -505,8 +516,40 @@ class SegmentMatcher:
             else:
                 out = self._guarded_jax_many(traces)
         self.metrics.count("traces", len(traces))
-        self.metrics.count("probes", sum(len(t.xy) for t in traces))
+        probes = sum(len(t.xy) for t in traces)
+        self.metrics.count("probes", probes)
+        if self.quality.enabled and len(traces):
+            self._record_quality(traces, out, probes)
         return out
+
+    def _record_quality(self, traces: Sequence[Trace], result,
+                        probes: int) -> None:
+        """Quality telemetry for one harvested batch (round 18): signal
+        extraction over the columns the harvest already built, the
+        window/drift sentinel, and the sampled shadow-oracle hook. All
+        host-side; the audit decision is one leaf-lock draw and the
+        oracle itself runs on the auditor's own bounded thread."""
+        from reporter_tpu.quality import audit as quality_audit
+        from reporter_tpu.quality import signals as quality_signals
+
+        nonempty = np.fromiter((len(t.xy) > 0 for t in traces), bool,
+                               len(traces))
+        hold = getattr(self._quality_tl, "unmatched_hold", None)
+        self._quality_tl.unmatched_hold = None
+        unmatched = hold.get("unmatched") if hold else None
+        sig = quality_signals.extract(
+            result, len(traces), probes, nonempty,
+            max_speed=self.quality.max_speed_mps, unmatched=unmatched)
+        self.quality.record(sig)
+        if self.backend == "jax" and hold is not None:
+            # auditing the oracle against itself is vacuous — and a
+            # degraded batch (watchdog fallback: _degrade nulls the
+            # hold) WAS the oracle, so sampling it would burn the audit
+            # interval/duty budget on a guaranteed-0 compare and bias
+            # the disagreement proxy toward 0 exactly while the device
+            # path is broken (r18 review). Only real device harvests
+            # (hold survives) are audit-eligible.
+            quality_audit.maybe_audit(self, traces, result)
 
     def _guarded_jax_many(self, traces: Sequence[Trace]):
         """Device dispatch under the watchdog (dispatch_timeout_s > 0).
@@ -528,10 +571,16 @@ class SegmentMatcher:
         The ``dispatch`` fault site fires here (inside the guarded body)
         so an injected hang stalls exactly where a dead tunnel would."""
         self._require_staged()
+        # quality-telemetry side channel: the harvest (possibly on the
+        # watchdog's daemon thread) drops its unmatched-point count into
+        # this caller-thread-owned holder — a thread-local written on
+        # the watchdog thread would never reach match_many
+        hold: dict = {}
+        self._quality_tl.unmatched_hold = hold
         timeout = float(self.params.dispatch_timeout_s)
         if timeout <= 0:
             faults.fire("dispatch")
-            return self._match_jax_many(traces)
+            return self._match_jax_many(traces, hold)
         if self._watchdog.tripped:
             # circuit open: enough abandoned dispatches are already stuck
             # on the dead link — degrade IMMEDIATELY rather than pin yet
@@ -551,8 +600,9 @@ class SegmentMatcher:
         # (recorded BEFORE the guarded body: a dispatch that hangs
         # forever still shows up in the post-mortem as the last thing
         # the matcher started)
-        out = self._watchdog.run(lambda: self._match_jax_many(traces),
-                                 timeout, fault_site="dispatch")
+        out = self._watchdog.run(
+            lambda: self._match_jax_many(traces, hold),
+            timeout, fault_site="dispatch")
         if out is not watchdog_mod.TIMED_OUT:
             return out
         self.metrics.count("dispatch_timeout")
@@ -570,6 +620,12 @@ class SegmentMatcher:
         """What a bounded dispatch becomes: the oracle (link-free) under
         dispatch_fallback='reference_cpu', else a retryable
         DispatchTimeout for the caller's held-row/isolation machinery."""
+        # drop the quality side channel: the ABANDONED harvest thread
+        # still holds the dict and may write its device-path unmatched
+        # count later — folding that into the fallback result's signals
+        # could trip a spurious quality_drift exactly when the link is
+        # degraded (r18 review)
+        self._quality_tl.unmatched_hold = None
         if self.params.dispatch_fallback == "reference_cpu":
             self.metrics.count("dispatch_fallback")
             fb = self._fallback_matcher()
@@ -593,6 +649,14 @@ class SegmentMatcher:
                 self._fallback = SegmentMatcher(
                     self.ts, _dc.replace(self.config,
                                          matcher_backend="reference_cpu"))
+                # oracle instances keep their quality telemetry OFF
+                # (r18 review): their signals would publish to a
+                # registry nothing scrapes, and their drift sentinel
+                # would consume the process 'quality' fault-site
+                # counter / dump budget from inside the degrade path —
+                # the OUTER matcher records this batch's signals either
+                # way
+                self._fallback.quality.enabled = False
         return self._fallback
 
     def matched_points(self, trace: Trace) -> list[MatchedPoint]:
@@ -816,6 +880,7 @@ class SegmentMatcher:
         return out
 
     def _match_jax_many(self, traces: Sequence[Trace],
+                        quality_hold: "dict | None" = None,
                         ) -> "Sequence[list[SegmentRecord]]":
         # Interleaved harvest + walk: np.asarray on the next slice blocks
         # on the LINK (remote-attached chip) with the GIL released, and the
@@ -831,6 +896,8 @@ class SegmentMatcher:
                 decoded = self._decode_many(traces)
             unmatched = sum(int((e < 0).sum()) for e, _, _ in decoded)
             self.metrics.count("unmatched_points", unmatched)
+            if quality_hold is not None:
+                quality_hold["unmatched"] = unmatched
             with self.metrics.stage("walk"):
                 return self._walk_decoded(traces, decoded)
 
@@ -862,6 +929,8 @@ class SegmentMatcher:
         with self.metrics.stage("walk"):
             _harvest_overlapped(inflight, walk_slice)
         self.metrics.count("unmatched_points", unmatched)
+        if quality_hold is not None:
+            quality_hold["unmatched"] = unmatched
         return MatchBatch(_merge_columns(slice_cols), len(traces))
 
     def _walk_decoded(self, traces: Sequence[Trace],

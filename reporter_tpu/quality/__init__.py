@@ -1,0 +1,22 @@
+"""Online match-quality telemetry (round 18).
+
+Three pieces, all host-side with zero wire or compiled-shape changes:
+
+  signals.py   per-batch quality signal extraction over the columnar
+               MatchBatch / SegmentRecord lists
+  monitor.py   per-metro windowed quality vectors, metric publication,
+               and the ``quality_drift`` sentinel (post-mortem on the
+               drift transition, the r9 fault-site discipline)
+  audit.py     deterministic sampled shadow-oracle audits against the
+               exact-Dijkstra reference — production ground truth,
+               cost counted and capped
+
+See README "Quality observability" for the signal inventory and what
+disagreement does and does not prove.
+"""
+
+from reporter_tpu.quality.signals import QualitySignals, extract
+from reporter_tpu.quality.monitor import QualityMonitor
+from reporter_tpu.quality.audit import ShadowAuditor
+
+__all__ = ["QualitySignals", "extract", "QualityMonitor", "ShadowAuditor"]

@@ -1,0 +1,371 @@
+"""Sampled shadow-oracle audits — production ground-truth estimation.
+
+The window/baseline sentinel (quality/monitor.py) watches PROXIES; this
+module measures the real thing, cheaply: a deterministic seeded sampler
+diverts a small fraction of served batches to the in-repo exact-Dijkstra
+oracle (``reference_cpu`` — the same oracle the bench's fidelity audits
+trust) on ONE bounded background thread, and counts segment-level
+disagreement as a production ``gt_edge`` proxy.
+
+Discipline (all r14/r15 contracts):
+
+  - the sampling DECISION is a counted seeded draw (the faults.py plan
+    discipline: schedule = pure function of (seed, call index), so a
+    test or a worker subprocess replays the exact audit schedule);
+  - the hot path pays one leaf-lock decision + a reference enqueue —
+    the oracle match runs on the auditor's own daemon thread, bounded
+    by the SHARED watchdog primitive (a wedged oracle is abandoned and
+    counted, never serialized into serving), and NEVER under a serving
+    lock;
+  - cost is COUNTED AND CAPPED, with ABSOLUTE bounds — a per-batch
+    probability alone scales with traffic (at serving batch cadence the
+    default rate turned into enough exact-Dijkstra work to saturate the
+    one-core host; r18 review): at most one audit per
+    ``min_interval_s`` of wall time, measured audit duty
+    (``audit_seconds_total / uptime``) above ``duty_pct_cap`` skips
+    further audits (counted, like the linkhealth probe-duty claim), and
+    the per-audit trace count is bounded;
+  - ONE process-global auditor (``auditor()`` / ``configure()`` — the
+    tracer()/faults.active()/linkhealth discipline): every metro's
+    matcher shares one audit thread and one duty budget. The leak gate
+    (analysis/global_state.py) watches the global: lazy None→X
+    construction is legal, a swapped-in fake that leaks is not.
+
+What disagreement proves: length-weighted segment-id divergence vs the
+exact oracle on short-edge tiles; on tiles with >256 m edges the
+long-segment pre-split makes ulp-level divergence legal and WAY-level
+agreement the contract (CLAUDE.md round 5) — treat elevated
+disagreement there as a prompt for the bench's oracle legs, not as a
+defect by itself.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import random
+import threading
+import time
+import zlib
+
+from reporter_tpu.utils import locks
+from reporter_tpu.utils.metrics import labeled
+from reporter_tpu.utils.watchdog import TIMED_OUT, AbandonedThreadWatchdog
+
+__all__ = ["ShadowAuditor", "auditor", "configure", "maybe_audit"]
+
+_ENV_RATE = "RTPU_QUALITY_AUDIT_RATE"
+_ENV_TRACES = "RTPU_QUALITY_AUDIT_TRACES"
+_ENV_TIMEOUT = "RTPU_QUALITY_AUDIT_TIMEOUT_S"
+_ENV_DUTY = "RTPU_QUALITY_AUDIT_DUTY_PCT"
+_ENV_INTERVAL = "RTPU_QUALITY_AUDIT_MIN_INTERVAL_S"
+_ENV_SEED = "RTPU_QUALITY_SEED"
+
+# default sampling rate: ~1 audited batch per 256 served. The rate alone
+# is NOT the cost bound — a per-batch probability scales with traffic
+# (the r18 review found the default rate turning into ~1.4 audits/s on
+# the serving face's batch cadence, saturating the one-core host with
+# oracle work) — so the auditor layers two ABSOLUTE bounds on top:
+# at most one audit per ``min_interval_s`` of wall time, and the
+# measured-duty cap.
+_DEFAULT_RATE = 1.0 / 256.0
+
+
+class _Job:
+    __slots__ = ("matcher", "traces", "result", "k")
+
+    def __init__(self, matcher, traces, result, k):
+        self.matcher = matcher
+        self.traces = traces
+        self.result = result
+        self.k = k
+
+
+class ShadowAuditor:
+    """Deterministic sampler + bounded background oracle worker."""
+
+    def __init__(self, rate: "float | None" = None,
+                 max_traces: "int | None" = None,
+                 timeout_s: "float | None" = None,
+                 duty_pct_cap: "float | None" = None,
+                 min_interval_s: "float | None" = None,
+                 seed: "int | None" = None,
+                 queue_cap: int = 4,
+                 clock=time.monotonic):
+        e = os.environ
+        self.rate = float(rate if rate is not None
+                          else e.get(_ENV_RATE, str(_DEFAULT_RATE)))
+        self.max_traces = int(max_traces if max_traces is not None
+                              else e.get(_ENV_TRACES, "2"))
+        self.timeout_s = float(timeout_s if timeout_s is not None
+                               else e.get(_ENV_TIMEOUT, "20"))
+        self.duty_pct_cap = float(duty_pct_cap if duty_pct_cap is not None
+                                  else e.get(_ENV_DUTY, "1.0"))
+        self.min_interval_s = float(
+            min_interval_s if min_interval_s is not None
+            else e.get(_ENV_INTERVAL, "60"))
+        seed = int(seed if seed is not None else e.get(_ENV_SEED, "0"))
+        # zlib.crc32 salt, not hash(): per-process string-hash
+        # randomization would break the replays-in-a-subprocess property
+        # the faults.py discipline exists for
+        self._rng = random.Random((seed << 8)
+                                  ^ (zlib.crc32(b"quality_audit")
+                                     & 0xFFFF))
+        self.clock = clock
+        self._lock = locks.named_lock("quality.audit")
+        self._queue: "collections.deque[_Job]" = collections.deque()
+        self._queue_cap = int(queue_cap)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._busy = False
+        self._watchdog = AbandonedThreadWatchdog(
+            cap=2, thread_name="quality-audit")
+        self._born = clock()
+        # stamped at BIRTH, not -inf: the first audit also waits out one
+        # interval — process startup (compile churn, first-wave
+        # latency) is the worst moment to hand the core to the oracle,
+        # and it is exactly where an unwarmed limiter always fired
+        self._last_enqueue = clock()
+        # counted outcomes (all under self._lock)
+        self.calls = 0
+        self.sampled = 0
+        self.skipped_budget = 0
+        self.skipped_interval = 0
+        self.skipped_queue = 0
+        self.audited_batches = 0
+        self.audited_traces = 0
+        self.audit_timeouts = 0
+        self.audit_seconds_total = 0.0
+        self.disagreement_sum = 0.0
+
+    # ---- hot-path surface ------------------------------------------------
+
+    def maybe_audit(self, matcher, traces, result) -> bool:
+        """One counted sampling decision (leaf lock, O(1)); a selected
+        batch snapshots (matcher, first ``max_traces`` traces, result)
+        and enqueues — materialization and the oracle both happen on
+        the worker thread. Returns whether the batch was enqueued."""
+        if self.rate <= 0.0 or not len(traces):
+            return False
+        # the breaker read takes the watchdog's own ledger lock — read
+        # it BEFORE the audit lock (advisory staleness is fine; nesting
+        # it would grow the lock graph for a boolean)
+        breaker_open = self._watchdog.tripped
+        with self._lock:
+            self.calls += 1
+            pick = self._rng.random() < self.rate
+            if not pick:
+                return False
+            now = self.clock()
+            if now - self._last_enqueue < self.min_interval_s:
+                # the ABSOLUTE frequency bound: a per-batch probability
+                # scales with traffic, and at serving batch cadence the
+                # default rate alone turned into enough oracle work to
+                # saturate the one-core host (r18 review) — at most one
+                # audit per interval, shed counted
+                self.skipped_interval += 1
+                return False
+            if self._duty_pct_locked() > self.duty_pct_cap:
+                self.skipped_budget += 1
+                return False
+            if len(self._queue) >= self._queue_cap or breaker_open:
+                # a full queue or a breaker-open watchdog (cap oracle
+                # threads already wedged) sheds the audit, counted —
+                # sampling must never become backpressure on serving
+                self.skipped_queue += 1
+                return False
+            k = min(self.max_traces, len(traces))
+            self._queue.append(_Job(matcher, list(traces[:k]), result, k))
+            self._last_enqueue = now
+            self.sampled += 1
+        self._ensure_worker()
+        self._wake.set()
+        return True
+
+    # ---- worker ----------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="quality-audit")
+            # started INSIDE the lock: two concurrent enqueues racing
+            # past an assign-then-start-outside would both call start()
+            # on the same Thread (RuntimeError on the serving hot path)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                job = self._queue.popleft() if self._queue else None
+                self._busy = job is not None
+            if job is None:
+                self._wake.wait(0.25)
+                self._wake.clear()
+                continue
+            try:
+                self._run_audit(job)
+            except Exception:
+                # an audit bug must never kill the worker (the oracle
+                # raising IS handled below; this is recorder-bug armor,
+                # the linkhealth loop discipline)
+                pass
+            finally:
+                with self._lock:
+                    self._busy = False
+
+    def _audit_oracle(self, matcher):
+        """The auditor's OWN reference_cpu oracle for this matcher —
+        deliberately NOT the serving degrade path's `_fallback_matcher`:
+        an audit holding `matcher.fallback` across a slow exact-Dijkstra
+        pass would serialize the dispatch-watchdog degradation behind
+        telemetry (the r18 review's finding — a wedged audit must never
+        stall serving through a shared lock). The instance is touched
+        only by the single worker thread, so its DijkstraCache needs no
+        lock; a watchdog-abandoned audit DROPS the instance (the
+        abandoned thread keeps its own reference) so the next audit can
+        never share the non-thread-safe cache with a zombie."""
+        fb = getattr(matcher, "_quality_audit_oracle", None)
+        if fb is None:
+            import dataclasses as _dc
+
+            from reporter_tpu.matcher.api import SegmentMatcher
+            fb = SegmentMatcher(
+                matcher.ts, _dc.replace(matcher.config,
+                                        matcher_backend="reference_cpu"))
+            # the oracle's OWN telemetry stays off (r18 review): its
+            # monitor would run a drift sentinel over 2-trace audit
+            # batches — publishing to a registry nothing scrapes,
+            # consuming the 'quality' fault-site counter from the audit
+            # thread, and able to burn the shared dump budget on
+            # sampling noise wearing the real metro's name
+            fb.quality.enabled = False
+            matcher._quality_audit_oracle = fb
+        return fb
+
+    def _run_audit(self, job: _Job) -> None:
+        """One audit: materialize the served records for the sampled
+        traces, run the exact oracle under the shared watchdog, count
+        length-weighted disagreement into the matcher's registry."""
+        from reporter_tpu.matcher.fidelity import mean_disagreement
+
+        served = [list(job.result[i]) for i in range(job.k)]
+        matcher = job.matcher
+        fb = self._audit_oracle(matcher)
+
+        def run():
+            return [list(r) for r in fb.match_many(job.traces)]
+
+        t0 = time.perf_counter()
+        out = self._watchdog.run(run, self.timeout_s)
+        dt = time.perf_counter() - t0
+        metro = matcher.ts.name
+        reg = matcher.metrics
+        if out is TIMED_OUT:
+            # the abandoned thread still owns fb's DijkstraCache — drop
+            # the reference so the next audit builds a fresh oracle
+            matcher._quality_audit_oracle = None
+            with self._lock:
+                self.audit_timeouts += 1
+                self.audit_seconds_total += dt
+            reg.count(labeled("quality_audit_timeouts", metro=metro))
+            return
+        dis = mean_disagreement(served, out)
+        with self._lock:
+            self.audited_batches += 1
+            self.audited_traces += job.k
+            self.audit_seconds_total += dt
+            self.disagreement_sum += dis
+        # registry writes OUTSIDE the auditor lock (leaf-lock contract)
+        reg.count(labeled("quality_audit_batches", metro=metro))
+        reg.count(labeled("quality_audit_traces", metro=metro), job.k)
+        reg.observe(labeled("quality_audit_disagreement", metro=metro),
+                    dis)
+        reg.observe(labeled("quality_audit_seconds", metro=metro), dt)
+
+    # ---- read side / lifecycle -------------------------------------------
+
+    def _duty_pct_locked(self) -> float:
+        up = max(self.clock() - self._born, 1e-6)
+        return 100.0 * self.audit_seconds_total / up
+
+    def duty_pct(self) -> float:
+        """Measured audit duty over the auditor's lifetime — the
+        recorded form of the 'cost counted and capped' claim."""
+        with self._lock:
+            return round(self._duty_pct_locked(), 4)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait for the queue to empty and the in-flight audit to land
+        (tests / the bench leg); True when drained inside the bound."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = not self._queue and not self._busy
+            if idle:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            batches = self.audited_batches
+            return {
+                "audit_rate": self.rate,
+                "audit_calls": self.calls,
+                "audited_batches": batches,
+                "audited_traces": self.audited_traces,
+                "audit_timeouts": self.audit_timeouts,
+                "audit_skips": (self.skipped_budget + self.skipped_queue
+                                + self.skipped_interval),
+                "audit_seconds": round(self.audit_seconds_total, 4),
+                "audit_duty_pct": round(self._duty_pct_locked(), 4),
+                "disagreement_rate": (
+                    None if not batches
+                    else round(self.disagreement_sum / batches, 4)),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Process-global auditor (the tracer()/faults.active()/linkhealth
+# discipline): one audit thread + one duty budget per process.
+
+_global: "ShadowAuditor | None" = None
+_global_lock = locks.named_lock("quality.registry")
+
+
+def auditor() -> ShadowAuditor:
+    """THE process auditor, constructed lazily from env."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = ShadowAuditor()
+        return _global
+
+
+def configure(a: "ShadowAuditor | None") -> None:
+    """Swap the process auditor (tests/bench install a configured
+    instance; None resets to lazy construction). Restore the previous
+    value in a finally — the leak gate fails an X→Y swap that outlives
+    its test."""
+    global _global
+    with _global_lock:
+        _global = a
+
+
+def maybe_audit(matcher, traces, result) -> bool:
+    """Module-level hook for the matcher's batch harvest: one decision
+    against the process auditor. jax-backend callers only (auditing the
+    oracle against itself is vacuous — the matcher gates this)."""
+    return auditor().maybe_audit(matcher, traces, result)

@@ -356,6 +356,10 @@ class ReporterApp:
         # link mood (round 15): the latest probe + measured duty, so a
         # degraded/dead tunnel is visible at the liveness face before
         # it shows up as dispatch timeouts
+        # match quality (round 18): the per-metro window + drift
+        # sentinel state, so "are we still matching well?" is answerable
+        # at the liveness face (full series at /stats and /metrics)
+        out["quality"] = self.matcher.quality.health()
         s = linkhealth.sampler() if linkhealth.enabled() else None
         last = s.latest() if s is not None else None
         out["link"] = {

@@ -1552,6 +1552,11 @@ class ColumnarStreamPipeline:
             "publish_retried": self.publisher.retried,
             "dead_lettered": self.publisher.dead_lettered,
             "dead_letter_pending": self.publisher.dead_letter_pending,
+            # online quality telemetry (round 18): every completed wave
+            # rode the matcher's per-metro quality window via
+            # match_many, so the worker's stats face carries the same
+            # windowed rates + drift state the service /health reports
+            "quality": self.matcher.quality.snapshot(),
             **self.stats_counters,
         }
         overload = getattr(self.queue, "overload_stats", None)

@@ -15,9 +15,12 @@ This module makes the decomposition a recorded artifact:
                   ``chrome://tracing``-loadable) on demand — and does so
                   AUTOMATICALLY at the round-9 fault sites (dispatch
                   watchdog timeout, circuit-breaker open, dead-letter
-                  spool, admission shed) so every one of those events
-                  leaves a post-mortem naming the failing span instead
-                  of firing blind.
+                  spool, admission shed) — joined by the r15 link_dead
+                  detection and the r18 quality_drift sentinel
+                  (quality/monitor.py), which dump through the same
+                  bounded post_mortem path — so every one of those
+                  events leaves a post-mortem naming the failing span
+                  instead of firing blind.
 
 One PROCESS-GLOBAL recorder (``tracer()``), mirroring faults.py: the
 fault sites live in the matcher/publisher/scheduler and must reach the

@@ -21,6 +21,16 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Disable the PROCESS-GLOBAL shadow auditor's default sampling for the
+# whole session (r18): at the default 1/256 rate the lazily-constructed
+# auditor starts firing real exact-oracle audits partway through a
+# multi-minute session — on background threads that interleave with
+# whatever fault plan / tracer state the CURRENT test installed
+# (observed: a mid-suite audit consuming another test's `quality` fault
+# rule). Tests that exercise auditing construct explicit ShadowAuditor
+# instances, whose constructor args override this env pin.
+os.environ["RTPU_QUALITY_AUDIT_RATE"] = "0"
+
 # Arm the lockdep runtime BEFORE any reporter_tpu module with locks is
 # imported (arming is creation-time: named_lock returns instrumented
 # wrappers only for locks created while armed). The whole tier-1 session

@@ -325,7 +325,8 @@ def test_summary_line_carries_prep_token():
 
 
 def test_summary_line_carries_lattr_token():
-    """lattr = [e2e p50 ms, stage-sum/e2e ratio, tracing overhead %]."""
+    """lattr = [e2e p50 WHOLE ms (r18 compaction), stage-sum/e2e ratio,
+    tracing overhead %]."""
     bench = _load_bench()
     doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
            "unit": "probes/s", "vs_baseline": 1.0,
@@ -336,7 +337,7 @@ def test_summary_line_carries_lattr_token():
                    "tracing_overhead_pct": 1.27},
            }}
     line = bench._summary_line(doc)
-    assert line["lattr"] == [2481.5, 1.0312, 1.27]
+    assert line["lattr"] == [2481, 1.0312, 1.27]
     empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
                                  "vs_baseline": 1.0, "detail": {}})
     assert empty["lattr"] == [None] * 3
@@ -398,6 +399,69 @@ def test_summary_line_carries_tune_token():
     assert empty["tune"] == [None] * 4
 
 
+QUALITY_PROBE_KEYS = (
+    "signals", "audit", "audit_overhead", "drift", "disagreement_rate",
+    "audited_batches", "audit_timeouts", "audit_seconds",
+    "drift_events", "window_waves",
+)
+
+QUALITY_VALIDATE_KEYS = QUALITY_PROBE_KEYS + (
+    "signals_recorded", "sampler_deterministic", "audit_ran",
+    "one_event_one_dump", "clean_twin_ok", "mechanism_ok",
+)
+
+QUALITY_OVERHEAD_KEYS = (
+    "off_pps", "on_pps", "audit_rate", "audit_s_per_batch",
+    "min_interval_s", "duty_pct_cap", "direct_overhead_pct",
+    "uncapped_overhead_pct", "audit_overhead_pct", "meets_2pct_bar",
+)
+
+
+def test_quality_leg_schema_keys():
+    """Pin detail.quality (round 18): the signal window, the shadow-
+    audit record, the overhead A/B (the <2% acceptance number), and the
+    CPU-validation mechanism bits must stay recorded fields on every
+    composite — extend, never drop."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._quality_probe)
+    for key in QUALITY_PROBE_KEYS:
+        assert f'"{key}"' in src, key
+    src_v = inspect.getsource(bench._quality_cpu_validate)
+    for key in QUALITY_VALIDATE_KEYS:
+        assert f'"{key}"' in src_v, key
+    src_o = inspect.getsource(bench._quality_overhead_ab)
+    for key in QUALITY_OVERHEAD_KEYS:
+        assert f'"{key}"' in src_o, key
+
+
+def test_summary_line_carries_qual_token():
+    """qual = [empty-match bp, violation bp, audit disagreement bp,
+    audit overhead %, drift events, mechanism bit (None on chip)]."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "quality": {
+                   "signals": {"empty_match_rate": 0.0123,
+                               "violation_rate": 0.002},
+                   "audit": {"disagreement_rate": 0.0077},
+                   "audit_overhead": {"audit_overhead_pct": 0.41},
+                   "drift": {"drift_events": 0},
+                   "mechanism_ok": True,
+               },
+           }}
+    line = bench._summary_line(doc)
+    assert line["qual"] == [123, 20, 77, 0.41, 0, 1]
+    # chip probes carry no mechanism bit — None, never vacuous green
+    del doc["detail"]["quality"]["mechanism_ok"]
+    assert bench._summary_line(doc)["qual"][-1] is None
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["qual"] == [None] * 6
+
+
 def test_fleet_leg_schema_keys():
     """Pin detail.fleet's occupancy/paging block (ISSUE 6): the
     capture's fleet story — metros served, mixed kpps, promotion
@@ -442,7 +506,7 @@ def test_summary_line_carries_fleet_token():
                },
            }}
     line = bench._summary_line(doc)
-    assert line["fleet"] == [8, 456, 42.51, 24, 20, 1]
+    assert line["fleet"] == [8, 456, 42, 24, 20, 1]   # p50 whole ms (r18)
     empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
                                  "vs_baseline": 1.0, "detail": {}})
     assert empty["fleet"] == [None] * 6

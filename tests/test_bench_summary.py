@@ -121,6 +121,30 @@ def _synthetic_doc():
             "candidates": {"subcull@128":
                            {"device_ms_per_dispatch": 138.113}},
         },
+        "quality": {
+            "signals": {"empty_match_rate": 0.0123,
+                        "breakage_rate": 0.0456,
+                        "discontinuity_rate": 0.1234,
+                        "violation_rate": 0.0123,
+                        "rejection_rate": 0.9123,
+                        "unmatched_point_rate": 0.1234,
+                        "window_waves": 12},
+            "audit": {"audited_batches": 12, "audited_traces": 24,
+                      "audit_timeouts": 0, "audit_seconds": 1.2345,
+                      "disagreement_rate": 0.0123},
+            "audit_overhead": {"off_pps": 2280000.1, "on_pps": 2270000.2,
+                               "audit_rate": 0.0039,
+                               "min_interval_s": 60.0,
+                               "duty_pct_cap": 1.0,
+                               "audited_batches": 1,
+                               "audit_s_per_batch": 0.1234,
+                               "direct_overhead_pct": 1.23,
+                               "uncapped_overhead_pct": 2.34,
+                               "audit_overhead_pct": 1.23,
+                               "meets_2pct_bar": True},
+            "drift": {"drift_events": 12},
+            "mechanism_ok": True,
+        },
         "link_health": {"rtt_ms": 1129.22, "mbps": 125.13,
                         "mood": "degraded", "samples": 123,
                         "probe_duty_pct": 0.4123},

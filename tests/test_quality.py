@@ -1,0 +1,503 @@
+"""Online match-quality telemetry (round 18, reporter_tpu/quality/).
+
+Covers the tentpole's contracts on CPU:
+
+  - signal extraction arithmetic on hand-built record columns, and
+    column/record-list form parity on real matcher output;
+  - monitor publication: per-metro labeled counters + rate histograms
+    land in the registry, /health and the streaming stats face carry
+    the window;
+  - the drift sentinel: baseline exceedance needs a warm window, an
+    injected ``quality`` fault rule fires deterministically, one drift
+    TRANSITION = one flight-recorder post-mortem (bounded by the shared
+    max_dumps budget), and a clean twin run dumps nothing;
+  - the shadow auditor: deterministic seeded schedule, a real
+    end-to-end audit against the exact oracle, counted shedding (duty
+    cap / queue / breaker), and the leak-gate contract for the
+    process-global auditor.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from reporter_tpu import faults
+from reporter_tpu.config import Config
+from reporter_tpu.matcher.api import SegmentMatcher, Trace
+from reporter_tpu.matcher.native_walk import RecordColumns
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.quality import audit as quality_audit
+from reporter_tpu.quality import signals as qsig
+from reporter_tpu.quality.monitor import (BASELINES, DEFAULT_BASELINE,
+                                          RATE_NAMES, QualityMonitor)
+from reporter_tpu.utils import tracing
+from reporter_tpu.utils.metrics import MetricsRegistry, labeled
+
+
+@pytest.fixture()
+def recorder():
+    """The process-global recorder, restored after each test (the
+    tests/test_tracing.py fixture shape)."""
+    tr = tracing.tracer()
+    prev = (tr.enabled, tr.dump_dir, tr.capacity, tr.max_dumps)
+    tr.clear()
+    yield tr
+    tr.configure(enabled=prev[0], dump_dir=prev[1], capacity=prev[2],
+                 max_dumps=prev[3])
+    tr.dumps_written = 0
+    tr.dumps_suppressed = 0
+    tr.clear()
+
+
+def _cols(rows):
+    """RecordColumns from (trace, seg, t0, t1, length, internal) rows."""
+    n = len(rows)
+    return RecordColumns(
+        np.array([r[0] for r in rows], np.int32),
+        np.array([r[1] for r in rows], np.int64),
+        np.array([r[2] for r in rows], np.float64),
+        np.array([r[3] for r in rows], np.float64),
+        np.array([r[4] for r in rows], np.float64),
+        np.zeros(n),
+        np.array([r[5] for r in rows], bool),
+        np.arange(n + 1, dtype=np.int64),
+        np.zeros(n, np.int64))
+
+
+# ---------------------------------------------------------------------------
+# signal extraction
+
+
+def test_signal_arithmetic_on_synthetic_columns():
+    # trace 0: two complete adjacent records, then a clean chain break
+    #          (gap, both flanks complete), then a speed violation
+    # trace 1: a partial mid-trace boundary (route discontinuity) and an
+    #          internal connector
+    # trace 2: no records at all (empty match)
+    rows = [
+        (0, 10, 0.0, 10.0, 100.0, False),
+        (0, 11, 10.0, 20.0, 100.0, False),     # adjacent: no break
+        (0, 12, 60.0, 70.0, 100.0, False),     # gap: HMM breakage
+        (0, 13, 70.0, 71.0, 500.0, False),     # 500 m/s: violation
+        (1, 20, 0.0, -1.0, 50.0, False),       # partial end mid-trace
+        (1, 21, 5.0, 9.0, 30.0, True),         # internal connector
+    ]
+    nonempty = np.ones(3, bool)
+    sig = qsig.signals_from_columns(_cols(rows), 3, 600, nonempty,
+                                    max_speed=60.0, unmatched=42)
+    assert sig.traces == 3 and sig.points == 600 and sig.records == 6
+    assert sig.empty_traces == 1              # trace 2 only
+    assert sig.pairs == 4
+    assert sig.breakages == 1                 # the 20->60 gap
+    assert sig.discontinuities == 1           # the partial boundary's
+    #                                           one same-trace pair
+    assert sig.speed_checked == 4
+    assert sig.speed_violations == 1
+    assert sig.rejected == 2                  # the partial + internal
+    assert sig.unmatched_points == 42
+
+
+def test_signal_zero_point_traces_not_counted_empty():
+    nonempty = np.array([False, True])
+    sig = qsig.signals_from_columns(_cols([]), 2, 0, nonempty)
+    assert sig.traces == 1 and sig.empty_traces == 1
+    assert sig.records == 0 and sig.pairs == 0
+
+
+def test_signals_merged_accumulates_counts():
+    a = qsig.QualitySignals(2, 100, 5, 1, 3, 1, 0, 4, 1, 2,
+                            unmatched_points=7)
+    b = qsig.QualitySignals(1, 50, 2, 0, 1, 0, 1, 1, 0, 1,
+                            unmatched_points=None)
+    m = a.merged(b)
+    assert m.traces == 3 and m.points == 150 and m.records == 7
+    assert m.breakages == 1 and m.discontinuities == 1
+    assert m.unmatched_points == 7
+
+
+def test_columns_and_record_lists_agree_on_matcher_output(tiny_tiles):
+    m = SegmentMatcher(tiny_tiles, Config(matcher_backend="jax"))
+    fleet = synthesize_fleet(tiny_tiles, 5, num_points=50, seed=3)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype(np.float32),
+                    times=p.times) for p in fleet]
+    batch = m.match_many(traces)
+    nonempty = np.ones(len(traces), bool)
+    points = sum(len(t.xy) for t in traces)
+    from_cols = qsig.signals_from_columns(batch.columns, len(traces),
+                                          points, nonempty)
+    from_recs = qsig.signals_from_records([list(r) for r in batch],
+                                          points, nonempty)
+    assert from_cols == from_recs
+
+
+# ---------------------------------------------------------------------------
+# monitor: publication + window + /health surfaces
+
+
+def test_monitor_publishes_labeled_series_and_window():
+    reg = MetricsRegistry()
+    mon = QualityMonitor("sf", reg, window=4, min_waves=2)
+    sig = qsig.QualitySignals(10, 1000, 20, 1, 15, 2, 3, 12, 1, 5,
+                              unmatched_points=30)
+    mon.record(sig)
+    snap = reg.snapshot()
+    assert snap[labeled("quality_batches", metro="sf")] == 1
+    assert snap[labeled("quality_traces", metro="sf")] == 10
+    assert snap[labeled("quality_breakages", metro="sf")] == 2
+    assert snap[labeled("quality_empty_match_rate_count",
+                        metro="sf")] == 1
+    agg = mon.window_rates()
+    assert agg["empty_match_rate"] == pytest.approx(0.1)
+    assert agg["breakage_rate"] == pytest.approx(2 / 15)
+    assert agg["unmatched_point_rate"] == pytest.approx(0.03)
+    h = mon.health()
+    assert h["enabled"] and h["window_waves"] == 1
+    assert set(RATE_NAMES) <= set(h)
+    # the exposition face renders the labeled histograms
+    assert "rtpu_quality_empty_match_rate_bucket" in \
+        reg.render_prometheus()
+
+
+def test_monitor_window_aggregate_is_count_weighted():
+    reg = MetricsRegistry()
+    mon = QualityMonitor("x", reg, window=8, min_waves=99)
+    mon.record(qsig.QualitySignals(1, 10, 1, 1, 0, 0, 0, 0, 0, 0))
+    mon.record(qsig.QualitySignals(99, 990, 99, 0, 0, 0, 0, 0, 0, 0))
+    # 1 empty trace of 100 total — NOT the mean of (1.0, 0.0)
+    assert mon.window_rates()["empty_match_rate"] == pytest.approx(0.01)
+
+
+def test_monitor_disabled_records_nothing(monkeypatch):
+    monkeypatch.setenv("RTPU_QUALITY", "0")
+    reg = MetricsRegistry()
+    mon = QualityMonitor("x", reg)
+    assert not mon.enabled
+    mon.record(qsig.QualitySignals(1, 10, 1, 1, 0, 0, 0, 0, 0, 0))
+    assert mon.waves == 0 and not reg.snapshot().get(
+        labeled("quality_batches", metro="x"))
+    with pytest.raises(ValueError):
+        monkeypatch.setenv("RTPU_QUALITY", "maybe")
+        QualityMonitor("x", reg)          # strict parse: typo raises
+
+
+def test_match_many_records_quality(tiny_tiles):
+    m = SegmentMatcher(tiny_tiles, Config(matcher_backend="jax"))
+    fleet = synthesize_fleet(tiny_tiles, 4, num_points=40, seed=5)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype(np.float32),
+                    times=p.times) for p in fleet]
+    m.match_many(traces)
+    snap = m.metrics.snapshot()
+    key = labeled("quality_batches", metro=tiny_tiles.name)
+    assert snap[key] == 1
+    # the jax harvest threads its unmatched count through to telemetry
+    assert labeled("quality_unmatched_points",
+                   metro=tiny_tiles.name) in snap
+    assert m.quality.health()["window_waves"] == 1
+
+
+# ---------------------------------------------------------------------------
+# drift sentinel
+
+
+def _sig_bad():
+    """A batch that exceeds every baseline ceiling."""
+    return qsig.QualitySignals(10, 100, 10, 9, 9, 9, 9, 10, 9, 10,
+                               unmatched_points=90)
+
+
+def _sig_good():
+    return qsig.QualitySignals(10, 100, 30, 0, 20, 0, 0, 20, 0, 2,
+                               unmatched_points=1)
+
+
+def test_drift_needs_warm_window_then_fires_once(recorder, tmp_path):
+    recorder.configure(enabled=True, capacity=256,
+                       dump_dir=str(tmp_path), max_dumps=8)
+    reg = MetricsRegistry()
+    mon = QualityMonitor("x", reg, window=8, min_waves=3)
+    mon.record(_sig_bad())
+    mon.record(_sig_bad())
+    assert mon.drift_events == 0          # cold window never cries wolf
+    mon.record(_sig_bad())                # warm: transition fires
+    mon.record(_sig_bad())                # STAYS drifted: no second dump
+    assert mon.drift_events == 1 and mon.drifted
+    assert reg.snapshot()[labeled("quality_drift_total", metro="x")] == 1
+    dumps = sorted(tmp_path.glob("flight_*_quality_drift.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["failing_span"] == "quality_window"
+    assert any(e["name"] == "quality_drift" for e in doc["traceEvents"])
+    # recovery re-arms the sentinel: a second collapse is a second event
+    for _ in range(8):
+        mon.record(_sig_good())
+    assert not mon.drifted
+    for _ in range(8):
+        mon.record(_sig_bad())
+    assert mon.drift_events == 2
+    assert len(sorted(tmp_path.glob("flight_*_quality_drift.json"))) == 2
+
+
+def test_injected_quality_fault_fires_drift_and_clean_twin(
+        recorder, tmp_path, tiny_tiles):
+    """The chaos acceptance (r10 pattern): a seeded plan drives the
+    quality_drift post-mortem deterministically through a REAL matcher
+    batch; the clean twin — same drive, no plan — dumps nothing."""
+    recorder.configure(enabled=True, capacity=512,
+                       dump_dir=str(tmp_path), max_dumps=8)
+    fleet = synthesize_fleet(tiny_tiles, 4, num_points=40, seed=7)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype(np.float32),
+                    times=p.times) for p in fleet]
+
+    def drive():
+        m = SegmentMatcher(tiny_tiles, Config(matcher_backend="jax"))
+        m.quality.min_waves = 99        # isolate the injected path
+        for _ in range(3):
+            m.match_many(traces)
+        return m
+
+    with faults.use(faults.FaultPlan.parse("quality:fail@1")):
+        m = drive()
+    assert m.quality.drift_events == 1
+    dumps = sorted(tmp_path.glob("flight_*_quality_drift.json"))
+    assert len(dumps) == 1              # one event, one dump
+    assert json.load(open(dumps[0]))["failing_span"] == "quality_window"
+    # clean twin: identical drive without a plan
+    m2 = drive()
+    assert m2.quality.drift_events == 0
+    assert len(sorted(tmp_path.glob("flight_*_quality_drift.json"))) == 1
+
+
+def test_drift_dumps_bounded_by_shared_budget(recorder, tmp_path):
+    recorder.configure(enabled=True, dump_dir=str(tmp_path), max_dumps=2)
+    reg = MetricsRegistry()
+    mon = QualityMonitor("x", reg, window=4, min_waves=1)
+    for k in range(5):                  # flap: drift, recover, drift...
+        mon.record(_sig_bad())
+        for _ in range(4):
+            mon.record(_sig_good())
+    assert mon.drift_events == 5
+    assert len(list(tmp_path.glob("flight_*_quality_drift.json"))) == 2
+    assert recorder.dumps_suppressed == 3
+
+
+def test_baselines_cover_rate_names():
+    for name, base in list(BASELINES.items()) + [("", DEFAULT_BASELINE)]:
+        assert set(base) == set(RATE_NAMES), name
+
+
+# ---------------------------------------------------------------------------
+# shadow auditor
+
+
+def test_sampler_schedule_is_seeded_and_deterministic():
+    picks = []
+    for _ in range(2):
+        a = quality_audit.ShadowAuditor(rate=0.3, seed=11,
+                                        duty_pct_cap=100.0)
+        rng_picks = [a._rng.random() < a.rate for _ in range(64)]
+        picks.append(rng_picks)
+        a.stop()
+    assert picks[0] == picks[1]
+    b = quality_audit.ShadowAuditor(rate=0.3, seed=12,
+                                    duty_pct_cap=100.0)
+    assert [b._rng.random() < b.rate for _ in range(64)] != picks[0]
+    b.stop()
+
+
+def test_auditor_end_to_end_counts_disagreement(tiny_tiles):
+    m = SegmentMatcher(tiny_tiles, Config(matcher_backend="jax"))
+    fleet = synthesize_fleet(tiny_tiles, 5, num_points=50, seed=9)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype(np.float32),
+                    times=p.times) for p in fleet]
+    out = m.match_many(traces)
+    a = quality_audit.ShadowAuditor(rate=1.0, max_traces=2,
+                                    timeout_s=60.0, duty_pct_cap=100.0,
+                                    min_interval_s=0.0)
+    try:
+        assert a.maybe_audit(m, traces, out)
+        assert a.drain(60.0)
+        st = a.stats()
+        assert st["audited_batches"] == 1 and st["audited_traces"] == 2
+        assert st["audit_timeouts"] == 0
+        assert 0.0 <= st["disagreement_rate"] <= 1.0
+        snap = m.metrics.snapshot()
+        metro = tiny_tiles.name
+        assert snap[labeled("quality_audit_batches", metro=metro)] == 1
+        assert labeled("quality_audit_disagreement_p50",
+                       metro=metro) in snap
+    finally:
+        a.stop()
+
+
+def test_auditor_duty_cap_and_queue_shed_are_counted(tiny_tiles):
+    m = SegmentMatcher(tiny_tiles, Config(matcher_backend="jax"))
+    fleet = synthesize_fleet(tiny_tiles, 2, num_points=30, seed=2)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype(np.float32),
+                    times=p.times) for p in fleet]
+    out = m.match_many(traces)
+    # duty cap 0: every selected batch sheds on budget, counted
+    a = quality_audit.ShadowAuditor(rate=1.0, duty_pct_cap=0.0,
+                                    min_interval_s=0.0)
+    a.audit_seconds_total = 1.0         # any nonzero spend > 0% cap
+    try:
+        assert not a.maybe_audit(m, traces, out)
+        assert a.stats()["audit_skips"] == 1
+        assert a.stats()["audited_batches"] == 0
+    finally:
+        a.stop()
+    # rate 0 short-circuits without counting a call
+    z = quality_audit.ShadowAuditor(rate=0.0)
+    assert not z.maybe_audit(m, traces, out)
+    assert z.stats()["audit_calls"] == 0
+    z.stop()
+    # the absolute frequency bound: selected batches shed until one
+    # interval has passed (including a warm-up interval after birth —
+    # startup is the worst time to hand the core to the oracle), and a
+    # second selection inside the interval sheds again, counted — the
+    # per-batch rate must never scale audit load with traffic (the r18
+    # serving-core lesson)
+    iv = quality_audit.ShadowAuditor(rate=1.0, duty_pct_cap=100.0,
+                                     min_interval_s=0.05)
+    try:
+        assert not iv.maybe_audit(m, traces, out)   # warm-up interval
+        time.sleep(0.06)
+        assert iv.maybe_audit(m, traces, out)
+        assert not iv.maybe_audit(m, traces, out)   # spacing
+        assert iv.skipped_interval == 2
+        assert iv.stats()["audit_skips"] == 2
+    finally:
+        iv.stop()
+
+
+def test_auditor_timeout_is_counted_not_fatal(tiny_tiles):
+    class SlowOracle:
+        def match_many(self, traces):
+            time.sleep(5.0)
+            return [[] for _ in traces]
+
+    class StubMatcher:
+        def __init__(self):
+            self.ts = tiny_tiles
+            self.metrics = MetricsRegistry()
+            # pre-seeded dedicated audit oracle (the r18 review moved
+            # audits OFF the serving fallback lock): the stub's sleep
+            # stands in for a wedged pure-compute oracle
+            self._quality_audit_oracle = SlowOracle()
+
+    stub = StubMatcher()
+    a = quality_audit.ShadowAuditor(rate=1.0, timeout_s=0.2,
+                                    duty_pct_cap=100.0,
+                                    min_interval_s=0.0)
+    try:
+        assert a.maybe_audit(stub, [object()], {0: []})
+        assert a.drain(30.0)
+        st = a.stats()
+        assert st["audit_timeouts"] == 1 and st["audited_batches"] == 0
+        assert stub.metrics.snapshot()[labeled(
+            "quality_audit_timeouts", metro=tiny_tiles.name)] == 1
+        # the abandoned thread owns the old oracle's cache: a timeout
+        # must drop the dedicated-instance reference
+        assert stub._quality_audit_oracle is None
+    finally:
+        a.stop()
+
+
+def test_oracle_instances_keep_quality_telemetry_off(tiny_tiles):
+    """r18 review: the watchdog-fallback oracle and the dedicated audit
+    oracle must not run their own monitors — invisible-registry
+    signals, a second consumer of the 'quality' fault-site counter, and
+    sentinel dumps wearing the real metro's name."""
+    m = SegmentMatcher(tiny_tiles, Config(matcher_backend="jax"))
+    assert m._fallback_matcher().quality.enabled is False
+    a = quality_audit.ShadowAuditor(rate=0.0)
+    try:
+        fb = a._audit_oracle(m)
+        assert fb.quality.enabled is False
+        assert fb is not m._fallback            # dedicated instance
+    finally:
+        a.stop()
+
+
+def test_degraded_batches_are_not_audited(tiny_tiles):
+    """r18 review: a watchdog-degraded batch WAS the oracle — sampling
+    it would burn the audit budget on a guaranteed-0 self-compare and
+    bias the disagreement proxy toward 0 while the device path is
+    broken."""
+    from reporter_tpu.config import MatcherParams
+
+    fleet = synthesize_fleet(tiny_tiles, 3, num_points=30, seed=13)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype(np.float32),
+                    times=p.times) for p in fleet]
+    # warm the shared wire executables first (watchdog knobs are
+    # stripped from wire params, so this matcher's compile serves the
+    # guarded one — the r10 warm-before-timeout discipline)
+    SegmentMatcher(tiny_tiles, Config(matcher_backend="jax")
+                   ).match_many(traces)
+    m = SegmentMatcher(tiny_tiles, Config(
+        matcher_backend="jax",
+        matcher=MatcherParams(dispatch_timeout_s=0.3,
+                              dispatch_fallback="reference_cpu")))
+    a = quality_audit.ShadowAuditor(rate=1.0, duty_pct_cap=100.0,
+                                    min_interval_s=0.0)
+    prev = quality_audit._global
+    quality_audit.configure(a)
+    try:
+        with faults.use(faults.FaultPlan.parse("dispatch:hang(1.2)@0")):
+            m.match_many(traces)                # degrades to the oracle
+        assert m.metrics.value("dispatch_timeout") == 1
+        assert a.stats()["audit_calls"] == 0    # gate: no decision taken
+        m.match_many(traces)                    # healthy device harvest
+        assert a.stats()["audit_calls"] == 1
+    finally:
+        quality_audit.configure(prev)
+        a.stop()
+
+
+def test_global_auditor_lazy_construction_and_leak_diff():
+    from reporter_tpu.analysis import global_state
+
+    pre = global_state.snapshot()
+    prev = quality_audit._global
+    try:
+        # None -> X (lazy construction) is legal
+        if prev is None:
+            a = quality_audit.auditor()
+            assert quality_audit.auditor() is a
+            assert not global_state.diff(pre, global_state.snapshot())
+        # X -> Y (a swapped fake left installed) must be named
+        fake = quality_audit.ShadowAuditor(rate=0.0)
+        base = global_state.snapshot()
+        quality_audit.configure(fake)
+        problems = global_state.diff(base, global_state.snapshot())
+        assert any("shadow auditor" in p for p in problems)
+        fake.stop()
+    finally:
+        quality_audit.configure(prev)
+
+
+# ---------------------------------------------------------------------------
+# serving-face surfaces
+
+
+def test_health_and_streaming_stats_carry_quality(tiny_tiles):
+    from reporter_tpu.service.app import make_app
+
+    app = make_app(tiny_tiles, Config(matcher_backend="jax"))
+    try:
+        q = app.health()["quality"]
+        assert q["enabled"] is True and "drift_events" in q
+        assert set(RATE_NAMES) <= set(q)
+    finally:
+        app.close()
+
+    from reporter_tpu.streaming.columnar import ColumnarStreamPipeline
+
+    pipe = ColumnarStreamPipeline(tiny_tiles, Config(
+        matcher_backend="jax"))
+    try:
+        sq = pipe.stats()["quality"]
+        assert "baseline" in sq and "drifted" in sq
+    finally:
+        pipe.close()
