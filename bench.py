@@ -1034,48 +1034,33 @@ def _rss_mb() -> "float | None":
 
 
 def _report_sink():
-    """Local datastore stand-in: counts every POSTed report row into a
-    multiset keyed by (id, next_id, t0, t1) so two runs' report streams
-    compare as multisets (duplicates vs losses). Returns (server, state);
-    callers shut the server down."""
-    import threading
-    from collections import Counter
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    """Local datastore stand-in — delegates to the package's ONE fake
+    datastore (distributed/supervisor.ReportSink, round 19) so the
+    report multiset key and the r9 duplicates-vs-losses accounting can
+    never fork between bench and the topology plane. Returns (server,
+    state) in the historical shape the chaos legs consume: ``state``
+    is a live mapping view over the sink (``reports`` multiset, row/
+    post counters, perf_counter first/last timestamps); callers shut
+    the server down via ``shutdown()``."""
+    from reporter_tpu.distributed import ReportSink
 
-    state = {"reports": Counter(), "posts": 0, "rows": 0,
-             "t_first": None, "t_last": None}
-    lock = threading.Lock()
+    sink = ReportSink()
 
-    class _H(BaseHTTPRequestHandler):
-        def do_POST(self):
-            n = int(self.headers.get("Content-Length") or 0)
-            try:
-                body = json.loads(self.rfile.read(n) or b"{}")
-            except json.JSONDecodeError:
-                body = {}
-            now = time.perf_counter()
-            with lock:
-                for r in body.get("reports", ()):
-                    key = (r.get("id"), r.get("next_id"),
-                           round(float(r.get("t0", 0.0)), 2),
-                           round(float(r.get("t1", 0.0)), 2))
-                    state["reports"][key] += 1
-                    state["rows"] += 1
-                state["posts"] += 1
-                if state["t_first"] is None:
-                    state["t_first"] = now
-                state["t_last"] = now
-            self.send_response(200)
-            self.send_header("Content-Length", "2")
-            self.end_headers()
-            self.wfile.write(b"{}")
+    class _State:
+        """Read-only dict-shaped view over the live sink."""
 
-        def log_message(self, *a):    # keep bench stdout clean
-            pass
+        def __getitem__(self, key):
+            if key == "reports":
+                return sink.reports
+            return sink.stats()[key]
 
-    srv = ThreadingHTTPServer(("127.0.0.1", 0), _H)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    return srv, state
+    class _Srv:
+        server_address = sink._server.server_address
+
+        def shutdown(self):
+            sink.close()
+
+    return _Srv(), _State()
 
 
 def _stage_durable_broker(ts, traces, n_stream: int, dirpath: str,
@@ -2604,6 +2589,7 @@ def _service_saturation_curve(apps: dict, ts, traces, levels=(16, 64, 256),
             _round(app, None, [], n)
         lats: dict = {a: [] for a in apps}
         walls: dict = {a: 0.0 for a in apps}
+        draw_walls: dict = {a: [] for a in apps}
         errors: dict = {a: [] for a in apps}
         before = {a: (app.stats["batches"],
                       app.scheduler.snapshot() if app.scheduler else None)
@@ -2612,13 +2598,21 @@ def _service_saturation_curve(apps: dict, ts, traces, levels=(16, 64, 256),
             for arm, app in apps.items():
                 t0 = time.perf_counter()
                 _round(app, lats[arm], errors[arm], n)
-                walls[arm] += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                walls[arm] += dt
+                draw_walls[arm].append(dt)
         for arm, app in apps.items():
             ls = sorted(lats[arm])
             batches0, snap0 = before[arm]
+            # per-draw req/s (round 19): the r18 capture note's
+            # "120-484 req/s across draws" bimodality class must be
+            # diagnosable FROM the capture — per-round rates make a
+            # bimodal arm visible without rediscovering it by rerunning
+            draws = [round(n / w, 1) for w in draw_walls[arm] if w > 0]
             sub = {
                 "req_per_sec": (round(len(ls) / walls[arm], 1)
                                 if ls and walls[arm] > 0 else None),
+                "round_rps": draws,
                 "p50_ms": (round(ls[len(ls) // 2] * 1e3, 1) if ls else None),
                 "p99_ms": (round(ls[min(len(ls) - 1,
                                         int(len(ls) * 0.99))] * 1e3, 1)
@@ -2984,6 +2978,302 @@ def _fleet_bench(tpu_ok: bool, n_metros: int = 8) -> dict:
     }
 
 
+def _topology_bench(tpu_ok: bool, timeout: float = 420.0) -> dict:
+    """detail.topology (round 19) — ROADMAP item 4 as a measured,
+    journaled artifact: a REAL supervised topology (1 supervisor × 2
+    ``streaming.__main__`` worker subprocesses over disjoint partition
+    pairs of one durable records broker + the supervisor's fake
+    datastore sink + its /metrics+/health WSGI face), soaked with a
+    mid-soak SIGKILL of worker-0. Recorded: the supervisor-observed
+    death → restart → recovery path, zero-lost accounting at offset
+    granularity across the replay, cross-worker aggregation FIDELITY
+    (merged exposition == per-leaf sums over the spooled member
+    snapshots, every counter and every histogram bucket), and one
+    stitched cross-pid Chrome trace (producer → broker dwell → worker
+    match, threaded by broker-propagated trace ids). Self-contained
+    (builds + saves its own tiny tile) and CPU-WORKERED on every
+    composite — the leg measures the topology plane, not the device, so
+    a chip composite must not donate its chip to two subprocesses'
+    startup compiles; ``aggregate.probes_per_sec_wall`` is one-core CPU
+    throughput by construction and the config says so."""
+    import shutil
+    import tempfile
+
+    from reporter_tpu.config import CompilerParams
+    from reporter_tpu.distributed import (Supervisor, aggregate, stitch,
+                                          worker_member)
+    from reporter_tpu.matcher.api import Trace
+    from reporter_tpu.netgen.synthetic import generate_city
+    from reporter_tpu.netgen.traces import synthesize_fleet
+    from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+    from reporter_tpu.tiles.compiler import compile_network
+    from reporter_tpu.utils import tracing
+
+    n_tr, n_pt, cycles, stamp_every = 12, 48, 3, 4
+    workdir = tempfile.mkdtemp(prefix="rtpu_topology_")
+    sup = None
+    try:
+        # ---- tile + fleet + staged records broker (producer side) ----
+        net = generate_city("tiny", nx=6, ny=6, seed=77)
+        net.name = "topo"
+        ts = compile_network(net, CompilerParams(reach_radius=500.0))
+        tiles_path = os.path.join(workdir, "topo_tiles.npz")
+        ts.save(tiles_path)
+        probes = synthesize_fleet(ts, n_tr, num_points=n_pt, seed=5)
+        traces = [Trace(uuid=f"v{j}", xy=p.xy, times=p.times)
+                  for j, p in enumerate(probes)]
+        batches, V, _ = _stage_round_batches(ts, traces, n_tr,
+                                             steps_per_batch=4)
+        broker_dir = os.path.join(workdir, "broker")
+        traces_dir = os.path.join(workdir, "traces")
+        q = DurableIngestQueue(broker_dir, 4)
+        # the producer's own flight-recorder ring (NOT the process
+        # tracer: bench's global recorder stays whatever the operator
+        # configured) — its ``produce`` spans carry the trace ids the
+        # workers will inherit from the stamped records
+        rec = tracing.FlightRecorder(capacity=8192).configure(enabled=True)
+        produced = stamped = 0
+        for c in range(cycles):
+            for b in batches:
+                tt = b.time + c * float(n_pt)
+                for i in range(b.n):
+                    r = {"uuid": str(b.uuid[i]), "lat": float(b.lat[i]),
+                         "lon": float(b.lon[i]), "time": float(tt[i])}
+                    if produced % stamp_every == 0:
+                        tid = f"{r['uuid']}@{produced}"
+                        tracing.stamp_record(r, tid)
+                        with rec.span("produce", trace_id=tid):
+                            q.append(r)
+                        stamped += 1
+                    else:
+                        q.append(r)
+                    produced += 1
+        end_offsets = [q.end_offset(p) for p in range(4)]
+        q.close()
+        rec.dump(path=os.path.join(traces_dir, "ring_producer.json"),
+                 reason="producer_done")
+
+        # ---- the topology ------------------------------------------
+        cfg_path = os.path.join(workdir, "worker_config.json")
+        with open(cfg_path, "w") as f:
+            json.dump({"streaming": {
+                "flush_min_points": 40,
+                # small polls: many steps per partition, so the SIGKILL
+                # lands with real lag outstanding (the r9 mid-stream
+                # discipline), not around one drain-everything poll
+                "poll_max_records": 120,
+                "hist_flush_interval": 0.0,
+                "flush_max_age": 1e6,
+            }}, f)
+        members = [
+            worker_member("worker-0", tiles_path, broker_dir, workdir,
+                          partitions=[0, 1], config=cfg_path),
+            worker_member("worker-1", tiles_path, broker_dir, workdir,
+                          partitions=[2, 3], config=cfg_path),
+        ]
+        sup = Supervisor(
+            members, workdir, restart=True, max_restarts=2, poll_s=0.05,
+            base_env={
+                # CPU-pinned workers on EVERY composite (see docstring)
+                "JAX_PLATFORMS": "cpu",
+                "RTPU_TRACE": "1", "RTPU_TRACE_DIR": traces_dir,
+                "RTPU_TOPO_SNAPSHOT_INTERVAL_S": "0.3",
+            })
+        t_soak0 = time.perf_counter()
+        sup.start()
+        http = sup.serve_http()
+        note = None
+
+        def _sink_rows() -> int:
+            return sup.sink.stats()["rows"]
+
+        # ---- mid-soak SIGKILL of worker-0 ---------------------------
+        t0 = time.perf_counter()
+        while _sink_rows() == 0:
+            if time.perf_counter() - t0 > timeout:
+                note = "no reports before kill deadline"
+                break
+            if sup.drained():
+                note = "topology drained before first sink read"
+                break
+            time.sleep(0.05)
+        killed_pid = sup.kill_member("worker-0")
+        t_kill = time.perf_counter()
+        t_kill_wall = time.time()
+        reports_at_kill = _sink_rows()
+        snap0 = sup.snapshots().get("worker-0") or {}
+        lag_at_kill = (snap0.get("stats") or {}).get("lag")
+
+        # supervisor-observed death + restart (the monitor thread's own
+        # detection — nothing here pre-acknowledges the kill)
+        detect_s = recovery_s = None
+        deaths_seen = 0
+        if killed_pid is not None:
+            while time.perf_counter() - t_kill < timeout:
+                deaths = [e for e in sup.events()
+                          if e["event"] == "member_death"
+                          and e.get("member") == "worker-0"]
+                if deaths:
+                    deaths_seen = len(deaths)
+                    # event timestamps are wall-clock: diff against the
+                    # wall time taken at the kill, same axis
+                    detect_s = round(max(0.0,
+                                         deaths[0]["t"] - t_kill_wall),
+                                     3)
+                    break
+                time.sleep(0.02)
+            # recovery = kill → the RESTARTED worker-0 spooling again
+            # (a new pid in its snapshot: matcher rebuilt, serving)
+            while time.perf_counter() - t_kill < timeout:
+                doc = sup.snapshots().get("worker-0")
+                if doc is not None and doc.get("pid") not in (None,
+                                                              killed_pid):
+                    recovery_s = round(time.perf_counter() - t_kill, 2)
+                    break
+                time.sleep(0.05)
+
+        # ---- drain to completion ------------------------------------
+        t0 = time.perf_counter()
+        while not sup.drained():
+            if time.perf_counter() - t0 > timeout:
+                note = (note or "") + " drain timed out"
+                break
+            time.sleep(0.1)
+        time.sleep(2 * sup.poll_s)
+        sup.poll_once()                  # reap the final exits
+        soak_wall = time.perf_counter() - t_soak0
+
+        # ---- observability face + aggregation fidelity --------------
+        import urllib.request
+        port = http.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=10) as resp:
+            health = json.loads(resp.read())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            exposition = resp.read().decode()
+        snaps = sup.snapshots()
+        merged = aggregate.merge_registry(snaps)
+        exports = {m: (doc.get("metrics") or {})
+                   for m, doc in snaps.items()}
+        fidelity_ok = True
+        counters_checked = buckets_checked = 0
+        want_counters: dict = {}
+        for exp in exports.values():
+            for k, v in (exp.get("counters") or {}).items():
+                want_counters[k] = want_counters.get(k, 0.0) + float(v)
+        for k, v in want_counters.items():
+            counters_checked += 1
+            if abs(merged._counters.get(k, 0.0) - v) > 1e-9:
+                fidelity_ok = False
+        want_hist: dict = {}
+        for exp in exports.values():
+            for k, buckets in (exp.get("hist") or {}).items():
+                w = want_hist.setdefault(k, [0] * len(buckets))
+                for i, c in enumerate(buckets):
+                    w[i] += int(c)
+        for k, w in want_hist.items():
+            for i, c in enumerate(w):
+                buckets_checked += 1
+                if merged._hist.get(k, [])[i:i + 1] != [c]:
+                    fidelity_ok = False
+
+        # ---- zero-lost accounting (offset granularity) --------------
+        reports_by_member = sup.exit_reports()
+        covered = [0] * 4
+        for rep in reports_by_member.values():
+            for p, off in enumerate((rep or {}).get("committed") or ()):
+                covered[p] = max(covered[p], int(off))
+        lost = sum(max(0, end_offsets[p] - covered[p]) for p in range(4))
+
+        sink = sup.sink.stats()
+        sup.stop()
+
+        # ---- stitch the cross-pid trace -----------------------------
+        dumps = {"producer": os.path.join(traces_dir,
+                                          "ring_producer.json")}
+        for name in ("worker-0", "worker-1"):
+            dumps[name] = os.path.join(traces_dir, f"ring_{name}.json")
+        stitched = stitch.stitch(
+            dumps, out_path=os.path.join(workdir, "topology_trace.json"))
+        st = stitched["stitched"]
+        stitch_ok = bool(st["processes"] >= 2
+                         and st["cross_pid_tracks"] >= 1)
+
+        events = sup.events()
+        event_counts: dict = {}
+        for e in events:
+            event_counts[e["event"]] = event_counts.get(e["event"], 0) + 1
+        exit_reports = {
+            name: (None if rep is None else {
+                "reports": rep.get("reports"), "lag": rep.get("lag"),
+                "traced_records": rep.get("traced_records"),
+                "link_mood": (rep.get("link") or {}).get("mood"),
+                "quality_drift_events": (rep.get("quality")
+                                         or {}).get("drift_events"),
+            }) for name, rep in reports_by_member.items()}
+        out = {
+            "config": (f"1 supervisor x 2 CPU worker subprocesses, "
+                       f"{produced} probes ({stamped} trace-stamped) "
+                       f"over a durable records broker, SIGKILL "
+                       f"worker-0 mid-soak, tile={ts.name}"),
+            "workers": 2,
+            "broker_probes": int(produced),
+            "stamped_records": int(stamped),
+            "soak": {
+                "wall_seconds": round(soak_wall, 2),
+                "probes_per_sec_wall": round(produced / soak_wall, 1),
+                "reports": int(sink["rows"]),
+                "posts": int(sink["posts"]),
+            },
+            "deaths": int(health.get("deaths_total", deaths_seen)),
+            "restarts": int(health.get("restarts_total", 0)),
+            "reports_at_kill": (None if reports_at_kill is None
+                                else int(reports_at_kill)),
+            "lag_at_kill": lag_at_kill,
+            "detect_seconds": detect_s,
+            "recovery_seconds": recovery_s,
+            "lost_records": int(lost),
+            "zero_lost_ok": bool(lost == 0),
+            "aggregation": {
+                "members": len(snaps),
+                "counters_checked": int(counters_checked),
+                "buckets_checked": int(buckets_checked),
+                "merged_series": len(merged._hist),
+                "fidelity_ok": bool(fidelity_ok and counters_checked),
+                "exposition_ok": bool(
+                    exposition.startswith("# TYPE")
+                    and "rtpu_topo_deaths" in exposition),
+            },
+            "health": {
+                "status": health.get("status"),
+                "deaths_total": health.get("deaths_total"),
+                "restarts_total": health.get("restarts_total"),
+            },
+            "event_counts": event_counts,
+            "exit_reports": exit_reports,
+            # the r19 worker-CLI satellite, asserted in the artifact:
+            # every member's exit JSON carried the link-health AND
+            # quality counter blocks
+            "worker_exit_reports_ok": all(
+                rep is not None and "link" in rep and "quality" in rep
+                for rep in reports_by_member.values()),
+            "stitch": {**st, "ok": stitch_ok},
+        }
+        if note:
+            out["note"] = note.strip()
+        return out
+    finally:
+        # teardown BEFORE the rmtree: an exception mid-soak must not
+        # leave two live worker subprocesses + the monitor thread (and
+        # its respawn logic) running over a deleted broker for the rest
+        # of the composite. stop() is idempotent — the normal path
+        # already stopped.
+        if sup is not None:
+            sup.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _provenance(tpu_ok: bool) -> dict:
     """Self-describing capture stamp (ISSUE-4 satellite): git sha + an
     optional round label, so a stale BENCH_DETAIL.json can never again
@@ -3064,13 +3354,16 @@ _ALL_LEGS = (
     "streaming", "streaming_capacity", "streaming_soak",
     "latency_attribution", "streaming_overload", "chaos",
     "device_compute", "sweep_ab", "autotune", "quality", "window2",
-    "prepare_bench", "fleet",
+    "prepare_bench", "fleet", "topology",
 )
-_SELF_CONTAINED_LEGS = {"fleet"}        # + sweep_ab / autotune /
+_SELF_CONTAINED_LEGS = {"fleet", "topology"}   # + sweep_ab / autotune /
 #                                         quality when no chip is in
 #                                         play (their *_cpu_validate
 #                                         stand-ins compile their own
-#                                         tiny tiles)
+#                                         tiny tiles); topology builds
+#                                         its own tile AND pins its
+#                                         worker subprocesses to CPU on
+#                                         every composite
 
 
 class BenchJournal:
@@ -3481,10 +3774,31 @@ def main() -> None:
         for _app in svc_apps.values():
             _app.close()        # drain schedulers; frees the executor
         top = curve[-1]
+
+        def _spread_pct(draws: "list | None") -> "float | None":
+            # (max-min)/max of the per-round rates — a one-number
+            # bimodality flag (≳50% = the r18 class; ≲15% = the normal
+            # same-mood jitter band)
+            if not draws or max(draws) <= 0:
+                return None
+            return round(100.0 * (max(draws) - min(draws)) / max(draws),
+                         1)
+
         ab = {
             "clients": top["clients"],
+            # client-THREAD count recorded explicitly (round 19): the
+            # closed loop runs one thread per client on however many
+            # cores the host has — 128 threads/core is the condition
+            # the per-draw spread below must be read against
+            "client_threads": top["clients"],
             "scheduler_rps": top["scheduler"]["req_per_sec"],
             "legacy_rps": top["legacy"]["req_per_sec"],
+            "scheduler_draw_rps": top["scheduler"].get("round_rps"),
+            "legacy_draw_rps": top["legacy"].get("round_rps"),
+            "scheduler_draw_spread_pct": _spread_pct(
+                top["scheduler"].get("round_rps")),
+            "legacy_draw_spread_pct": _spread_pct(
+                top["legacy"].get("round_rps")),
             "speedup": (round(top["scheduler"]["req_per_sec"]
                               / top["legacy"]["req_per_sec"], 3)
                         if top["scheduler"]["req_per_sec"]
@@ -4121,6 +4435,14 @@ def main() -> None:
     # in setup_seconds' sum
     split["fleet_residency_s"] = journal.seconds("fleet")
 
+    # -- topology observability plane (ISSUE 15): every composite;
+    # self-contained (builds its own tile, CPU-pinned worker
+    # subprocesses), so `--legs topology` fits a short window ----------
+    topo = journal.leg("topology", lambda: _topology_bench(tpu_ok))
+    if topo:
+        detail["topology"] = topo
+    split["topology_s"] = journal.seconds("topology")
+
     # -- link-health record (round 15): the whole run's window + the
     # measured probe duty (the <0.5% steady-state claim as a field) ------
     if link_enabled:
@@ -4222,6 +4544,27 @@ def _qual_token(_g) -> list:
             None if mech is None else int(bool(mech))]
 
 
+def _topo_token(_g) -> list:
+    """topo = [workers, aggregate probes/s over the soak wall (int —
+    CPU-pinned workers by construction, see _topology_bench), deaths,
+    restarts, recovery seconds (SIGKILL → the restarted worker spooling
+    snapshots again, 1 decimal), lost records across the replay (must
+    be 0), aggregation-fidelity bit (merged exposition == per-leaf sums
+    on every counter + histogram bucket), stitched-cross-pid bit]."""
+    pps = _g("topology", "soak", "probes_per_sec_wall")
+    rec_s = _g("topology", "recovery_seconds")
+    fid = _g("topology", "aggregation", "fidelity_ok")
+    stv = _g("topology", "stitch", "ok")
+    return [_g("topology", "workers"),
+            None if pps is None else int(pps),
+            _g("topology", "deaths"),
+            _g("topology", "restarts"),
+            None if rec_s is None else round(rec_s, 1),
+            _g("topology", "lost_records"),
+            None if fid is None else int(bool(fid)),
+            None if stv is None else int(bool(stv))]
+
+
 def _summary_line(doc: dict) -> dict:
     """Compact (<1 KB, CI-pinned by tests/test_bench_summary.py)
     machine-readable round summary: headline value, per-tile throughput,
@@ -4263,7 +4606,9 @@ def _summary_line(doc: dict) -> dict:
     summary = {
         "metric": doc["metric"],
         "value": doc["value"],
-        "unit": doc["unit"],
+        # "unit" dropped from the LINE (r19 compaction — the topo token
+        # needed the bytes): it is implied by the metric name and stays
+        # in the doc/detail file
         "vs_baseline": doc["vs_baseline"],
         "device": dev,
         "tiles_kpps": tiles_kpps,
@@ -4426,6 +4771,8 @@ def _summary_line(doc: dict) -> dict:
             _g("fleet", "occupancy", "promotions"),
             _g("fleet", "occupancy", "demotions"),
             None if fleet_bit is None else int(bool(fleet_bit))],
+        # round-19 topology token (see _topo_token)
+        "topo": _topo_token(_g),
         # round-15 link-health token: [rtt_ms, mbps, mood] — the run's
         # window; CPU composites record mood "cpu", never omit the token
         # (full record incl. measured probe duty in detail.link_health)

@@ -76,6 +76,10 @@ _LOWER_SUFFIXES = (
     "violation_rate", "rejection_rate", "unmatched_point_rate",
     "disagreement_rate", "overhead_pct", "audit_timeouts",
     "drift_events",
+    # r19 topology leg: supervisor detection latency and records the
+    # replay failed to cover are worse when UP (lost_records' healthy
+    # baseline is 0 — the zero-baseline rendering applies)
+    "detect_seconds", "lost_records",
 )
 # Whole subtrees that are bookkeeping, measurement conditions, or
 # self-referential analysis — pruned before any leaf is classified (one
@@ -93,6 +97,11 @@ _NEUTRAL_SUBTREES = frozenset({
     "occupancy",          # fleet paging bookkeeping (kpps carry the claims)
     "per_metro_kpps",     # leaf keys are metro NAMES; the mixed aggregate
     #                       kpps is the compared claim
+    "event_counts",       # r19 topology event-log tallies — leaf keys are
+    #                       EVENT NAMES; deaths/restarts/recovery carry
+    #                       the claims at the leg's top level
+    "exit_reports",       # r19 per-member exit echoes (leaf keys include
+    #                       member-local rates already claimed elsewhere)
 })
 # leaf keys that are workload/config/bookkeeping, never a perf claim —
 # matched exactly, skipped before the suffix rules run. THE explicit
@@ -157,6 +166,23 @@ _SKIP_KEYS = {
     "oracle_sample_traces", "total_traces", "trace_window", "wire_mode",
     "edges_vs_sf", "reach_rows_growth", "exact_tie_fraction",
     "lt_1cm_fraction", "lt_1m_fraction",
+    # topology leg (round 19): injected-fault tallies and measurement
+    # conditions — deaths/restarts are BY DESIGN 1/1 (the leg kills a
+    # worker on purpose; recovery/detect_seconds + lost_records carry
+    # the compared claims), kill-time state is a condition, aggregation/
+    # stitch population counts are bookkeeping (their _ok bits gate)
+    "deaths", "restarts", "deaths_total", "restarts_total",
+    "reports_at_kill", "lag_at_kill", "stamped_records", "broker_probes",
+    "counters_checked", "buckets_checked", "merged_series", "members",
+    "processes", "unsynced_processes", "events", "traced_ids",
+    "cross_pid_tracks", "posts",
+    # service-leg per-draw spread (round 19): the per-round rates and
+    # their spread DIAGNOSE the one-core closed loop's bimodality (r18
+    # capture note) — run-over-run comparison of individual draws is
+    # exactly the noise the best-of discipline exists to absorb
+    "round_rps", "scheduler_draw_rps", "legacy_draw_rps",
+    "scheduler_draw_spread_pct", "legacy_draw_spread_pct",
+    "client_threads",
 }
 
 # every throughput/latency number measured THROUGH the remote link is

@@ -137,6 +137,15 @@ LOCK_ORDER_EDGES: "dict[tuple[str, str], str]" = {
     # so no matcher.fallback -> quality/faults/tracer nesting exists;
     # the shadow audit also runs a DEDICATED oracle instance and never
     # takes matcher.fallback at all)
+    # ---- topology supervisor (round 19) ----------------------------------
+    # supervisor.members / supervisor.events / supervisor.sink are LEAF
+    # locks BY CONSTRUCTION (distributed/supervisor.py docstring):
+    # spawning (subprocess.Popen is a patched blocking entry point),
+    # post-mortems, gauge publication, and snapshot merging all run
+    # outside them, so the topology layer contributes zero order edges
+    # and zero blocking-allow entries. A future edge from any of them
+    # is a design change — justify it here with a date, don't just add
+    # it.
     # ---- streaming brokers ----------------------------------------------
     ("broker.partitions", "faults.plan"): "2026-08-04 durable append "
         "consults the broker fault site inside the partition lock so an "

@@ -21,6 +21,17 @@ itself a finding. Rules:
   env-table       every RTPU_*/REPORTER_* env read must have a row in
                   README's consolidated env table, and every table row
                   must correspond to a real read (drift both ways).
+  metric-inventory  every metric name LITERALLY registered through a
+                  utils.metrics registry (count/gauge/observe/stage
+                  first-arg string, incl. through ``labeled(...)``)
+                  must appear in README's marker-delimited metric
+                  inventory, and every inventory token must name a real
+                  registration (drift both ways — the env-table pattern
+                  applied to the round-19 aggregation plane, where an
+                  undocumented series silently changes the fleet
+                  exposition's shape). Dynamically-composed names
+                  (``"quality_" + rate``) are out of scope by
+                  construction and documented in prose, not the block.
   lock-blocking   no known-blocking call (sleep, urlopen, fsync,
                   subprocess, device_put, block_until_ready, foreign
                   ``.wait``) lexically inside a ``with <lock>:`` body.
@@ -579,6 +590,124 @@ def _rule_dead_private(mods: "list[_Module]",
 
 
 # ---------------------------------------------------------------------------
+# cross-file rule: metric-inventory (round 19 — the env-table pattern
+# applied to the metric namespace the aggregation plane merges)
+
+_INVENTORY_BEGIN = "<!-- metric-inventory:begin -->"
+_INVENTORY_END = "<!-- metric-inventory:end -->"
+_METRIC_TOKEN = re.compile(r"`([a-z][a-z0-9_]*)`")
+# names the registry itself derives/registers (not literal call sites);
+# documented rows for these are legal without a registration
+_REGISTRY_INTRINSIC = {"uptime_seconds", "probes_per_sec_busy"}
+_METRIC_RECEIVER = re.compile(r"(^(m|reg|registry)$|metrics$|registry$)")
+
+
+def _metric_registrations(mod: _Module) -> "dict[str, tuple[str, int]]":
+    """name → (path, line) for every metric name LITERALLY registered in
+    this module: the first string argument of a
+    ``<registry>.count/gauge/observe/stage(...)`` call (receiver must
+    smell like a metrics registry) or of any ``labeled(...)`` call.
+    ``stage`` registers ``<name>_seconds`` (StageTimer's derived
+    series). utils/metrics.py itself is excluded — its docstring
+    examples and generic machinery are not registrations."""
+    out: "dict[str, tuple[str, int]]" = {}
+    if mod.path.replace(os.sep, "/").endswith(
+            "reporter_tpu/utils/metrics.py"):
+        return out
+
+    def is_labeled(f: "ast.AST") -> bool:
+        # both spellings: bare `labeled(...)` and the qualified
+        # `metrics.labeled(...)` CLAUDE.md's own convention note uses
+        return ((isinstance(f, ast.Name) and f.id == "labeled")
+                or (isinstance(f, ast.Attribute) and f.attr == "labeled"))
+
+    def lit(node: "ast.AST") -> "str | None":
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        # labeled("name", ...) wraps the literal: unwrap one level
+        if isinstance(node, ast.Call) and is_labeled(node.func) \
+                and node.args:
+            return lit(node.args[0])
+        return None
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if is_labeled(f):
+            name = lit(node.args[0])
+            if name:
+                out.setdefault(name, (mod.path, node.lineno))
+        elif isinstance(f, ast.Attribute) \
+                and f.attr in ("count", "gauge", "observe", "stage") \
+                and _METRIC_RECEIVER.search(ast.unparse(f.value)):
+            name = lit(node.args[0])
+            if name:
+                if f.attr == "stage":
+                    name += "_seconds"
+                out.setdefault(name, (mod.path, node.lineno))
+    return out
+
+
+def _inventory_tokens(readme_lines: "list[str]",
+                      ) -> "tuple[dict[str, int], bool]":
+    """(token → first line) inside the marker-delimited inventory block,
+    plus whether the markers were found at all (absent markers are a
+    finding — the contract must not pass vacuously)."""
+    documented: "dict[str, int]" = {}
+    inside = found = False
+    for i, ln in enumerate(readme_lines, 1):
+        if _INVENTORY_BEGIN in ln:
+            inside = found = True
+            continue
+        if _INVENTORY_END in ln:
+            inside = False
+            continue
+        if inside:
+            for tok in _METRIC_TOKEN.findall(ln):
+                documented.setdefault(tok, i)
+    return documented, found
+
+
+def _rule_metric_inventory(mods: "list[_Module]",
+                           readme_path: str) -> "list[Finding]":
+    out: "list[Finding]" = []
+    registered: "dict[str, tuple[str, int]]" = {}
+    for mod in mods:
+        for name, where in _metric_registrations(mod).items():
+            registered.setdefault(name, where)
+    try:
+        with open(readme_path) as f:
+            readme = f.readlines()
+    except OSError:
+        return [Finding("metric-inventory", "README.md", 1,
+                        "README.md not found — the metric inventory is "
+                        "the documentation contract")]
+    documented, found = _inventory_tokens(readme)
+    if not found:
+        return [Finding(
+            "metric-inventory", "README.md", 1,
+            f"no {_INVENTORY_BEGIN} … {_INVENTORY_END} block in README "
+            "— the metric inventory contract has nothing to check "
+            "against (the gate must not pass vacuously)")]
+    for name, (path, line) in sorted(registered.items()):
+        if name not in documented:
+            out.append(Finding(
+                "metric-inventory", path, line,
+                f"metric {name!r} is registered here but has no row in "
+                "README's metric inventory block — an undocumented "
+                "series changes the fleet exposition's shape silently"))
+    for name, line in sorted(documented.items()):
+        if name not in registered and name not in _REGISTRY_INTRINSIC:
+            out.append(Finding(
+                "metric-inventory", "README.md", line,
+                f"README metric inventory documents {name!r} but "
+                "nothing in the lint targets registers it — dead row "
+                "(or the registration stopped being a literal)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # cross-file rule: env-table
 
 def _rule_env_table(mods: "list[_Module]",
@@ -714,6 +843,14 @@ def run_lint(root: str = REPO_ROOT,
             if m is not None:
                 _apply_waivers(m, [f])
         out.extend(table)
+    if rules is None or "metric-inventory" in rules:
+        inv = _rule_metric_inventory(mods,
+                                     os.path.join(root, "README.md"))
+        for f in inv:
+            m = by_path.get(f.path)
+            if m is not None:
+                _apply_waivers(m, [f])
+        out.extend(inv)
     if rules is None or "bench-coverage" in rules:
         from reporter_tpu.analysis.bench_delta import coverage_findings
 

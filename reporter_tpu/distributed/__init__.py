@@ -1,0 +1,12 @@
+"""Topology observability plane (round 19): the supervised
+multi-process topology (supervisor.py), cross-worker metrics
+aggregation over atomically spooled snapshots (aggregate.py +
+utils/metrics.merge_exports), and cross-pid trace stitching
+(stitch.py). See DISTRIBUTED.md "Topology observability" for the
+measured artifact."""
+
+from reporter_tpu.distributed.supervisor import (MemberSpec, ReportSink,
+                                                 Supervisor,
+                                                 worker_member)
+
+__all__ = ["MemberSpec", "ReportSink", "Supervisor", "worker_member"]

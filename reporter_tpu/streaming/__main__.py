@@ -62,7 +62,28 @@ def main(argv: "list[str] | None" = None) -> int:
                          "columnar throughput additionally needs a batch "
                          "broker — over the durable dict log, polls pay a "
                          "per-record packing shim")
+    # topology observability (round 19): spool an atomic metrics/health
+    # snapshot the supervisor tails (distributed/aggregate.py). Env
+    # twins RTPU_TOPO_* let the supervisor configure spawned workers
+    # without rebuilding their command lines; explicit flags win.
+    ap.add_argument("--snapshot-dir",
+                    default=os.environ.get("RTPU_TOPO_SNAPSHOT_DIR")
+                    or None,
+                    help="spool per-worker metrics snapshots here "
+                         "(atomic tmp+fsync+rename; env twin "
+                         "RTPU_TOPO_SNAPSHOT_DIR)")
+    ap.add_argument("--snapshot-interval", type=float,
+                    default=float(os.environ.get(
+                        "RTPU_TOPO_SNAPSHOT_INTERVAL_S") or 1.0),
+                    help="seconds between snapshot spools (env twin "
+                         "RTPU_TOPO_SNAPSHOT_INTERVAL_S; default 1)")
+    ap.add_argument("--member",
+                    default=os.environ.get("RTPU_TOPO_MEMBER") or None,
+                    help="this worker's topology member name (snapshot "
+                         "file + trace-dump naming; env twin "
+                         "RTPU_TOPO_MEMBER; default worker-<pid>)")
     args = ap.parse_args(argv)
+    member = args.member or f"worker-{os.getpid()}"
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
@@ -141,8 +162,28 @@ def main(argv: "list[str] | None" = None) -> int:
     signal.signal(signal.SIGINT, _handle)
     signal.signal(signal.SIGTERM, _handle)
 
-    reports = steps = 0
-    last_ckpt = time.monotonic()
+    # the worker's matcher registry is the one every layer feeds — the
+    # snapshot spool exports IT, so the supervisor's merge sees the same
+    # series /stats and /metrics would serve in-process
+    matcher = getattr(pipe, "matcher", None) or pipe.app.matcher
+
+    def _spool_snapshot(seq: int, st: dict) -> None:
+        from reporter_tpu.distributed import aggregate
+
+        try:
+            aggregate.write_snapshot(
+                aggregate.snapshot_path(args.snapshot_dir, member),
+                matcher.metrics, member, seq=seq,
+                stats={k: st.get(k) for k in
+                       ("lag", "published", "malformed", "reports",
+                        "dispatch_timeouts", "dead_letter_pending")})
+        except OSError as exc:
+            # a full/unwritable spool disk must degrade observability,
+            # never take the matcher down with it
+            log.warning("snapshot spool failed: %s", exc)
+
+    reports = steps = snap_seq = 0
+    last_ckpt = last_snap = time.monotonic()
     stall, prev_lag = 0, None
     try:
         while not stop["now"]:
@@ -155,6 +196,11 @@ def main(argv: "list[str] | None" = None) -> int:
             if args.max_steps is not None and steps >= args.max_steps:
                 break
             st = pipe.stats()
+            if args.snapshot_dir and (time.monotonic() - last_snap
+                                      >= args.snapshot_interval):
+                snap_seq += 1
+                _spool_snapshot(snap_seq, st)
+                last_snap = time.monotonic()
             if args.exit_on_drain:
                 # drained = lag 0, OR lag pinned by a sub-threshold
                 # buffered tail with nothing in flight (the commit floor
@@ -185,18 +231,57 @@ def main(argv: "list[str] | None" = None) -> int:
             pipe.publisher.replay_dead_letters()
         if args.checkpoint:
             pipe.checkpoint(args.checkpoint)
+        if args.snapshot_dir:
+            # final spool AFTER the drain: the supervisor's last view of
+            # this worker covers everything it ever published
+            _spool_snapshot(snap_seq + 1, pipe.stats())
         close = getattr(pipe, "close", None)
         if close is not None:       # pipelined worker: stop the executor
             close()                 # + publisher threads
         queue.close()
+        from reporter_tpu.utils import tracing
+
+        tr = tracing.tracer()
+        if tr.enabled and tr.dump_dir:
+            # per-process ring dump for distributed/stitch.py (named by
+            # member so the stitcher can label the track); a dump
+            # failure must not cost the worker its exit report
+            try:
+                tr.dump(path=os.path.join(tr.dump_dir,
+                                          f"ring_{member}.json"),
+                        reason="worker_exit")
+            except OSError as exc:
+                log.warning("exit trace dump failed: %s", exc)
+    st = pipe.stats()
+    # link-health counters (r15 layer) + quality counters (r18 layer):
+    # both run in-process all along — the exit report is where a
+    # supervisor reads them after the worker is gone
+    from reporter_tpu.utils import linkhealth
+
+    if linkhealth.enabled():
+        s = linkhealth.sampler()
+        link = {**s.window(), "probes": int(s.probes_total),
+                "dead_probes": int(s.dead_probes_total)}
+    else:
+        link = {"rtt_ms": None, "mbps": None, "mood": None,
+                "samples": 0, "probes": 0, "dead_probes": 0}
+    qh = matcher.quality.health()
+    quality = {k: qh.get(k) for k in
+               ("enabled", "window_waves", "drifted", "drift_events",
+                "empty_match_rate", "breakage_rate",
+                "discontinuity_rate", "violation_rate",
+                "rejection_rate", "unmatched_point_rate")}
     print(json.dumps({"steps": steps, "reports": reports,
                       "committed": list(pipe.committed),
-                      **{k: v for k, v in pipe.stats().items()
+                      "member": member,
+                      "link": link, "quality": quality,
+                      **{k: v for k, v in st.items()
                          if k in ("lag", "published", "malformed",
                                   "hist_rows", "qhist_rows",
                                   "buffered_points", "publish_retried",
                                   "dead_lettered", "dead_letter_pending",
-                                  "dispatch_timeouts")}}))
+                                  "dispatch_timeouts",
+                                  "traced_records")}}))
     return 0
 
 

@@ -45,6 +45,21 @@ Commit state intentionally lives in StreamPipeline (its commit floor is
 the oldest *unflushed* record, a property of the matcher's buffers, not of
 the broker); an adapter that mirrors commits to the broker's consumer
 group can read ``pipeline.committed`` after each step.
+
+Trace metadata (round 19, optional): a producer may stamp a record with
+``tracing.stamp_record(record, trace_id)`` — one extra dict key
+(``tracing.TRACE_KEY``) carrying ``{"id", "ts"}`` — before appending.
+Record-format brokers store dicts verbatim, so the metadata rides the
+log untouched; format-pinned directories stay compatible in BOTH
+directions because an absent key reads as "untraced" and an unknown key
+is ignored by every validator (the Kafka-headers analog: metadata
+beside the payload, never inside it). Consumers that recognize the key
+tag their spans with the inherited id (StreamPipeline), which is what
+lets distributed/stitch.py merge producer and worker flight-recorder
+dumps into one causal per-probe track across pids. The columnar broker
+stores five fixed columns and deliberately does NOT carry the key —
+trace stitching is a record-broker affordance; a columnar topology
+still aggregates metrics and events, just without per-probe flows.
 """
 
 from __future__ import annotations

@@ -29,15 +29,23 @@ from reporter_tpu.streaming.broker import ProbeConsumer
 from reporter_tpu.streaming.histogram import SpeedHistogram
 from reporter_tpu.streaming.queue import IngestQueue
 from reporter_tpu.tiles.tileset import TileSet
+from reporter_tpu.utils import tracing
+
+# inherited trace ids recorded per span are BOUNDED: a wave may cover
+# thousands of probes, and span args must stay a small payload (the
+# traced count rides alongside so truncation is visible)
+_TRACE_IDS_PER_SPAN = 8
 
 
 class _Buffer:
-    __slots__ = ("points", "first_offset", "born")
+    __slots__ = ("points", "first_offset", "born", "trace_ids", "traced")
 
     def __init__(self, born: float):
         self.points: list[dict] = []
         self.first_offset: "tuple[int, int] | None" = None  # (partition, offset)
         self.born = born
+        self.trace_ids: list[str] = []   # inherited broker trace ids
+        self.traced = 0                  # (bounded list + full count)
 
 
 class StreamPipeline:
@@ -99,6 +107,12 @@ class StreamPipeline:
         self.steps = 0
         self.malformed = 0
         self.overrun = 0    # records lost to broker drop-oldest shed
+        # broker-propagated trace stitching (round 19): spans this
+        # worker records carry the trace ids inherited from producer-
+        # stamped records, so distributed/stitch.py can thread a
+        # probe's producer→worker path across pids
+        self._tracer = tracing.tracer()
+        self.traced_records = 0
 
     @property
     def publisher(self):
@@ -116,13 +130,14 @@ class StreamPipeline:
         from reporter_tpu.streaming.state import poll_with_overrun_skip
 
         sc = self.config.streaming
-        for p in self.partitions:
-            pairs = poll_with_overrun_skip(
-                self, lambda pp, off, n: self.queue.poll(pp, off, n),
-                p, sc.poll_max_records)
-            for off, rec in pairs:
-                self._consume(p, off, rec)
-                self._consumed[p] = off + 1
+        with self._tracer.span("consume"):
+            for p in self.partitions:
+                pairs = poll_with_overrun_skip(
+                    self, lambda pp, off, n: self.queue.poll(pp, off, n),
+                    p, sc.poll_max_records)
+                for off, rec in pairs:
+                    self._consume(p, off, rec)
+                    self._consumed[p] = off + 1
 
         now = self.clock()
         ripe = [u for u, b in self._buffers.items()
@@ -166,6 +181,12 @@ class StreamPipeline:
             buf = self._buffers[uuid] = _Buffer(self.clock())
         if buf.first_offset is None:
             buf.first_offset = (p, off)
+        tid = tracing.trace_id_of(rec)
+        if tid is not None:
+            self.traced_records += 1
+            buf.traced += 1
+            if len(buf.trace_ids) < _TRACE_IDS_PER_SPAN:
+                buf.trace_ids.append(tid)
         if t is None:
             # Timeless producer: index seconds per trace, matching the HTTP
             # path's convention (app._validate_payload), not the partition
@@ -188,10 +209,26 @@ class StreamPipeline:
     def _flush(self, uuids: list[str]) -> int:
         payloads = [{"uuid": u, "trace": self._buffers[u].points}
                     for u in uuids]
+        # inherited trace context (bounded) gathered BEFORE the buffers
+        # are dropped — the worker_match span below is the event
+        # stitch.py threads into the producer's causal track
+        span_args: dict = {}
+        if self._tracer.enabled:
+            ids: list = []
+            traced = 0
+            for u in uuids:
+                b = self._buffers[u]
+                traced += b.traced
+                if len(ids) < _TRACE_IDS_PER_SPAN:
+                    ids.extend(b.trace_ids[:_TRACE_IDS_PER_SPAN
+                                           - len(ids)])
+            if traced:
+                span_args = {"trace_ids": ids, "traced": traced}
         # Match BEFORE dropping buffers: if the matcher or publisher raises,
         # the points stay buffered and keep holding the commit floor down —
         # a supervisor retrying step() re-flushes instead of losing them.
-        results = self.app.report_many(payloads)
+        with self._tracer.span("worker_match", **span_args):
+            results = self.app.report_many(payloads)
         for u in uuids:
             self._buffers.pop(u, None)
         n = 0
@@ -246,6 +283,7 @@ class StreamPipeline:
             "hist_rows": int(len(self.hist.nonzero_rows())),
             "qhist_rows": int(len(self.qhist.nonzero_rows())),
             "overrun": int(self.overrun),
+            "traced_records": int(self.traced_records),
             **self.app.stats,
         }
         overload = getattr(self.queue, "overload_stats", None)

@@ -33,6 +33,10 @@ HISTOGRAM_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 _PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_VALUE = re.compile(r"[\"\\\n]")
 
+# version tag on MetricsRegistry.export() documents (the snapshot files
+# the topology supervisor tails) — bump when the merge semantics change
+EXPORT_SCHEMA = 1
+
 
 def labeled(name: str, **labels) -> str:
     """Canonical label-suffixed series key: ``labeled("fleet_hits",
@@ -67,6 +71,23 @@ def _with_suffix(key: str, suffix: str) -> str:
     rather than a name with trailing braces in the middle."""
     base, lab = _split_labels(key)
     return base + suffix + lab
+
+
+_LABEL_PAIR = re.compile(r'(\w+)="([^"]*)"')
+
+
+def with_labels(key: str, **extra) -> str:
+    """Add labels to a series key that may ALREADY carry a label block:
+    ``with_labels('stream_lag{metro="sf"}', worker="w0")`` →
+    ``stream_lag{metro="sf",worker="w0"}``. Existing labels win on a
+    name clash (a member's own label is its identity; an aggregator
+    must never overwrite it). Routed through ``labeled()`` so the
+    sorted-label canonical spelling holds here too."""
+    base, lab = _split_labels(key)
+    labels = dict(_LABEL_PAIR.findall(lab))
+    for k, v in extra.items():
+        labels.setdefault(k, v)
+    return labeled(base, **labels)
 
 
 class _Reservoir:
@@ -159,7 +180,12 @@ class MetricsRegistry:
             r = self._series.get(name)
             if r is None:
                 r = self._series[name] = _Reservoir()
-                self._hist[name] = [0] * (len(HISTOGRAM_BUCKETS) + 1)
+                # setdefault, not assignment: a merged registry
+                # (merge_exports) carries histograms with no reservoir
+                # behind them — a later observe() into the same name
+                # must extend those bucket counts, never zero them
+                self._hist.setdefault(
+                    name, [0] * (len(HISTOGRAM_BUCKETS) + 1))
             r.add(value)
             self._hist[name][bisect.bisect_left(HISTOGRAM_BUCKETS,
                                                 value)] += 1
@@ -209,6 +235,23 @@ class MetricsRegistry:
             out["probes_per_sec_busy"] = probes / busy
         out["uptime_seconds"] = time.time() - self._born
         return out
+
+    def export(self) -> dict:
+        """The MERGE-ABLE wire form of the whole registry (round 19's
+        cross-worker aggregation — the reason ``HISTOGRAM_BUCKETS`` has
+        been fixed since round 10): counters and gauges verbatim plus
+        every observation series' fixed-bucket counts. Reservoir SAMPLES
+        are deliberately absent — percentiles are a process-local
+        affordance (/stats), the aggregable artifact is the histogram,
+        so merged expositions DROP ``_p50/_p99`` rather than publish a
+        quantile no math can justify (test-pinned)."""
+        with self._lock:
+            return {
+                "schema": EXPORT_SCHEMA,
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "hist": {k: list(v) for k, v in self._hist.items()},
+            }
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (format 0.0.4) of the whole
@@ -274,3 +317,47 @@ class MetricsRegistry:
                 lines.append(f"{n}_sum{lab} {float(total)}")
                 lines.append(f"{n}_count{lab} {count}")
         return "\n".join(lines) + "\n"
+
+
+def merge_exports(exports: "dict[str, dict]") -> MetricsRegistry:
+    """K member ``export()`` documents → ONE fleet-wide registry (the
+    round-10 promise, finally performed): keyed by member name so gauges
+    stay attributable.
+
+      counters    sum — labeled series union per full ``{metro=…}`` key
+                  (identical keys from two members are the same logical
+                  series and add; the ``_total``/``_count`` shadows ride
+                  along, keeping histogram ``_sum``/``_count`` exact);
+      gauges      carry a ``worker`` label — two members' backlog depths
+                  are different facts; last-write-wins across processes
+                  would fabricate a fleet-wide level nobody measured;
+      histograms  sum BUCKET-WISE over the shared fixed ``le`` grid
+                  (legal precisely because the grid is pinned);
+      reservoirs  dropped — the merged exposition publishes no
+                  ``_p50/_p99`` (see ``export()``).
+
+    The result is a plain MetricsRegistry: ``render_prometheus()`` is
+    the fleet exposition, ``snapshot()``/``value()`` serve /health math.
+    Property-tested (tests/test_distributed.py): merging K exports
+    equals one registry observing the union of all K observation
+    streams, exactly, on every counter and every bucket."""
+    out = MetricsRegistry()
+    with out._lock:
+        for member in sorted(exports):
+            exp = exports[member] or {}
+            if exp.get("schema") != EXPORT_SCHEMA:
+                # the tag exists to be CHECKED: an export from a
+                # version-skewed process is skipped, never mis-merged
+                # (empty dicts — a member with no metrics yet — carry
+                # no tag and contribute nothing either way)
+                continue
+            for k, v in (exp.get("counters") or {}).items():
+                out._counters[k] = out._counters.get(k, 0.0) + float(v)
+            for k, v in (exp.get("gauges") or {}).items():
+                out._gauges[with_labels(k, worker=member)] = float(v)
+            for k, buckets in (exp.get("hist") or {}).items():
+                h = out._hist.setdefault(
+                    k, [0] * (len(HISTOGRAM_BUCKETS) + 1))
+                for i, c in enumerate(buckets[:len(h)]):
+                    h[i] += int(c)
+    return out

@@ -54,7 +54,41 @@ from typing import Any
 from reporter_tpu.utils import locks
 
 __all__ = ["FlightRecorder", "Span", "tracer", "configure", "span",
-           "post_mortem", "NOOP"]
+           "post_mortem", "NOOP", "TRACE_KEY", "stamp_record",
+           "trace_id_of"]
+
+# ---------------------------------------------------------------------------
+# Broker-propagated trace context (round 19). A PRODUCER may stamp a
+# probe record with ``record[TRACE_KEY] = {"id": ..., "ts": wall}``
+# before appending it to a broker; the record-format brokers store dicts
+# verbatim, so the metadata rides the log untouched. Consumers that
+# recognize the key tag their spans with the inherited id
+# (streaming/pipeline.py); consumers that don't simply ignore an extra
+# dict key — which is exactly why format-pinned broker dirs stay
+# compatible in BOTH directions: old logs have no key (reads as
+# untraced), old readers skip the key (records stay valid). The
+# canonical-record validators never look at it.
+
+TRACE_KEY = "_trace"
+
+
+def stamp_record(record: dict, trace_id, ts: "float | None" = None) -> dict:
+    """Attach producer-side trace context to one broker record (in
+    place; returned for chaining). ``ts`` is WALL time (``time.time()``)
+    — the cross-process axis stitch.py aligns dumps on."""
+    record[TRACE_KEY] = {"id": str(trace_id),
+                         "ts": time.time() if ts is None else float(ts)}
+    return record
+
+
+def trace_id_of(record) -> "str | None":
+    """The inherited trace id of a broker record, or None when the
+    record is untraced (absent/malformed metadata is untraced, never an
+    error — a poisoned producer must not wedge consumption)."""
+    meta = record.get(TRACE_KEY) if isinstance(record, dict) else None
+    if isinstance(meta, dict) and meta.get("id") is not None:
+        return str(meta["id"])
+    return None
 
 
 class Span:
@@ -252,6 +286,16 @@ class FlightRecorder:
             "displayTimeUnit": "ms",
             "wall_time": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                        time.gmtime()),
+            # clock anchor (round 19): span timestamps are per-process
+            # ``time.monotonic`` — meaningless across pids. One
+            # (monotonic, wall) pair taken at dump time lets
+            # distributed/stitch.py shift every event onto the shared
+            # wall-clock axis and merge dumps from many processes into
+            # one causally ordered trace.
+            "clock_sync": {"monotonic_us": round(time.monotonic() * 1e6,
+                                                 1),
+                           "unix_us": round(time.time() * 1e6, 1),
+                           "pid": os.getpid()},
         }
         if reason is not None:
             doc["reason"] = reason

@@ -291,6 +291,51 @@ def test_bench_legs_autotune_cli(tmp_path):
     assert legs["autotune"]["result"]["mechanism_ok"] is True
 
 
+def test_bench_legs_topology_cli(tmp_path):
+    """Round-19 acceptance: `python bench.py --legs topology` runs the
+    real supervised 2-worker topology with its mid-soak SIGKILL on the
+    no-chip path — supervisor-observed death + restart + recovery,
+    zero-lost accounting, aggregation fidelity, and a stitched
+    cross-pid trace — journals the leg, records the topo summary
+    token, and writes the PARTIAL detail file only (no-clobber)."""
+    env = dict(os.environ)
+    env["REPORTER_BENCH_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    cpu_capture = os.path.join(os.path.dirname(_BENCH),
+                               "BENCH_DETAIL_CPU.json")
+    committed = (open(cpu_capture).read()
+                 if os.path.exists(cpu_capture) else None)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(_BENCH), "--legs", "topology"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        timeout=420, env=env, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stdout[-2000:]
+    summary = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    workers, pps, deaths, restarts, rec_s, lost, fid, stitched = \
+        summary["topo"]
+    assert workers == 2
+    assert deaths == 1 and restarts == 1      # the injected SIGKILL,
+    #                                           detected + restarted
+    assert rec_s is not None and rec_s > 0
+    assert lost == 0                          # zero-lost accounting
+    assert fid == 1                           # merged == union sums
+    assert stitched == 1                      # cross-pid causal track
+    assert pps and pps > 0
+    if committed is not None:                 # no-clobber (r15 rule)
+        assert open(cpu_capture).read() == committed
+    journal_path = os.path.join(os.path.dirname(os.path.abspath(_BENCH)),
+                                "bench_journal.jsonl")
+    entries = [json.loads(ln)
+               for ln in open(journal_path).read().splitlines()]
+    legs = {e.get("leg"): e for e in entries[1:]}
+    assert "topology" in legs
+    res = legs["topology"]["result"]
+    assert res["zero_lost_ok"] is True
+    assert res["aggregation"]["fidelity_ok"] is True
+    assert res["stitch"]["processes"] >= 2
+    assert res["worker_exit_reports_ok"] is True
+
+
 def test_bench_rejects_unknown_legs():
     env = dict(os.environ)
     out = subprocess.run(

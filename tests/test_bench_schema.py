@@ -512,6 +512,76 @@ def test_summary_line_carries_fleet_token():
     assert empty["fleet"] == [None] * 6
 
 
+TOPOLOGY_KEYS = (
+    "workers", "broker_probes", "stamped_records", "soak",
+    "probes_per_sec_wall", "deaths", "restarts", "reports_at_kill",
+    "lag_at_kill", "detect_seconds", "recovery_seconds", "lost_records",
+    "zero_lost_ok", "aggregation", "counters_checked", "buckets_checked",
+    "fidelity_ok", "exposition_ok", "event_counts", "exit_reports",
+    "worker_exit_reports_ok", "stitch",
+)
+
+
+def test_topology_leg_schema_keys():
+    """Pin detail.topology (round 19): the supervised-soak story —
+    death/restart/recovery, zero-lost accounting, aggregation fidelity,
+    the stitched cross-pid trace — must stay recorded fields on every
+    composite. Extend, never drop."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._topology_bench)
+    for key in TOPOLOGY_KEYS:
+        assert f'"{key}"' in src, key
+    # the leg's worker subprocesses are CPU-pinned on EVERY composite
+    # (a chip run must not donate its device to two startup compiles)
+    assert '"JAX_PLATFORMS": "cpu"' in src
+
+
+def test_summary_line_carries_topo_token():
+    """topo = [workers, aggregate probes/s (int), deaths, restarts,
+    recovery seconds (1 decimal), lost records, aggregation-fidelity
+    bit, stitched-cross-pid bit]."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "topology": {
+                   "workers": 2,
+                   "soak": {"probes_per_sec_wall": 163.2},
+                   "deaths": 1, "restarts": 1,
+                   "recovery_seconds": 2.36,
+                   "lost_records": 0,
+                   "aggregation": {"fidelity_ok": True},
+                   "stitch": {"ok": True},
+               },
+           }}
+    line = bench._summary_line(doc)
+    assert line["topo"] == [2, 163, 1, 1, 2.4, 0, 1, 1]
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["topo"] == [None] * 8
+
+
+def test_service_ab_records_draw_spread():
+    """Round-19 satellite: the closed-loop service A/B records the
+    client-thread count and per-draw req/s spread, so the r18
+    bimodality class ("120-484 req/s across draws") is diagnosable
+    FROM the capture. Source pin on the ab-block builder."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._service_saturation_curve)
+    assert '"round_rps"' in src
+    # the ab block (built in main's _leg_service) carries the per-draw
+    # fields; _summary_line is untouched (detail-only satellite)
+    src_main = inspect.getsource(bench.main)
+    for key in ("client_threads", "scheduler_draw_rps",
+                "legacy_draw_rps", "scheduler_draw_spread_pct",
+                "legacy_draw_spread_pct"):
+        assert f'"{key}"' in src_main, key
+
+
 def test_service_overload_boundary_rules():
     bench = _load_bench()
 

@@ -92,8 +92,13 @@ def _synthetic_doc():
             "wires_identical_after_paging": True,
             "mxu_compared": True,
         },
-        "service_ab": {"clients": 512, "scheduler_rps": 1544.3,
+        "service_ab": {"clients": 512, "client_threads": 512,
+                       "scheduler_rps": 1544.3,
                        "legacy_rps": 713.9, "speedup": 2.163,
+                       "scheduler_draw_rps": [1844.3, 1244.2, 1544.1],
+                       "legacy_draw_rps": [713.9, 484.2, 120.3],
+                       "scheduler_draw_spread_pct": 32.5,
+                       "legacy_draw_spread_pct": 83.1,
                        "inflight_ge2_dispatches": 37, "errors": 0},
         "service_overload_boundary": {"clients": 512,
                                       "reason": "p99_blowup"},
@@ -144,6 +149,18 @@ def _synthetic_doc():
                                "meets_2pct_bar": True},
             "drift": {"drift_events": 12},
             "mechanism_ok": True,
+        },
+        # widths honest-worst for the leg's FIXED tiny scale (1728
+        # probes, 2 workers, restart budget 2 each — see
+        # _topology_bench): 5-digit pps, 3-digit recovery, 4-digit lost
+        "topology": {
+            "workers": 2,
+            "soak": {"probes_per_sec_wall": 34567.8},
+            "deaths": 12, "restarts": 12,
+            "recovery_seconds": 123.45,
+            "lost_records": 1234,
+            "aggregation": {"fidelity_ok": True},
+            "stitch": {"ok": True},
         },
         "link_health": {"rtt_ms": 1129.22, "mbps": 125.13,
                         "mood": "degraded", "samples": 123,

@@ -1,0 +1,453 @@
+"""Round-19 topology observability plane: cross-worker metrics
+aggregation (the property contract: merging K exports == one registry
+observing the union), atomic snapshot spooling, the supervisor's
+death→count→post-mortem→restart path, and cross-pid trace stitching.
+
+Supervisor tests use trivial ``python -c`` members so death/restart
+mechanics run in milliseconds; the REAL 2-jax-worker topology (broker,
+SIGKILL, replay, sink accounting) is exercised end-to-end by bench.py's
+``detail.topology`` leg and its CLI acceptance test in
+tests/test_bench_journal.py — one expensive integration, not two.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from reporter_tpu.distributed import (MemberSpec, Supervisor, aggregate,
+                                      stitch)
+from reporter_tpu.utils import metrics, tracing
+
+
+# ---------------------------------------------------------------------------
+# satellite: cross-worker histogram merge == union of observations
+
+
+def _union_and_members(seed: int, k: int = 3, n_ops: int = 400):
+    """K member registries + ONE union registry fed the same randomized
+    observation stream (each op applied to exactly one member AND the
+    union)."""
+    rng = random.Random(seed)
+    members = [metrics.MetricsRegistry() for _ in range(k)]
+    union = metrics.MetricsRegistry()
+    series = ["match_seconds", "report_build_seconds",
+              metrics.labeled("quality_batches", metro="sf"),
+              metrics.labeled("quality_batches", metro="oak")]
+    counters = ["probes", metrics.labeled("fleet_hits", metro="sf"),
+                metrics.labeled("fleet_hits", metro="oak")]
+    for _ in range(n_ops):
+        m = members[rng.randrange(k)]
+        op = rng.randrange(3)
+        if op == 0:
+            name = rng.choice(series)
+            # values spanning the whole fixed bucket grid incl. +Inf
+            v = 10.0 ** rng.uniform(-4, 2)
+            m.observe(name, v)
+            union.observe(name, v)
+        elif op == 1:
+            name = rng.choice(counters)
+            d = rng.randrange(1, 5)
+            m.count(name, d)
+            union.count(name, d)
+        else:
+            m.gauge("stream_lag", rng.randrange(100))
+    return members, union
+
+
+@pytest.mark.parametrize("seed", [0, 7, 1234])
+def test_merge_exports_equals_union_of_observations(seed):
+    members, union = _union_and_members(seed)
+    merged = metrics.merge_exports(
+        {f"w{i}": m.export() for i, m in enumerate(members)})
+    # every histogram bucket, exactly (ints — no tolerance needed)
+    assert set(merged._hist) == set(union._hist)
+    for name, buckets in union._hist.items():
+        assert merged._hist[name] == buckets, name
+    # every counter (incl. the _total/_count shadows and the labeled
+    # per-metro union), to float-sum tolerance
+    assert set(merged._counters) == set(union._counters)
+    for name, v in union._counters.items():
+        assert merged._counters[name] == pytest.approx(v, abs=1e-9), name
+
+
+def test_merge_gauges_carry_worker_label_never_last_write_wins():
+    a, b = metrics.MetricsRegistry(), metrics.MetricsRegistry()
+    a.gauge("stream_lag", 5)
+    b.gauge("stream_lag", 9)
+    b.gauge(metrics.labeled("fleet_resident", metro="sf"), 1)
+    merged = metrics.merge_exports({"w0": a.export(), "w1": b.export()})
+    assert merged._gauges[metrics.labeled("stream_lag", worker="w0")] == 5
+    assert merged._gauges[metrics.labeled("stream_lag", worker="w1")] == 9
+    # existing labels survive; worker merges in, sorted-canonical
+    assert merged._gauges[
+        metrics.labeled("fleet_resident", metro="sf", worker="w1")] == 1
+
+
+def test_merged_registry_drops_reservoir_percentiles():
+    """PINNED choice (ISSUE 15 satellite): merged expositions publish NO
+    _p50/_p99 — reservoir percentiles are a process-local affordance;
+    the aggregable artifact is the fixed-bucket histogram. A merged
+    quantile would be math nobody can defend."""
+    a = metrics.MetricsRegistry()
+    for v in (0.01, 0.2, 3.0):
+        a.observe("match_seconds", v)
+    merged = metrics.merge_exports({"w0": a.export()})
+    snap = merged.snapshot()
+    assert not any(k.endswith(("_p50", "_p95", "_p99")) for k in snap), \
+        [k for k in snap if k.endswith(("_p50", "_p95", "_p99"))]
+    # but the histogram exposition (the aggregable form) is intact
+    text = merged.render_prometheus()
+    assert "# TYPE rtpu_match_seconds histogram" in text
+    assert 'le="+Inf"' in text
+    # the member registry itself still serves its local percentiles
+    assert a.snapshot()["match_seconds_p50"] == 0.2
+
+
+def test_merge_is_associative_across_grouping():
+    """Merging {A,B,C} equals merging {merge({A,B}) as one export, C} —
+    the supervisor can re-export its merged view upward (topologies of
+    topologies) without changing any number."""
+    members, _ = _union_and_members(99)
+    a, b, c = (m.export() for m in members)
+    flat = metrics.merge_exports({"a": a, "b": b, "c": c})
+    ab = metrics.merge_exports({"a": a, "b": b})
+    # NOTE gauges are worker-labeled on the first merge; compare the
+    # label-free aggregables (counters + buckets), which is the claim
+    two = metrics.merge_exports({"ab": ab.export(), "c": c})
+    assert flat._counters == pytest.approx(two._counters)
+    assert flat._hist == two._hist
+
+
+def test_observe_into_merged_registry_extends_buckets():
+    a = metrics.MetricsRegistry()
+    a.observe("match_seconds", 0.002)
+    merged = metrics.merge_exports({"w0": a.export()})
+    before = list(merged._hist["match_seconds"])
+    merged.observe("match_seconds", 0.002)
+    assert sum(merged._hist["match_seconds"]) == sum(before) + 1
+
+
+def test_with_labels_preserves_existing_and_sorts():
+    key = metrics.labeled("x", metro="sf")
+    assert metrics.with_labels(key, worker="w0") == \
+        'x{metro="sf",worker="w0"}'
+    # existing label wins on clash; plain names gain a block
+    assert metrics.with_labels(key, metro="oak") == key
+    assert metrics.with_labels("plain", worker="w1") == 'plain{worker="w1"}'
+
+
+# ---------------------------------------------------------------------------
+# snapshot spool protocol
+
+
+def test_snapshot_roundtrip_and_atomicity(tmp_path):
+    reg = metrics.MetricsRegistry()
+    reg.count("probes", 7)
+    reg.observe("match_seconds", 0.05)
+    path = aggregate.snapshot_path(str(tmp_path), "worker-0")
+    aggregate.write_snapshot(path, reg, "worker-0", seq=3,
+                             stats={"lag": 12})
+    assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+    doc = aggregate.read_snapshot(path)
+    assert doc["member"] == "worker-0" and doc["seq"] == 3
+    assert doc["stats"] == {"lag": 12}
+    assert doc["metrics"]["counters"]["probes"] == 7
+    # load_dir keys by member; foreign/torn files are skipped, never fatal
+    (tmp_path / "garbage.json").write_text("{torn")
+    (tmp_path / "foreign.json").write_text('{"other": 1}')
+    snaps = aggregate.load_dir(str(tmp_path))
+    assert set(snaps) == {"worker-0"}
+    merged = aggregate.merge_registry(snaps)
+    assert merged.value("probes") == 7
+    health = aggregate.member_health(snaps)
+    assert health["worker-0"]["seq"] == 3
+    assert health["worker-0"]["snapshot_age_s"] >= 0
+
+
+def test_version_skewed_snapshots_and_exports_are_skipped(tmp_path):
+    """The version tags are CHECKED, not decorative (the staged_layout
+    discipline): a snapshot or export from a version-skewed process is
+    skipped, never mis-merged into the fleet exposition."""
+    reg = metrics.MetricsRegistry()
+    reg.count("probes", 5)
+    path = aggregate.snapshot_path(str(tmp_path), "w")
+    aggregate.write_snapshot(path, reg, "w", seq=1)
+    doc = json.load(open(path))
+    doc["schema"] = aggregate.SNAPSHOT_SCHEMA + 1
+    (tmp_path / "skewed.json").write_text(json.dumps(doc))
+    snaps = aggregate.load_dir(str(tmp_path))
+    assert set(snaps) == {"w"}              # current-schema file only
+    exp = reg.export()
+    skewed = dict(exp, schema=metrics.EXPORT_SCHEMA + 1)
+    merged = metrics.merge_exports({"ok": exp, "skewed": skewed})
+    assert merged.value("probes") == 5      # skewed export contributed 0
+
+
+def test_snapshot_overwrite_keeps_latest(tmp_path):
+    reg = metrics.MetricsRegistry()
+    path = aggregate.snapshot_path(str(tmp_path), "w")
+    aggregate.write_snapshot(path, reg, "w", seq=1)
+    reg.count("probes", 3)
+    aggregate.write_snapshot(path, reg, "w", seq=2)
+    doc = aggregate.read_snapshot(path)
+    assert doc["seq"] == 2
+    assert doc["metrics"]["counters"]["probes"] == 3
+
+
+# ---------------------------------------------------------------------------
+# supervisor: death detection, restart policy, events, faces
+
+
+def _specs(tmp_path):
+    ok = MemberSpec("ok", [sys.executable, "-c",
+                           "import json; print(json.dumps("
+                           "{'steps': 1, 'link': {}, 'quality': {}}))"])
+    bad = MemberSpec("bad", [sys.executable, "-c", "import sys; sys.exit(3)"])
+    return [ok, bad]
+
+
+def _wait(pred, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_supervisor_detects_death_restarts_and_logs(tmp_path):
+    sup = Supervisor(_specs(tmp_path), str(tmp_path), restart=True,
+                     max_restarts=1, poll_s=0.02)
+    sup.start()
+    try:
+        assert _wait(sup.drained)
+        assert _wait(lambda: (sup.poll_once() or True)
+                     and sup.health()["members"]["bad"]["deaths"] >= 2)
+        h = sup.health()
+        # bad: died, restarted once, died again, budget exhausted
+        assert h["members"]["bad"]["restarts"] == 1
+        assert h["members"]["ok"]["clean_exits"] >= 1
+        assert h["deaths_total"] >= 2 and h["restarts_total"] == 1
+        kinds = [e["event"] for e in sup.events()]
+        assert kinds[0] == "topology_start"
+        assert "member_death" in kinds and "member_exit" in kinds
+        assert "restart_budget_exhausted" in kinds
+        spawns = [e for e in sup.events() if e["event"] == "member_spawn"]
+        assert {e["reason"] for e in spawns} == {"start", "restart"}
+        # the clean exit captured the worker's final JSON line
+        assert sup.exit_reports()["ok"] == {"steps": 1, "link": {},
+                                            "quality": {}}
+        # supervisor bookkeeping reaches the merged exposition
+        text = sup.metrics_text()
+        assert "rtpu_topo_deaths" in text and "rtpu_topo_members" in text
+    finally:
+        sup.stop()
+
+
+def test_supervisor_clean_exit_is_not_a_death(tmp_path):
+    sup = Supervisor([_specs(tmp_path)[0]], str(tmp_path), restart=True,
+                     poll_s=0.02)
+    sup.start()
+    try:
+        assert _wait(sup.drained)
+        sup.poll_once()
+        h = sup.health()["members"]["ok"]
+        assert h["deaths"] == 0 and h["restarts"] == 0
+        assert h["clean_exits"] == 1
+        assert not any(e["event"] == "member_death" for e in sup.events())
+    finally:
+        sup.stop()
+
+
+def test_supervisor_one_death_one_postmortem(tmp_path):
+    """The r15 one-event-one-dump rule at the topology layer: a death
+    TRANSITION dumps exactly one flight-recorder post-mortem (bounded
+    by the shared max_dumps budget like every other fault site)."""
+    tr = tracing.tracer()
+    was_enabled, was_dir = tr.enabled, tr.dump_dir
+    was_written = tr.dumps_written
+    dump_dir = str(tmp_path / "dumps")
+    tr.configure(enabled=True, dump_dir=dump_dir)
+    tr.dumps_written = 0        # this test must not eat later tests'
+    #                             bounded max_dumps budget (restored)
+    try:
+        sup = Supervisor([_specs(tmp_path)[1]], str(tmp_path),
+                         restart=False, poll_s=0.02)
+        sup.start()
+        try:
+            assert _wait(lambda: (sup.poll_once() or True)
+                         and sup.health()["members"]["bad"]["deaths"] >= 1)
+            time.sleep(0.1)
+            sup.poll_once()
+        finally:
+            sup.stop()
+        dumps = [n for n in os.listdir(dump_dir)
+                 if "worker_death" in n]
+        assert len(dumps) == 1, dumps
+        doc = json.load(open(os.path.join(dump_dir, dumps[0])))
+        assert doc["reason"] == "worker_death"
+        assert doc["failing_span"] == "bad"
+        assert "clock_sync" in doc          # stitchable post-mortem
+    finally:
+        tr.configure(enabled=was_enabled, dump_dir=was_dir)
+        tr.dumps_written = was_written
+
+
+def test_supervisor_wsgi_face(tmp_path):
+    sup = Supervisor(_specs(tmp_path)[:1], str(tmp_path), poll_s=0.02)
+    sup.start()
+    srv = sup.serve_http()
+    try:
+        port = srv.server_address[1]
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=10).read())
+        assert "members" in health and "deaths_total" in health
+        assert health["sink"]["rows"] == 0
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert text.startswith("# TYPE")
+        assert "rtpu_topo_members" in text
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=10)
+        assert ei.value.code == 404
+    finally:
+        sup.stop()
+
+
+def test_member_env_sink_beats_inherited_datastore_url(tmp_path,
+                                                       monkeypatch):
+    """An operator's inherited DATASTORE_URL must not silently redirect
+    a supervised topology's reports to a REAL datastore — the owned
+    sink wins; base_env/spec.env stay the deliberate overrides."""
+    monkeypatch.setenv("DATASTORE_URL", "http://real-datastore.invalid/")
+    sup = Supervisor([], str(tmp_path), poll_s=0.02)
+    try:
+        spec = MemberSpec("w", ["true"])
+        env = sup._member_env(spec)
+        assert env["DATASTORE_URL"] == sup.sink.url
+        spec2 = MemberSpec("w2", ["true"],
+                           env={"DATASTORE_URL": "http://override/"})
+        assert sup._member_env(spec2)["DATASTORE_URL"] == \
+            "http://override/"
+        # the package root rides PYTHONPATH so `-m reporter_tpu...`
+        # members import regardless of the supervisor's cwd
+        import reporter_tpu
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(reporter_tpu.__file__)))
+        assert env["PYTHONPATH"].split(os.pathsep)[0] == root
+    finally:
+        sup.stop()
+
+
+def test_report_sink_counts_rows(tmp_path):
+    sup = Supervisor([], str(tmp_path), poll_s=0.02)
+    try:
+        body = json.dumps({"reports": [
+            {"id": 1, "next_id": 2, "t0": 0.0, "t1": 1.0},
+            {"id": 1, "next_id": 2, "t0": 0.0, "t1": 1.0},
+        ]}).encode()
+        req = urllib.request.Request(sup.sink.url, data=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+        assert urllib.request.urlopen(req, timeout=10).status == 200
+        st = sup.sink.stats()
+        assert st["rows"] == 2 and st["posts"] == 1
+        assert sup.sink.reports[(1, 2, 0.0, 1.0)] == 2
+    finally:
+        sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# trace stitching
+
+
+def _worker_doc(pid, ts_mono, wall_at_dump, events):
+    return {"traceEvents": [dict(e, pid=pid) for e in events],
+            "clock_sync": {"monotonic_us": ts_mono * 1e6,
+                           "unix_us": wall_at_dump * 1e6, "pid": pid}}
+
+
+def test_stitch_aligns_clocks_and_threads_flows(tmp_path):
+    wall = 1_700_000_000.0
+    # producer: its monotonic epoch ~100s, produce at mono 101
+    prod = _worker_doc(10, 200.0, wall, [
+        {"name": "produce", "ph": "X", "tid": 1, "ts": 101.0 * 1e6,
+         "dur": 1000.0, "args": {"trace_id": "t1"}}])
+    # worker: different monotonic epoch; consumed 2s (wall) later
+    work = _worker_doc(20, 5000.0, wall, [
+        {"name": "worker_match", "ph": "X", "tid": 1,
+         "ts": (5000.0 - 97.0) * 1e6, "dur": 2000.0,
+         "args": {"trace_ids": ["t1"], "traced": 4}}])
+    out = stitch.stitch({"producer": prod, "worker-0": work},
+                        out_path=str(tmp_path / "stitched.json"))
+    st = out["stitched"]
+    assert st["processes"] == 2 and st["unsynced_processes"] == 0
+    assert st["traced_ids"] == 1 and st["cross_pid_tracks"] == 1
+    ev = {(e["name"], e.get("ph")): e for e in out["traceEvents"]}
+    p = ev[("produce", "X")]
+    w = ev[("worker_match", "X")]
+    # after alignment both sit on the wall axis: produce 99 s before
+    # dump, match 97 s before dump → dwell ≈ 2 s minus produce duration
+    assert w["ts"] - p["ts"] == pytest.approx(2.0 * 1e6, abs=1.0)
+    dwell = ev[("broker_dwell", "X")]
+    assert dwell["pid"] == 0
+    assert dwell["ts"] == pytest.approx(p["ts"] + 1000.0, abs=1.0)
+    assert dwell["dur"] == pytest.approx(2.0 * 1e6 - 1000.0, abs=1.0)
+    # flow start on the producer, finish on the worker, same id
+    flows = [e for e in out["traceEvents"] if e["name"] == "probe_path"]
+    assert {f["ph"] for f in flows} == {"s", "f"}
+    assert all(f["id"] == "t1" for f in flows)
+    # process_name metadata labels every member + the broker track
+    names = {e["args"]["name"] for e in out["traceEvents"]
+             if e["name"] == "process_name"}
+    assert names == {"producer", "worker-0", "broker"}
+    # written atomically, loadable
+    disk = json.load(open(tmp_path / "stitched.json"))
+    assert disk["stitched"] == st
+
+
+def test_stitch_same_pid_ids_do_not_flow():
+    doc = _worker_doc(10, 0.0, 1000.0, [
+        {"name": "a", "ph": "X", "tid": 1, "ts": 0.0, "dur": 1.0,
+         "args": {"trace_id": "x"}},
+        {"name": "b", "ph": "X", "tid": 1, "ts": 5.0, "dur": 1.0,
+         "args": {"trace_id": "x"}}])
+    out = stitch.stitch({"solo": doc})
+    assert out["stitched"]["cross_pid_tracks"] == 0
+    assert not any(e["name"] == "probe_path" for e in out["traceEvents"])
+
+
+def test_stitch_unsynced_dump_counts_and_still_merges(tmp_path):
+    legacy = {"traceEvents": [{"name": "old", "ph": "X", "pid": 3,
+                               "tid": 1, "ts": 1.0, "dur": 1.0}]}
+    p = tmp_path / "legacy.json"
+    p.write_text(json.dumps(legacy))
+    out = stitch.stitch({"legacy": str(p), "missing": str(tmp_path / "no")})
+    assert out["stitched"]["processes"] == 1
+    assert out["stitched"]["unsynced_processes"] == 1
+    assert stitch.load_dump(str(tmp_path / "no")) is None
+
+
+# ---------------------------------------------------------------------------
+# broker-propagated trace context (producer/consumer contract)
+
+
+def test_stamp_record_and_trace_id_of_roundtrip():
+    rec = {"uuid": "v1", "lat": 1.0, "lon": 2.0}
+    out = tracing.stamp_record(rec, "t-9", ts=123.0)
+    assert out is rec
+    assert rec[tracing.TRACE_KEY] == {"id": "t-9", "ts": 123.0}
+    assert tracing.trace_id_of(rec) == "t-9"
+    # absent / malformed metadata reads as untraced, never raises
+    assert tracing.trace_id_of({"uuid": "v2"}) is None
+    assert tracing.trace_id_of({tracing.TRACE_KEY: "garbage"}) is None
+    assert tracing.trace_id_of({tracing.TRACE_KEY: {}}) is None
+    assert tracing.trace_id_of(None) is None

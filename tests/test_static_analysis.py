@@ -545,6 +545,95 @@ def test_env_table_documents_all_real_reads():
 
 
 # ---------------------------------------------------------------------------
+# metric-inventory (round 19): seeded violations + clean twins, the
+# env-table pattern applied to the metric namespace
+
+
+def _metric_inventory(source: str, readme_text: str, tmp_path):
+    import ast as _ast
+
+    from reporter_tpu.analysis import lint_rules
+
+    readme = tmp_path / "README.md"
+    readme.write_text(readme_text)
+    mod = lint_rules._Module("synthetic.py", source, _ast.parse(source),
+                             source.splitlines())
+    return lint_rules._rule_metric_inventory([mod], str(readme))
+
+
+_INV = ("<!-- metric-inventory:begin -->\n| kind | names |\n{rows}\n"
+        "<!-- metric-inventory:end -->\n")
+
+
+def test_metric_inventory_catches_undocumented_registration(tmp_path):
+    src = ("def f(self):\n"
+           "    self.metrics.count(\"synthetic_undocumented_total\")\n")
+    found = _metric_inventory(src, _INV.format(rows="| x | `probes` |"),
+                              tmp_path)
+    msgs = [f.message for f in found]
+    assert any("synthetic_undocumented_total" in m for m in msgs)
+    # ... and the dead `probes` row is the reverse direction
+    assert any("'probes'" in m and "dead row" in m for m in msgs)
+
+
+def test_metric_inventory_documented_registrations_pass(tmp_path):
+    src = ("from reporter_tpu.utils.metrics import labeled\n"
+           "def f(self, m, reg):\n"
+           "    m.count(\"syn_a\")\n"
+           "    reg.gauge(labeled(\"syn_b\", metro=\"sf\"), 1)\n"
+           "    self.metrics.observe(\"syn_c\", 0.1)\n"
+           "    with self.metrics.stage(\"syn_d\"):\n"
+           "        pass\n")
+    rows = "| x | `syn_a`, `syn_b`, `syn_c`, `syn_d_seconds` |"
+    assert _metric_inventory(src, _INV.format(rows=rows), tmp_path) == []
+
+
+def test_metric_inventory_qualified_labeled_spelling(tmp_path):
+    # metrics.labeled(...) — the CLAUDE.md convention spelling — must
+    # register exactly like the bare import form
+    src = ("from reporter_tpu.utils import metrics\n"
+           "def f(reg):\n"
+           "    reg.count(metrics.labeled(\"syn_q\", metro=\"sf\"))\n")
+    found = _metric_inventory(src, _INV.format(rows="| x | nothing |"),
+                              tmp_path)
+    assert any("'syn_q'" in f.message for f in found)
+    rows = "| x | `syn_q` |"
+    assert _metric_inventory(src, _INV.format(rows=rows), tmp_path) == []
+
+
+def test_metric_inventory_stage_registers_seconds_suffix(tmp_path):
+    src = ("def f(self):\n"
+           "    with self.metrics.stage(\"syn_stage\"):\n"
+           "        pass\n")
+    rows = "| x | `syn_stage` |"   # wrong: stage derives _seconds
+    found = _metric_inventory(src, _INV.format(rows=rows), tmp_path)
+    assert any("syn_stage_seconds" in f.message for f in found)
+    assert any("'syn_stage'" in f.message and "dead row" in f.message
+               for f in found)
+
+
+def test_metric_inventory_non_registry_receivers_ignored(tmp_path):
+    # str.count / list.count with a literal arg are not registrations
+    src = ("def f(parts, text):\n"
+           "    return text.count(\"x\") + parts.count(\"probes\")\n")
+    assert _metric_inventory(src, _INV.format(rows="| x | nothing |"),
+                             tmp_path) == []
+
+
+def test_metric_inventory_missing_markers_is_loud(tmp_path):
+    found = _metric_inventory("x = 1\n", "# README with no block\n",
+                              tmp_path)
+    assert any("metric-inventory:begin" in f.message for f in found)
+
+
+def test_metric_inventory_repo_gate_is_clean():
+    findings = [f for f in _repo_findings()
+                if f.rule == "metric-inventory"]
+    assert not [f for f in findings if not f.waived], \
+        "\n".join(str(f) for f in findings if not f.waived)
+
+
+# ---------------------------------------------------------------------------
 # global-state leak detector (the conftest gate's engine)
 
 

@@ -142,3 +142,89 @@ def test_worker_columnar_broker_autodetect(worker_env, capsys, monkeypatch):
                  "--max-steps", "1"]) == 0
     out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out2["lag"] == 0
+
+
+def test_worker_exit_json_carries_link_and_quality_counters(worker_env,
+                                                            capsys):
+    """Round-19 satellite: the r15 link-health layer and the r18 quality
+    layer both run in-process — the exit report is where a supervisor
+    reads them after the worker is gone. Both blocks must be present
+    with their counter keys (mood may be None on a probe-less run; the
+    KEYS are the contract)."""
+    d = worker_env["dir"]
+    broker = str(d / "broker_exitjson")
+    q = DurableIngestQueue(broker, Config().streaming.num_partitions)
+    for p in worker_env["fleet"]:
+        for (lo, la), t in zip(p.lonlat, p.times):
+            q.append({"uuid": p.uuid, "lat": float(la), "lon": float(lo),
+                      "time": float(t)})
+    q.close()
+    assert main(["--tiles", worker_env["tiles"], "--broker-dir", broker,
+                 "--max-steps", "2"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    for key in ("probes", "dead_probes", "mood"):
+        assert key in out["link"], key
+    for key in ("enabled", "window_waves", "drifted", "drift_events",
+                "empty_match_rate", "violation_rate"):
+        assert key in out["quality"], key
+    assert out["quality"]["drift_events"] == 0
+    assert "traced_records" in out and out["member"]
+
+
+def test_worker_spools_snapshots_and_inherits_trace_ids(
+        worker_env, capsys, monkeypatch):
+    """Round-19 tentpole at the worker seam: --snapshot-dir (env twin
+    RTPU_TOPO_*) spools atomic, merge-able registry exports the
+    supervisor tails, and producer-stamped records tag the worker's
+    spans + traced_records count."""
+    import os
+
+    from reporter_tpu.distributed import aggregate
+    from reporter_tpu.utils import tracing
+
+    d = worker_env["dir"]
+    broker = str(d / "broker_topo")
+    snap_dir = str(d / "snaps")
+    q = DurableIngestQueue(broker, Config().streaming.num_partitions)
+    stamped = 0
+    for p in worker_env["fleet"]:
+        for i, ((lo, la), t) in enumerate(zip(p.lonlat, p.times)):
+            rec = {"uuid": p.uuid, "lat": float(la), "lon": float(lo),
+                   "time": float(t)}
+            if i % 4 == 0:
+                tracing.stamp_record(rec, f"{p.uuid}@{i}")
+                stamped += 1
+            q.append(rec)
+    q.close()
+
+    tr = tracing.tracer()
+    was_enabled = tr.enabled
+    tr.configure(enabled=True)
+    tr.clear()
+    monkeypatch.setenv("RTPU_TOPO_MEMBER", "w-test")
+    try:
+        assert main(["--tiles", worker_env["tiles"],
+                     "--broker-dir", broker,
+                     "--snapshot-dir", snap_dir,
+                     "--snapshot-interval", "0",
+                     "--max-steps", "2"]) == 0
+    finally:
+        tr.configure(enabled=was_enabled)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["member"] == "w-test"
+    assert out["traced_records"] == stamped
+    # spooled snapshot: member-named, atomic, merge-able
+    snaps = aggregate.load_dir(snap_dir)
+    assert set(snaps) == {"w-test"}
+    doc = snaps["w-test"]
+    assert doc["pid"] == os.getpid()
+    assert doc["metrics"]["counters"]["probes"] > 0
+    assert doc["stats"]["lag"] == 0
+    merged = aggregate.merge_registry(snaps)
+    assert merged.value("probes") == doc["metrics"]["counters"]["probes"]
+    # spans carry the inherited ids (bounded list + full count)
+    spans = {s.name: s for s in tr.snapshot()}
+    assert "worker_match" in spans
+    args = spans["worker_match"].args
+    assert args and args["traced"] > 0 and args["trace_ids"]
+    assert all(isinstance(t, str) for t in args["trace_ids"])
