@@ -3274,6 +3274,136 @@ def _topology_bench(tpu_ok: bool, timeout: float = 420.0) -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _backfill_bench(tpu_ok: bool) -> dict:
+    """detail.backfill (round 20) — ROADMAP item 4's batch half as a
+    measured, journaled artifact: the open-loop spool-replay engine
+    (reporter_tpu/backfill) vs the closed-loop streaming worker draining
+    the SAME durable columnar spool of the same tiny tile's fleet.
+    Self-contained (builds + spools its own tile) so ``--legs backfill``
+    fits a short tunnel window; on a no-chip composite the numbers are
+    one-core CPU mechanism validation, never a throughput claim.
+    Recorded: both arms' krows/s over the spool wall (each arm warmed
+    untimed first — the first dispatch pays jit trace+lower, the r12
+    discipline), their ratio ``vs_soak_x`` (the acceptance bar: open ≥
+    closed on a CPU capture — the open loop never waits on the host
+    between waves), the engine's device-vs-reference aggregate identity
+    bit (shadow reference: the same flat_cells binning through np.add.at
+    instead of the device scatter), and the k-anonymity harvest
+    counts."""
+    import shutil
+    import tempfile
+
+    from reporter_tpu.backfill import BackfillConfig, BackfillEngine
+    from reporter_tpu.config import (CompilerParams, Config, ServiceConfig,
+                                     StreamingConfig)
+    from reporter_tpu.netgen.synthetic import generate_city
+    from reporter_tpu.netgen.traces import synthesize_fleet
+    from reporter_tpu.streaming.columnar import ColumnarStreamPipeline
+    from reporter_tpu.streaming.durable_columnar import (
+        DurableColumnarIngestQueue)
+    from reporter_tpu.tiles.compiler import compile_network
+
+    n_veh, n_pt = (96, 240) if tpu_ok else (32, 120)
+    nparts = 4
+    workdir = tempfile.mkdtemp(prefix="rtpu_backfill_")
+    try:
+        # short OSMLR segments (the streaming fixtures' compile shape):
+        # segment-boundary transitions must be OBSERVABLE within a trace
+        # or the spool yields no complete records to aggregate
+        net = generate_city("tiny")
+        net.name = "bf"
+        ts = compile_network(net, CompilerParams(reach_radius=500.0,
+                                                 osmlr_max_length=200.0))
+        probes = synthesize_fleet(ts, n_veh, num_points=n_pt, seed=11,
+                                  gps_sigma=3.0)
+        batches, _, _ = _stage_round_batches(ts, probes, n_veh,
+                                             steps_per_batch=40)
+        broker_dir = os.path.join(workdir, "spool")
+        q = DurableColumnarIngestQueue(broker_dir, nparts)
+        for b in batches:
+            q.append_columns(b)
+        ends = [q.end_offset(p) for p in range(nparts)]
+        q.close()
+        total = int(sum(ends))
+
+        cfg = Config(
+            matcher_backend="jax",
+            service=ServiceConfig(datastore_url="http://sink.invalid/"),
+            streaming=StreamingConfig(num_partitions=nparts))
+
+        # ---- closed-loop arm: the serving worker drains the spool ----
+        def _closed_drain() -> dict:
+            posts = [0]
+
+            def transport(url, body):
+                posts[0] += 1
+                return 200
+
+            pipe = ColumnarStreamPipeline(
+                ts, cfg,
+                queue=DurableColumnarIngestQueue(broker_dir, nparts),
+                transport=transport)
+            try:
+                t0 = time.perf_counter()
+                while pipe.queue.lag(pipe.committed) > 0:
+                    pipe.step()
+                pipe.drain()
+                dt = max(time.perf_counter() - t0, 1e-9)
+            finally:
+                pipe.close()
+                pipe.queue.close()
+            return {"seconds": round(dt, 3),
+                    "krows_per_s": round(total / dt / 1e3, 3),
+                    "posts": posts[0]}
+
+        _closed_drain()                       # warm (compile, untimed)
+        closed = _closed_drain()
+
+        # ---- open-loop arm: the backfill engine over the same spool --
+        bf = BackfillConfig(slice_traces=64, max_inflight=4,
+                            poll_records=4096, k_anonymity=2)
+
+        def _open_run(shadow: bool):
+            eng = BackfillEngine(ts, cfg, bf)
+            if shadow:
+                eng.enable_shadow_reference()
+            return eng, eng.run(broker_dir)
+
+        try:
+            _open_run(False)                  # warm (compile, untimed)
+            eng, ostats = _open_run(True)
+        except RuntimeError as exc:           # no native walker: the
+            return {"records": total,         # parity suites scream, the
+                    "closed_loop": closed,    # leg degrades to a note
+                    "note": f"open loop skipped: {exc}"}
+
+        vs = round(ostats["krows_per_s"] / max(closed["krows_per_s"],
+                                               1e-9), 2)
+        return {
+            "config": (f"{n_veh} vehicles x {n_pt} pts = {total} records "
+                       f"over a {nparts}-partition durable columnar "
+                       f"spool, both arms warmed, tile={ts.name}"),
+            "records": total,
+            "open_loop": {
+                "krows_per_s": ostats["krows_per_s"],
+                "seconds": ostats["seconds"],
+                "waves": ostats["waves"],
+                "chunks": ostats["chunks"],
+                "reports": ostats["reports"],
+                "replay_tax_records": ostats["replay_tax_records"],
+                "kept_segments": ostats["kept_segments"],
+                "kanon_dropped": ostats["kanon_dropped"],
+                "agg_identical": eng.shadow_identical(),
+            },
+            "closed_loop": closed,
+            "vs_soak_x": vs,
+            "open_ge_closed_ok": bool(
+                ostats["krows_per_s"] >= closed["krows_per_s"]),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _provenance(tpu_ok: bool) -> dict:
     """Self-describing capture stamp (ISSUE-4 satellite): git sha + an
     optional round label, so a stale BENCH_DETAIL.json can never again
@@ -3354,9 +3484,10 @@ _ALL_LEGS = (
     "streaming", "streaming_capacity", "streaming_soak",
     "latency_attribution", "streaming_overload", "chaos",
     "device_compute", "sweep_ab", "autotune", "quality", "window2",
-    "prepare_bench", "fleet", "topology",
+    "prepare_bench", "fleet", "topology", "backfill",
 )
-_SELF_CONTAINED_LEGS = {"fleet", "topology"}   # + sweep_ab / autotune /
+_SELF_CONTAINED_LEGS = {"fleet", "topology", "backfill"}   # + sweep_ab /
+#                                         autotune /
 #                                         quality when no chip is in
 #                                         play (their *_cpu_validate
 #                                         stand-ins compile their own
@@ -4443,6 +4574,14 @@ def main() -> None:
         detail["topology"] = topo
     split["topology_s"] = journal.seconds("topology")
 
+    # -- open-loop backfill engine (ISSUE 16): every composite;
+    # self-contained (builds + spools its own tile), so `--legs
+    # backfill` fits a short window ------------------------------------
+    backfill = journal.leg("backfill", lambda: _backfill_bench(tpu_ok))
+    if backfill:
+        detail["backfill"] = backfill
+    split["backfill_s"] = journal.seconds("backfill")
+
     # -- link-health record (round 15): the whole run's window + the
     # measured probe duty (the <0.5% steady-state claim as a field) ------
     if link_enabled:
@@ -4565,6 +4704,21 @@ def _topo_token(_g) -> list:
             None if stv is None else int(bool(stv))]
 
 
+def _bf_token(_g) -> list:
+    """bf = [open-loop krows/s (1 decimal), open/closed speedup vs the
+    same spool's closed-loop drain (the acceptance bar: ≥ 1 on a CPU
+    capture), device-vs-reference aggregate identity bit (shadow
+    reference — same flat_cells binning, np.add.at twin), k-anonymity-
+    withheld segment count] — full leg in detail.backfill."""
+    kr = _g("backfill", "open_loop", "krows_per_s")
+    vs = _g("backfill", "vs_soak_x")
+    agg = _g("backfill", "open_loop", "agg_identical")
+    return [None if kr is None else round(kr, 1),
+            None if vs is None else round(vs, 2),
+            None if agg is None else int(bool(agg)),
+            _g("backfill", "open_loop", "kanon_dropped")]
+
+
 def _summary_line(doc: dict) -> dict:
     """Compact (<1 KB, CI-pinned by tests/test_bench_summary.py)
     machine-readable round summary: headline value, per-tile throughput,
@@ -4613,11 +4767,14 @@ def _summary_line(doc: dict) -> dict:
         "device": dev,
         "tiles_kpps": tiles_kpps,
         "e2e_over_decode": d.get("e2e_over_decode"),
-        # whole ms (r18 compaction; exact value stays in the detail)
-        "p50_trace_ms": (None
-                         if d.get("p50_single_trace_latency_ms") is None
-                         else int(d["p50_single_trace_latency_ms"])),
-        "p50_matcher_ms": d.get("p50_matcher_only_ms"),
+        # fixed-order array [single-trace e2e p50 (whole ms, r18
+        # compaction), matcher-only p50] — the two r18 keys folded into
+        # one (r20 compaction: the bf token needed the bytes); exact
+        # values stay in the detail file
+        "p50_ms": [(None
+                    if d.get("p50_single_trace_latency_ms") is None
+                    else int(d["p50_single_trace_latency_ms"])),
+                   d.get("p50_matcher_only_ms")],
         # key names compacted for the 1 KB pin (r8 precedent): xl_bind =
         # xl binding leg ("dev" = device_sweep, "host" = host legs —
         # r15 compaction, the link/delta tokens needed the bytes),
@@ -4773,6 +4930,8 @@ def _summary_line(doc: dict) -> dict:
             None if fleet_bit is None else int(bool(fleet_bit))],
         # round-19 topology token (see _topo_token)
         "topo": _topo_token(_g),
+        # round-20 backfill token (see _bf_token)
+        "bf": _bf_token(_g),
         # round-15 link-health token: [rtt_ms, mbps, mood] — the run's
         # window; CPU composites record mood "cpu", never omit the token
         # (full record incl. measured probe duty in detail.link_health)
@@ -4788,19 +4947,21 @@ def _summary_line(doc: dict) -> dict:
         "delta": [_g("bench_delta", "regressions_total"),
                   _g("bench_delta", "link_attributable_total"),
                   regs[0]["delta_pct"] if regs else None],
-        # first overloaded client level (None = survived the whole curve)
-        "svc_edge": _g("service_overload_boundary", "clients"),
         # serving-face A/B headline (full curves + open loop in detail):
         # [clients, scheduler req/s, queue-and-combine req/s, dispatches
-        # at in-flight depth >= 2, errors] — same run, alternated
-        # rounds; req/s truncated to ints (r15 compaction)
+        # at in-flight depth >= 2, errors, first overloaded client level
+        # (None = survived the whole curve — the r20 compaction folded
+        # the old svc_edge key in as the last slot; the bf token needed
+        # the bytes)] — same run, alternated rounds; req/s truncated to
+        # ints (r15 compaction)
         "svc": [_g("service_ab", "clients"),
                 (None if _g("service_ab", "scheduler_rps") is None
                  else int(_g("service_ab", "scheduler_rps"))),
                 (None if _g("service_ab", "legacy_rps") is None
                  else int(_g("service_ab", "legacy_rps"))),
                 _g("service_ab", "inflight_ge2_dispatches"),
-                _g("service_ab", "errors")],
+                _g("service_ab", "errors"),
+                _g("service_overload_boundary", "clients")],
         "total_seconds": d.get("total_seconds"),
     }
     return summary

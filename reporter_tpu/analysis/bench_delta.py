@@ -56,6 +56,9 @@ _HIGHER_SUFFIXES = (
     # tracing-overhead A/B's sustained rates
     "throughput_vs_sf", "throughput_vs_unrestricted", "_peak",
     "pps_traced", "pps_untraced",
+    # r20 backfill leg: the open-loop engine's speedup over the same
+    # spool's closed-loop drain (the leg's acceptance ratio)
+    "vs_soak_x",
 )
 _LOWER_SUFFIXES = (
     "_ms", "disagreement", "miss_rate", "step_miss_rate", "lag",
@@ -80,6 +83,9 @@ _LOWER_SUFFIXES = (
     # replay failed to cover are worse when UP (lost_records' healthy
     # baseline is 0 — the zero-baseline rendering applies)
     "detect_seconds", "lost_records",
+    # r20 backfill leg: records re-read across a checkpoint-resume are
+    # the counted replay tax (healthy baseline 0 on a clean replay)
+    "replay_tax_records",
 )
 # Whole subtrees that are bookkeeping, measurement conditions, or
 # self-referential analysis — pruned before any leaf is classified (one
@@ -183,6 +189,13 @@ _SKIP_KEYS = {
     "round_rps", "scheduler_draw_rps", "legacy_draw_rps",
     "scheduler_draw_spread_pct", "legacy_draw_spread_pct",
     "client_threads",
+    # backfill leg (round 20): spool/wave/chunk shape echoes and the
+    # k-anonymity harvest tallies — kanon_dropped/kept_segments are
+    # cutoff bookkeeping at the leg's fixed k and scale, not perf
+    # claims; krows_per_s/vs_soak_x/replay_tax_records above carry the
+    # compared claims
+    # lint: allow[bench-coverage] 2026-08-06 r20 detail.backfill rows land with this round's capture (the leg is new; no committed composite carries it yet) — they guard the next committed capture, CPU and chip flavors alike
+    "records", "waves", "chunks", "kept_segments", "kanon_dropped",
 }
 
 # every throughput/latency number measured THROUGH the remote link is
@@ -193,7 +206,8 @@ _LINK_FREE_TOKENS = re.compile(
     r"colocated|device_probes_per_sec|device_ms_per_dispatch|krows"
     r"|disagreement|point_edge|point_segment|matcher_only"
     r"|cpu_reference|python_|miss_rate|lost|duplicated|dead_letter"
-    r"|errors|rejected|dropped|overhead_pct|speedup|probe_duty",
+    r"|errors|rejected|dropped|overhead_pct|speedup|probe_duty"
+    r"|replay_tax|vs_soak",
     re.IGNORECASE)
 
 

@@ -56,9 +56,11 @@ SMEM_BOUND_BYTES = 1024 * 1024
 
 def compute_manifest() -> "dict[str, Any]":
     """The compiled-shape universe, derived from the live constants."""
+    from reporter_tpu.backfill import aggregate as bagg
     from reporter_tpu.config import (SWEEP_NJ_CAP_RUNGS, MatcherParams,
                                      ServiceConfig)
     from reporter_tpu.matcher import api, autotune
+    from reporter_tpu.ops import aggregate as agg
     from reporter_tpu.ops import dense_candidates as dc
     from reporter_tpu.ops import match
     from reporter_tpu.service import scheduler
@@ -119,6 +121,16 @@ def compute_manifest() -> "dict[str, Any]":
         "histogram_scatter": {
             "cap_rows": SpeedHistogram._CAP,
         },
+        # round 20: the backfill aggregates' shared flat scatter — ONE
+        # update-batch shape and a fixed set of grids per tile, so an
+        # open-loop run adds exactly two scatter executables to the
+        # universe (ops/aggregate.py; grids in backfill/aggregate.py)
+        "backfill_scatter": {
+            "cap_rows": agg._CAP,
+            "grids": ["speed_tod", "turns"],
+            "tod_bins_default": bagg.DEFAULT_TOD_BINS,
+            "turn_slots_default": bagg.DEFAULT_TURN_SLOTS,
+        },
         # round 17: the per-metro self-tuning plan space — the cap-rung
         # × kernel-arm matrix the tuner may pick from, fully enumerated
         # so per-metro tuning can never grow the executable population
@@ -163,6 +175,10 @@ GOLDEN: "dict[str, Any]" = \
               'plan_version': 1,
               'plans_bound': 15,
               'staged_member': 'tuned_plan'},
+ 'backfill_scatter': {'cap_rows': 4096,
+                      'grids': ['speed_tod', 'turns'],
+                      'tod_bins_default': 24,
+                      'turn_slots_default': 8},
  'dense_sweep': {'chunk_sub_bboxes': 8,
                  'feat_rows': 8,
                  'narrow_grid_cap': 128,

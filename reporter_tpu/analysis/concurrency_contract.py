@@ -151,6 +151,12 @@ LOCK_ORDER_EDGES: "dict[tuple[str, str], str]" = {
         "consults the broker fault site inside the partition lock so an "
         "injected torn write lands exactly where a real one would; the "
         "plan lock is a leaf counter",
+    ("broker.partitions", "faults.registry"): "2026-08-06 "
+        "faults.active()'s lazy one-shot env parse takes the registry "
+        "lock on first consultation, which can land under a durable "
+        "partition lock when a broker fault site is the process's first "
+        "consultation (the backfill engine's reader thread reaches one "
+        "before any matcher site); leaf — the app.combine edge's shape",
     # ---- publisher -------------------------------------------------------
     ("publisher.spool", "publisher.counters"): "2026-08-04 replay "
         "rewrites the spool prefix and reconciles pending/replayed "

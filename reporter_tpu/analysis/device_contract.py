@@ -369,6 +369,24 @@ def _audit_histogram() -> "list[Finding]":
                        ("reporter_tpu/streaming/histogram.py", 1))
 
 
+def _audit_backfill_scatter() -> "list[Finding]":
+    """Round 20: the backfill aggregates' shared FLAT scatter
+    (ops/aggregate.py) — same fixed-batch-shape discipline as the
+    histogram, audited under the same x64 widening rules."""
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops import aggregate as agg
+
+    cap = agg._CAP
+    closed = jax.make_jaxpr(agg._scatter_add)(
+        jax.ShapeDtypeStruct((4096,), jnp.int32),
+        jax.ShapeDtypeStruct((cap,), jnp.int32),
+        jax.ShapeDtypeStruct((cap,), jnp.bool_))
+    return audit_jaxpr(closed, "backfill/scatter",
+                       ("reporter_tpu/ops/aggregate.py", 1))
+
+
 def _merge_across_cases(findings: "list[Finding]") -> "list[Finding]":
     """One finding per (rule, path, line): a shared-code violation is hit
     by most of the 54 matrix cells (every case traces the same viterbi),
@@ -426,6 +444,7 @@ def run_device_contract(root: str = REPO_ROOT) -> "list[Finding]":
             findings.extend(check_wire_avals(closed.out_avals, case.layout,
                                              case.label, site))
         findings.extend(_audit_histogram())
+        findings.extend(_audit_backfill_scatter())
 
     findings = _merge_across_cases(findings)
     by_path: "dict[str, list[Finding]]" = {}

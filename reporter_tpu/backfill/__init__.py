@@ -1,0 +1,10 @@
+"""Open-loop batch backfill (round 20): durable-spool reprocessing with
+device-side per-segment aggregation. See engine.py's module docstring."""
+
+from reporter_tpu.backfill.aggregate import (AggregateStore,
+                                             SpeedTodHistogram, TurnCounts,
+                                             harvest_aggregates)
+from reporter_tpu.backfill.engine import BackfillConfig, BackfillEngine
+
+__all__ = ["AggregateStore", "BackfillConfig", "BackfillEngine",
+           "SpeedTodHistogram", "TurnCounts", "harvest_aggregates"]

@@ -1,0 +1,222 @@
+"""Backfill aggregates: per-segment speed × time-of-day histograms and
+next-segment turn counts, device-resident (round 20).
+
+Both aggregates ride ONE audited scatter (ops/aggregate.FixedGridCounts —
+the SpeedHistogram fixed-batch-shape discipline over a FLAT grid); this
+module owns only the host-side binning that turns an observation into a
+flat cell index. The binning has exactly ONE spelling (``flat_cells``),
+shared by the device path and the numpy reference path, so device-vs-
+reference parity (tests + every composite's ``detail.backfill`` leg)
+isolates the scatter itself.
+
+Grid sizes: the speed × time-of-day histogram stages
+``rows × tod_bins × speed_bins`` i32 cells (defaults: 24 × 13 ≈ 1.2 KB
+per segment row — ~2.5 GB at the 2M-segment envelope, inside the HBM
+budget next to staged tables); turn counts stage ``rows × (slots + 1)``
+with a host-side first-seen slot legend per segment (road fanout almost
+always fits ``DEFAULT_TURN_SLOTS``; overflow lands in the counted
+"other" slot, never silently dropped).
+
+The k-anonymity cutoff (``harvest_aggregates``) runs host-side ONCE at
+harvest: a segment whose observation count is below k is ABSENT from the
+persisted doc — never present-but-zeroed, which would leak that the
+segment was observed at all.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from reporter_tpu.ops.aggregate import FixedGridCounts, reference_counts
+from reporter_tpu.utils import locks
+
+DEFAULT_TOD_BINS = 24
+DEFAULT_TURN_SLOTS = 8
+
+_DAY_S = 86400.0
+
+
+class SpeedTodHistogram:
+    """i32 [rows, tod_bins, speed_bins] counts on device (flat grid)."""
+
+    def __init__(self, num_rows: int, speed_edges, tod_bins: int = DEFAULT_TOD_BINS):
+        self.speed_edges = np.asarray(speed_edges, np.float64)
+        self.num_bins = len(self.speed_edges)    # last bin open-ended
+        self.tod_bins = int(tod_bins)
+        self.num_rows = int(num_rows)
+        self._grid = FixedGridCounts(
+            self.num_rows * self.tod_bins * self.num_bins)
+
+    def flat_cells(self, rows, times, speeds) -> np.ndarray:
+        """THE binning: (segment row, start time s, speed m/s) → flat
+        cell index; −1 for an observation no cell accepts (unknown row,
+        negative speed). Shared by device and reference accumulation."""
+        rows = np.asarray(rows, np.int64)
+        tod = np.floor(np.mod(np.asarray(times, np.float64), _DAY_S)
+                       / (_DAY_S / self.tod_bins)).astype(np.int64)
+        tod = np.clip(tod, 0, self.tod_bins - 1)
+        sbin = (np.searchsorted(self.speed_edges, np.asarray(speeds),
+                                side="right") - 1).astype(np.int64)
+        ok = (rows >= 0) & (rows < self.num_rows) & (sbin >= 0)
+        return np.where(ok, (rows * self.tod_bins + tod) * self.num_bins
+                        + sbin, -1)
+
+    def update(self, rows, times, speeds) -> int:
+        """Scatter one observation per (row, time, speed); returns the
+        accepted count. Async device work — no host readback."""
+        if len(np.asarray(rows)) == 0:
+            return 0
+        return self._grid.add(self.flat_cells(rows, times, speeds))
+
+    def snapshot(self) -> np.ndarray:
+        return self._grid.snapshot().reshape(
+            self.num_rows, self.tod_bins, self.num_bins)
+
+    def load(self, hist) -> None:
+        self._grid.load(np.asarray(hist))
+
+    def reference(self, rows, times, speeds) -> np.ndarray:
+        """Numpy accumulation from zero over the same observations —
+        what a fresh device snapshot must equal bit-for-bit."""
+        return reference_counts(
+            self._grid.size, self.flat_cells(rows, times, speeds)).reshape(
+                self.num_rows, self.tod_bins, self.num_bins)
+
+
+class TurnCounts:
+    """i32 [rows, slots + 1] next-segment counts on device (flat grid).
+
+    Slot assignment is host-side and first-seen per segment row: the
+    legend (row → ordered list of successor segment ids) lives on host —
+    tiny, bounded by road fanout — and rides checkpoints through the
+    cache dump; counts stay on device. Successors past ``slots`` land in
+    the final "other" slot, counted, so the ratio denominators stay
+    exact even for pathological fanout."""
+
+    def __init__(self, num_rows: int, slots: int = DEFAULT_TURN_SLOTS):
+        self.num_rows = int(num_rows)
+        self.slots = int(slots)
+        self._grid = FixedGridCounts(self.num_rows * (self.slots + 1))
+        self._legend: "dict[int, list[int]]" = {}
+
+    def _slot(self, row: int, next_id: int) -> int:
+        lst = self._legend.setdefault(row, [])
+        try:
+            return lst.index(next_id)
+        except ValueError:
+            if len(lst) < self.slots:
+                lst.append(next_id)
+                return len(lst) - 1
+            return self.slots            # counted overflow, never dropped
+
+    def flat_cells(self, rows, next_ids) -> np.ndarray:
+        """(segment row, successor segment id) → flat cell; −1 when
+        there is no successor (next id < 0) or the row is unknown. The
+        Python loop runs over DISTINCT (row, successor) pairs only."""
+        rows = np.asarray(rows, np.int64)
+        next_ids = np.asarray(next_ids, np.int64)
+        ok = (rows >= 0) & (rows < self.num_rows) & (next_ids >= 0)
+        out = np.full(len(rows), -1, np.int64)
+        if not ok.any():
+            return out
+        pairs = np.stack([rows[ok], next_ids[ok]], axis=1)
+        uniq, inverse = np.unique(pairs, axis=0, return_inverse=True)
+        slots = np.asarray([self._slot(int(r), int(n)) for r, n in uniq],
+                           np.int64)
+        out[ok] = rows[ok] * (self.slots + 1) + slots[inverse]
+        return out
+
+    def update(self, rows, next_ids) -> int:
+        if len(np.asarray(rows)) == 0:
+            return 0
+        return self._grid.add(self.flat_cells(rows, next_ids))
+
+    def snapshot(self) -> np.ndarray:
+        return self._grid.snapshot().reshape(self.num_rows, self.slots + 1)
+
+    def load(self, counts) -> None:
+        self._grid.load(np.asarray(counts))
+
+    def dump_legend(self) -> dict:
+        """JSON-able legend for the checkpoint cache dump."""
+        return {str(r): [int(n) for n in lst]
+                for r, lst in self._legend.items()}
+
+    def load_legend(self, dumped: dict) -> None:
+        self._legend = {int(r): [int(n) for n in lst]
+                        for r, lst in (dumped or {}).items()}
+
+    def reference(self, rows, next_ids) -> np.ndarray:
+        return reference_counts(
+            self._grid.size, self.flat_cells(rows, next_ids)).reshape(
+                self.num_rows, self.slots + 1)
+
+
+def harvest_aggregates(hist: SpeedTodHistogram, turns: TurnCounts,
+                       osmlr_ids: np.ndarray, k: int) -> dict:
+    """ONE host readback per grid + the k-anonymity cutoff.
+
+    A segment's aggregate is persisted only when that aggregate's own
+    observation total reaches ``k`` (k = 0 still requires ≥ 1: empty
+    rows are trivially absent). Withheld segments are ABSENT from the
+    doc — never zeroed — and counted in ``kanon_dropped``."""
+    k = int(k)
+    thresh = max(k, 1)
+    h = hist.snapshot()
+    t = turns.snapshot()
+    h_tot = h.sum(axis=(1, 2))
+    t_tot = t.sum(axis=1)
+    keep_h = h_tot >= thresh
+    keep_t = t_tot >= thresh
+    observed = (h_tot > 0) | (t_tot > 0)
+    dropped = int((observed & ~keep_h & ~keep_t).sum())
+    segments: "dict[str, dict]" = {}
+    for r in np.nonzero(keep_h | keep_t)[0]:
+        seg: "dict[str, object]" = {}
+        if keep_h[r]:
+            seg["observations"] = int(h_tot[r])
+            seg["speed_tod"] = h[r].astype(int).tolist()
+        if keep_t[r]:
+            lst = turns._legend.get(int(r), [])
+            counts = {str(nid): int(t[r, s]) for s, nid in enumerate(lst)
+                      if t[r, s] > 0}
+            seg["turns"] = {"total": int(t_tot[r]), "counts": counts,
+                            "other": int(t[r, turns.slots])}
+        segments[str(int(osmlr_ids[r]))] = seg
+    return {
+        "k_anonymity": k,
+        "tod_bins": hist.tod_bins,
+        "speed_bin_edges": hist.speed_edges.tolist(),
+        "turn_slots": turns.slots,
+        "segments": segments,
+        "kanon_dropped": dropped,
+    }
+
+
+class AggregateStore:
+    """Thread-safe holder of the latest harvested doc — the service's
+    queryable aggregates face (GET /aggregates). Install-then-read only;
+    nothing in here ever touches the device."""
+
+    def __init__(self):
+        self._lock = locks.named_lock("backfill.aggregates")
+        self._doc: "dict | None" = None
+
+    def install(self, doc: dict) -> None:
+        with self._lock:
+            self._doc = doc
+
+    def snapshot(self, segment: "str | None" = None) -> "dict | None":
+        """The full doc, or one segment's block wrapped with the grid
+        metadata (None when nothing is installed / unknown segment)."""
+        with self._lock:
+            doc = self._doc
+        if doc is None:
+            return None
+        if segment is None:
+            return doc
+        seg = doc["segments"].get(str(segment))
+        if seg is None:
+            return None
+        return {k: v for k, v in doc.items() if k != "segments"} | {
+            "segment_id": str(segment), "aggregate": seg}

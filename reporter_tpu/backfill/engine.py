@@ -1,0 +1,472 @@
+"""Open-loop batch backfill engine (round 20, ROADMAP item 4).
+
+Replays a durable broker spool — records or columnar, via the
+format-pinned readers (durable_queue / durable_columnar; never forked) —
+through a three-stage pipeline sized for the axon link discipline:
+
+  1. a READ-AHEAD thread polls spooled waves, groups points into traces,
+     and batches them into trace-count-rung submit slices (the
+     scheduler's rung table — the compiled-shape universe stays the
+     pinned grid), running the r12 native prepare per slice (pure host
+     work: matcher.prepare_submit_slice);
+  2. the main loop DISPATCHES prepared slices through the existing wire
+     entries (matcher.submit_prepared — no wire fork) keeping up to
+     ``max_inflight`` chained dispatches outstanding;
+  3. each harvest is ONE host sync (np.asarray on the oldest wire) whose
+     records feed the device-side fixed-grid aggregate scatters
+     (backfill/aggregate.py) — no per-wave host readback ever; the
+     k-anonymity cutoff runs once at harvest_aggregates().
+
+Closed-loop serving waits for the host between waves (the one-core
+service curve, the wave-paced soak); this loop keeps the device busy as
+long as the spool has records — which is why ``detail.backfill`` pins
+open-loop krows/s ≥ the same tile's closed-loop soak pps.
+
+Checkpointed resume REUSES streaming/state.py's npz schema (ONE
+checkpoint spelling in the repo): committed offsets are the commit floor
+of fully-aggregated waves, and the snapshot is taken exactly at a wave
+boundary — harvest order is FIFO, so when wave W's last slice lands no
+later wave has contributed — making the on-disk (offsets, aggregates)
+pair consistent. A killed run resumes at the floor and replays only
+whole waves: aggregates stay coverage-exact and the replay tax is
+COUNTED (``records_total`` in the cache dump accumulates across runs;
+tax = records_total − spool records).
+
+The ``backfill`` fault site fires once per completed wave (r9 grammar:
+``backfill:crash@N`` kills a replay mid-spool) — the chaos test's seam.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as _queue
+import threading
+import time
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from reporter_tpu import faults
+from reporter_tpu.backfill.aggregate import (AggregateStore,
+                                             DEFAULT_TOD_BINS,
+                                             DEFAULT_TURN_SLOTS,
+                                             SpeedTodHistogram, TurnCounts,
+                                             harvest_aggregates)
+from reporter_tpu.config import Config
+from reporter_tpu.geometry import lonlat_to_xy
+from reporter_tpu.matcher.api import SegmentMatcher, Trace
+from reporter_tpu.streaming import state as stream_state
+from reporter_tpu.streaming.columnar import (build_report_columns,
+                                             pack_records)
+from reporter_tpu.streaming.durable_columnar import DurableColumnarIngestQueue
+from reporter_tpu.streaming.durable_queue import (DurableIngestQueue,
+                                                  read_broker_format)
+
+# Padding traces sit far outside any metro tile (tile-local meters are
+# metro-scale), so they match nothing and contribute zero records — rung
+# padding rides on batch-composition independence, like the scheduler's.
+_PAD_XY = 1.0e7
+
+
+@dataclass(frozen=True)
+class BackfillConfig:
+    """Open-loop engine knobs (env overrides: RTPU_BACKFILL_*)."""
+
+    slice_traces: int = 64         # traces per submit group (a scheduler
+    #                                trace-count rung — validated)
+    max_inflight: int = 4          # chained dispatches outstanding
+    readahead_slices: int = 4      # prepared slices buffered ahead
+    poll_records: int = 16384      # broker records per partition per wave.
+    #   A wave is also the per-uuid TRACE boundary (open-loop: no
+    #   cross-wave cache) — size waves in vehicle-minutes, not probes:
+    #   a wave that holds only ~20 points per vehicle yields mostly
+    #   PARTIAL segments (no complete start+end time) and few reports.
+    k_anonymity: int = 5           # harvest cutoff (0 ⇒ any observation)
+    tod_bins: int = DEFAULT_TOD_BINS
+    turn_slots: int = DEFAULT_TURN_SLOTS
+    checkpoint_path: "str | None" = None
+    checkpoint_every_waves: int = 8
+
+    def validate(self) -> "BackfillConfig":
+        from reporter_tpu.service.scheduler import _TRACE_RUNGS
+
+        if self.slice_traces not in _TRACE_RUNGS:
+            raise ValueError(
+                f"backfill.slice_traces={self.slice_traces} is not a "
+                f"scheduler trace-count rung {_TRACE_RUNGS} — off-rung "
+                "slices grow the compiled-shape universe")
+        for f, lo in (("max_inflight", 1), ("readahead_slices", 1),
+                      ("poll_records", 1), ("k_anonymity", 0),
+                      ("tod_bins", 1), ("turn_slots", 1),
+                      ("checkpoint_every_waves", 1)):
+            if getattr(self, f) < lo:
+                raise ValueError(f"backfill.{f} must be >= {lo}")
+        return self
+
+    def with_env_overrides(self, env=None) -> "BackfillConfig":
+        env = os.environ if env is None else env
+        out = self
+        # literal reads (not a name loop): the env-table lint keys on them
+        for var, raw, field in (
+                ("RTPU_BACKFILL_K", env.get("RTPU_BACKFILL_K"),
+                 "k_anonymity"),
+                ("RTPU_BACKFILL_INFLIGHT", env.get("RTPU_BACKFILL_INFLIGHT"),
+                 "max_inflight"),
+                ("RTPU_BACKFILL_READAHEAD",
+                 env.get("RTPU_BACKFILL_READAHEAD"), "readahead_slices")):
+            if raw is None or raw == "":
+                continue
+            try:
+                val = int(raw)
+            except ValueError:
+                raise ValueError(f"{var}={raw!r} is not an integer")
+            out = replace(out, **{field: val})
+        return out
+
+
+class _Group:
+    """One rung-padded submit group (== one spool wave, or a split of
+    one): bookkeeping for FIFO completion → commit-floor advance."""
+
+    __slots__ = ("traces", "work", "n_real", "remaining", "offsets",
+                 "n_records")
+
+    def __init__(self, traces, work, n_real, remaining, offsets, n_records):
+        self.traces = traces
+        self.work = work
+        self.n_real = n_real
+        self.remaining = remaining
+        self.offsets = offsets         # reader offsets after this wave's
+        #                                records (None on non-final splits)
+        self.n_records = n_records
+
+
+_DONE = object()
+
+
+class BackfillEngine:
+    """See module docstring. One engine = one tileset + one matcher."""
+
+    def __init__(self, tileset, config: "Config | None" = None,
+                 bf: "BackfillConfig | None" = None, matcher=None,
+                 store: "AggregateStore | None" = None):
+        self.ts = tileset
+        self.matcher = matcher or SegmentMatcher(tileset, config)
+        if self.matcher._native_walker is None:
+            raise RuntimeError(
+                "backfill requires the native column walker (the "
+                "columnar product path's precondition) — unset "
+                "REPORTER_TPU_NO_NATIVE / fix the native build")
+        self.config = self.matcher.config
+        self.bf = (bf or BackfillConfig()).with_env_overrides().validate()
+        self.metrics = self.matcher.metrics
+        self.store = store or AggregateStore()
+        self._osmlr_ids = np.asarray(tileset.osmlr_id)
+        self._row_order = np.argsort(self._osmlr_ids, kind="stable")
+        self._row_sorted = self._osmlr_ids[self._row_order]
+        rows = len(self._osmlr_ids)
+        # state.py checkpoint duck-typing: hist/qhist/_hist_flushed/
+        # _qhist_flushed (flush baselines are vestigial here — backfill
+        # publishes once at harvest, so they stay empty)
+        self.hist = SpeedTodHistogram(rows, self.config.streaming.speed_bins,
+                                      self.bf.tod_bins)
+        self.qhist = TurnCounts(rows, self.bf.turn_slots)
+        self._hist_flushed = np.zeros(0, np.int32)
+        self._qhist_flushed = np.zeros(0, np.int32)
+        self._records_prior = 0        # records processed by earlier
+        #                                (crashed) runs, from the checkpoint
+        self._shadow = None
+        self.stats: "dict[str, int | float]" = {}
+
+    def enable_shadow_reference(self) -> None:
+        """Accumulate a host-side numpy twin of both aggregate grids —
+        the SAME flat_cells spelling, np.add.at instead of the device
+        scatter — so a run can assert device-vs-reference identity
+        (detail.backfill's ``agg_identical`` bit). Fresh runs only: a
+        checkpoint-resumed grid starts ahead of the zeroed twin."""
+        self._shadow = {
+            "hist": np.zeros(self.hist._grid.size, np.int32),
+            "turns": np.zeros(self.qhist._grid.size, np.int32),
+        }
+
+    def shadow_identical(self) -> "bool | None":
+        """True iff both device grids equal the host twins bit-for-bit
+        (None when the shadow was never enabled)."""
+        if self._shadow is None:
+            return None
+        return bool(
+            np.array_equal(self.hist.snapshot().reshape(-1),
+                           self._shadow["hist"])
+            and np.array_equal(self.qhist.snapshot().reshape(-1),
+                               self._shadow["turns"]))
+
+    # ---- spool → traces (reader thread) ---------------------------------
+
+    def _wave_traces(self, cols) -> "tuple[list[Trace], int, int]":
+        """One wave's ProbeColumns → per-uuid time-sorted traces.
+        Returns (traces, malformed points, short traces). A uuid's
+        points split across waves become separate traces — the open
+        loop trades the streaming cache's cross-wave continuity for
+        device saturation (documented wave-boundary semantics)."""
+        good = ~np.isnan(cols.lat)
+        malformed = int((~good).sum())
+        cols = cols.rows(good)
+        if not cols.n:
+            return [], malformed, 0
+        t = np.where(np.isnan(cols.time), np.arange(cols.n, dtype=np.float64),
+                     cols.time)
+        order = np.lexsort((t, cols.uuid))
+        u, lat, lon = cols.uuid[order], cols.lat[order], cols.lon[order]
+        tt, acc = t[order], cols.accuracy[order]
+        xy = lonlat_to_xy(np.stack([lon, lat], axis=1),
+                          np.asarray(self.ts.meta.origin_lonlat))
+        bounds = np.concatenate([[0], np.nonzero(u[1:] != u[:-1])[0] + 1,
+                                 [len(u)]])
+        traces, short = [], 0
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            if hi - lo < 2:
+                short += 1
+                continue
+            a = acc[lo:hi]
+            traces.append(Trace(
+                uuid=str(u[lo]), xy=xy[lo:hi].astype(np.float32),
+                times=tt[lo:hi].astype(np.float64),
+                accuracy=(np.nan_to_num(a, nan=0.0).astype(np.float32)
+                          if np.isfinite(a).any() else None)))
+        return traces, malformed, short
+
+    def _pad_to_rung(self, traces: "list[Trace]") -> "list[Trace]":
+        from reporter_tpu.service.scheduler import _TRACE_RUNGS
+
+        rung = next((r for r in _TRACE_RUNGS if r >= len(traces)),
+                    _TRACE_RUNGS[-1])
+        pad = [Trace(uuid="", times=np.asarray([0.0, 1.0]),
+                     xy=np.asarray([[_PAD_XY, _PAD_XY],
+                                    [_PAD_XY, _PAD_XY + 1.0]], np.float32))
+               for _ in range(rung - len(traces))]
+        return list(traces) + pad
+
+    def _reader(self, queue, fmt: str, nparts: int, ends: "list[int]",
+                out_q, stop: threading.Event, err: list) -> None:
+        """Stage 1+2a: poll waves, build rung groups, run host prepare,
+        feed the bounded slice queue (backpressure = readahead bound)."""
+        try:
+            offsets = list(self._consumed)
+            while not stop.is_set():
+                if all(offsets[p] >= ends[p] for p in range(nparts)):
+                    break
+                recs = 0
+                wave_cols = []
+                for p in range(nparts):
+                    if offsets[p] >= ends[p]:
+                        continue
+                    want = min(self.bf.poll_records, ends[p] - offsets[p])
+                    if fmt == "columnar":
+                        got = queue.poll_batch(p, offsets[p], want)
+                        n = sum(c.n for _, c in got)
+                        wave_cols.extend(c for _, c in got)
+                    else:
+                        got = queue.poll(p, offsets[p], want)
+                        n = len(got)
+                        if n:
+                            wave_cols.append(
+                                pack_records([r for _, r in got]))
+                    offsets[p] += n
+                    recs += n
+                if not recs:
+                    break                        # static spool fully read
+                cols = (wave_cols[0] if len(wave_cols) == 1 else
+                        type(wave_cols[0])(*(np.concatenate(parts)
+                                             for parts in zip(*wave_cols))))
+                traces, malformed, short = self._wave_traces(cols)
+                self.stats["malformed"] += malformed
+                self.stats["short_traces"] += short
+                # split oversized waves; offsets ride the LAST group so
+                # the commit floor only advances past a whole wave
+                chunks = [traces[i:i + self.bf.slice_traces]
+                          for i in range(0, len(traces),
+                                         self.bf.slice_traces)] or [[]]
+                for j, part in enumerate(chunks):
+                    last = j == len(chunks) - 1
+                    padded = self._pad_to_rung(part)
+                    work, sliced = self.matcher.plan_submit(padded)
+                    group = _Group(padded, work, len(part), len(sliced),
+                                   list(offsets) if last else None,
+                                   recs if last else 0)
+                    for b, ws in sliced:
+                        ps = self.matcher.prepare_submit_slice(
+                            padded, work, b, ws)
+                        if stop.is_set():
+                            return
+                        out_q.put((group, ws, ps))
+            out_q.put(_DONE)
+        except BaseException as exc:   # noqa: BLE001 - relayed to main loop
+            err.append(exc)
+            out_q.put(_DONE)
+
+    # ---- harvest + aggregation (main loop) ------------------------------
+
+    def _harvest(self, group: _Group, ws, wire, done_q) -> None:
+        t0 = time.monotonic()
+        arr = np.asarray(wire)               # the ONE sync for this chunk
+        cols, _ = self.matcher.walk_wire_columns(group.traces, group.work,
+                                                 ws, arr)
+        rep = build_report_columns(
+            cols, None, self.config.service.min_segment_length)
+        seg, nxt, rt0, rt1, rlen, _rqueue, _ = rep
+        if len(seg):
+            pos = np.searchsorted(self._row_sorted, seg)
+            pos = np.minimum(pos, len(self._row_sorted) - 1)
+            rows = np.where(self._row_sorted[pos] == seg,
+                            self._row_order[pos], -1).astype(np.int64)
+            dur = rt1 - rt0
+            okd = dur > 0
+            speeds = rlen[okd] / np.maximum(dur[okd], 1e-9)
+            self.hist.update(rows[okd], rt0[okd], speeds)
+            self.qhist.update(rows, nxt)
+            if self._shadow is not None:
+                for key, cells in (
+                        ("hist", self.hist.flat_cells(rows[okd], rt0[okd],
+                                                      speeds)),
+                        ("turns", self.qhist.flat_cells(rows, nxt))):
+                    hit = cells[cells >= 0]
+                    np.add.at(self._shadow[key], hit, np.int32(1))
+            self.stats["reports"] += int(len(seg))
+        self.metrics.count("backfill_chunks_total")
+        self.metrics.observe("backfill_chunk_seconds",
+                             time.monotonic() - t0)
+        self.stats["chunks"] += 1
+        group.remaining -= 1
+        if group.remaining == 0:
+            done_q.append(group)
+
+    def _complete_groups(self, done_q, force_checkpoint=False) -> None:
+        """FIFO wave completions: counters, commit-floor advance, the
+        fault site, and the wave-boundary checkpoint."""
+        while done_q:
+            group = done_q.pop(0)
+            self.metrics.count("backfill_traces_total", group.n_real)
+            self.stats["traces"] += group.n_real
+            if group.offsets is not None:
+                self.metrics.count("backfill_records_total",
+                                   group.n_records)
+                self.metrics.count("backfill_waves_total")
+                self.stats["records"] += group.n_records
+                self.stats["waves"] += 1
+                self._consumed = list(group.offsets)
+                faults.fire("backfill")
+                if (self.bf.checkpoint_path
+                        and (force_checkpoint or self.stats["waves"]
+                             % self.bf.checkpoint_every_waves == 0)):
+                    self._write_checkpoint()
+
+    def _write_checkpoint(self) -> None:
+        cache = {"turn_legend": self.qhist.dump_legend(),
+                 "records_total": self._records_prior
+                 + self.stats["records"]}
+        stream_state.save_checkpoint(
+            self.bf.checkpoint_path, list(self._consumed), cache,
+            self.hist.snapshot(), self._hist_flushed,
+            self.qhist.snapshot(), self._qhist_flushed)
+
+    def _load_checkpoint(self) -> None:
+        path = self.bf.checkpoint_path
+        if not path:
+            return
+        npz = path if path.endswith(".npz") else path + ".npz"
+        if not os.path.exists(npz):
+            return
+        state = stream_state.load_checkpoint(path, self)
+        self._consumed = [int(x) for x in state["committed"]]
+        cache = state.get("cache", {}) or {}
+        self.qhist.load_legend(cache.get("turn_legend", {}))
+        self._records_prior = int(cache.get("records_total", 0))
+
+    # ---- the run --------------------------------------------------------
+
+    def run(self, broker_dir: str) -> dict:
+        """Replay the whole spool; returns the run's stats dict (the
+        harvested k-anonymized doc lands in self.store)."""
+        fmt = read_broker_format(broker_dir)
+        if fmt is None:
+            raise ValueError(f"{broker_dir}: not a broker directory "
+                             "(no meta.json)")
+        with open(os.path.join(broker_dir, "meta.json")) as f:
+            nparts = int(json.load(f)["num_partitions"])
+        queue_cls = (DurableColumnarIngestQueue if fmt == "columnar"
+                     else DurableIngestQueue)
+        queue = queue_cls(broker_dir, nparts)
+        self.stats = {k: 0 for k in ("records", "traces", "waves", "chunks",
+                                     "reports", "malformed", "short_traces")}
+        self._consumed = [0] * nparts
+        self._load_checkpoint()
+        try:
+            ends = [queue.end_offset(p) for p in range(nparts)]
+            spool_records = sum(ends[p] - queue.retention_floor(p)
+                                for p in range(nparts))
+            out_q: "_queue.Queue" = _queue.Queue(
+                maxsize=self.bf.readahead_slices)
+            stop = threading.Event()
+            err: list = []
+            reader = threading.Thread(
+                target=self._reader,
+                args=(queue, fmt, nparts, ends, out_q, stop, err),
+                name="backfill-reader", daemon=True)
+            t0 = time.monotonic()
+            reader.start()
+            inflight: "list[tuple]" = []
+            done_q: "list[_Group]" = []
+            try:
+                while True:
+                    item = out_q.get()
+                    if item is _DONE:
+                        break
+                    group, ws, ps = item
+                    inflight.append((group, ws,
+                                     self.matcher.submit_prepared(ps)))
+                    self.metrics.gauge("backfill_inflight", len(inflight))
+                    if len(inflight) >= self.bf.max_inflight:
+                        self._harvest(*inflight.pop(0), done_q)
+                        self._complete_groups(done_q)
+                while inflight:
+                    self._harvest(*inflight.pop(0), done_q)
+                    self._complete_groups(done_q)
+                self.metrics.gauge("backfill_inflight", 0)
+                if err:
+                    raise err[0]
+                # all waves aggregated: the floor IS the end of the spool
+                self._consumed = list(ends)
+                if self.bf.checkpoint_path:
+                    self._write_checkpoint()
+            finally:
+                stop.set()
+                # unblock a reader waiting on a full slice queue
+                while not out_q.empty():
+                    try:
+                        out_q.get_nowait()
+                    except _queue.Empty:     # pragma: no cover - race
+                        break
+                reader.join(timeout=30.0)
+            seconds = max(time.monotonic() - t0, 1e-9)
+        finally:
+            queue.close()
+        doc = self.harvest()
+        records_total = self._records_prior + self.stats["records"]
+        self.stats.update(
+            format=fmt, partitions=nparts, seconds=round(seconds, 3),
+            records_total=records_total,
+            replay_tax_records=max(0, records_total - spool_records),
+            krows_per_s=round(self.stats["records"] / seconds / 1e3, 3),
+            kanon_dropped=doc["kanon_dropped"],
+            kept_segments=len(doc["segments"]))
+        return dict(self.stats)
+
+    def harvest(self) -> dict:
+        """Host-side harvest + k-anonymity cutoff; installs the doc into
+        the store and returns it."""
+        doc = harvest_aggregates(self.hist, self.qhist, self._osmlr_ids,
+                                 self.bf.k_anonymity)
+        self.metrics.gauge("backfill_kanon_dropped", doc["kanon_dropped"])
+        self.store.install(doc)
+        return doc

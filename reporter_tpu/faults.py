@@ -38,6 +38,11 @@ Injection SITES (each consults the active plan at one seam):
               the broker-torn pattern: the monitor acts, the plan only
               schedules), driving the ``quality_drift`` post-mortem
               deterministically in chaos tests and the bench leg
+  backfill    open-loop chunk harvest (backfill/engine.py) — fires once
+              per aggregated chunk, so ``backfill:crash@N`` kills a
+              spool replay mid-stream exactly between a harvest and its
+              checkpoint: the at-least-once resume contract the chaos
+              test replays (coverage-exact aggregates, counted tax)
 
 Rules are windows over a per-site CALL COUNTER (0-based), so a plan is
 deterministic run to run regardless of wall clock; the optional ``p``
@@ -66,7 +71,7 @@ from dataclasses import dataclass, field
 from reporter_tpu.utils import locks
 
 SITES = ("publish", "checkpoint", "broker", "dispatch", "fleet_promote",
-         "quality")
+         "quality", "backfill")
 KINDS = ("fail", "crash", "hang", "torn")
 
 

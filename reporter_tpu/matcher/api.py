@@ -19,7 +19,7 @@ from __future__ import annotations
 import threading
 from collections.abc import Sequence as _SequenceABC
 from dataclasses import dataclass
-from typing import Any, Sequence
+from typing import Any, NamedTuple, Sequence
 
 import numpy as np
 
@@ -45,6 +45,26 @@ from reporter_tpu.utils.watchdog import AbandonedThreadWatchdog
 # (analysis/compile_manifest.py): changing it requires regenerating the
 # golden manifest (`python -m reporter_tpu.analysis --update-manifest`).
 _BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
+
+
+class PreparedSlice(NamedTuple):
+    """One submit slice after host-side prepare, before dispatch.
+
+    The round-20 prepare/dispatch seam: ``prepare_submit_slice`` is pure
+    host work (the r12 native prepare pass + accuracy scaling) and safe
+    to run on a read-ahead thread, while ``submit_prepared`` only
+    dispatches through the existing wire entries — so an open-loop
+    caller (backfill/engine.py) overlaps prepare of slice k+1 with
+    device execution of slice k without re-packing anything."""
+
+    b: int                       # point bucket (padded length)
+    ws: "list[int]"              # work indices (Morton order preserved)
+    mode: int                    # 2 = i8 delta, 1 = i16 quantized, 0 = f32
+    pts: Any                     # f32 points (mode 0 path)
+    lens: Any
+    origins: Any
+    payload: Any
+    scale: "np.ndarray | None"   # accuracy → emission scale, or None
 
 
 class DispatchTimeout(RuntimeError):
@@ -756,24 +776,13 @@ class SegmentMatcher:
         return build_segments(self.ts, chains, self._route_fn,
                               self.params.backward_slack)
 
-    def _submit_many(self, traces: Sequence[Trace]):
-        """Submit every trace slice to the device (async dispatches).
+    def plan_submit(self, traces: Sequence[Trace]):
+        """The submit PLAN: work list + Morton-sorted bucket slices.
 
-        Returns (work, inflight): work[w] = (trace index, chunk offset,
-        xy); inflight = [(slice work indices, wire device array)] in
-        submission order. Harvesting an inflight wire (np.asarray) blocks
-        on the link; callers decide what to overlap with that wait.
-
-        The per-slice prepare — pad → i16 quantize → i8 delta pack with
-        the exact overflow fallbacks — is ONE implementation in two
-        forms (matcher/native_prepare): the C entry when the library is
-        up, the byte-identical numpy reference otherwise. Which form
-        served is counted (prepare_native_total / prepare_python_total)
-        so a silent native-build failure degrading to Python shows at
-        /stats and /metrics.
-        """
-        from reporter_tpu.matcher import native_prepare
-
+        Returns (work, sliced): work[w] = (trace index, chunk offset,
+        xy); sliced = [(bucket, [work indices])] in submission order.
+        Pure host bookkeeping — the first half of the round-20
+        prepare/dispatch seam (see PreparedSlice)."""
         self._require_staged()
         max_b = _BUCKETS[-1]
         # Traces beyond the largest bucket are decoded in consecutive chunks
@@ -802,49 +811,85 @@ class SegmentMatcher:
         sliced = [(b, ws[i:i + chunk])
                   for b, ws in sorted(by_bucket.items())
                   for i in range(0, len(ws), chunk)]
-        # Two phases: submit every slice (dispatches are async), then
-        # harvest. Device compute and device→host transfers of slice k
-        # overlap with the transfer of slice k-1 — on a remote-attached
-        # chip the link round-trip otherwise serializes with compute.
+        return work, sliced
+
+    def prepare_submit_slice(self, traces: Sequence[Trace], work,
+                             b: int, ws: "list[int]") -> PreparedSlice:
+        """Host-side prepare of one plan slice — NO device work, safe on
+        a read-ahead thread.
+
+        The per-slice prepare — pad → i16 quantize → i8 delta pack with
+        the exact overflow fallbacks — is ONE implementation in two
+        forms (matcher/native_prepare): the C entry when the library is
+        up, the byte-identical numpy reference otherwise. Which form
+        served is counted (prepare_native_total / prepare_python_total)
+        so a silent native-build failure degrading to Python shows at
+        /stats and /metrics.
+        """
+        from reporter_tpu.matcher import native_prepare
+
+        B = len(ws)
+        xys = [work[w][2] for w in ws]
+        # Quantized infeed (half the host→device bytes): i16 0.25 m
+        # offsets from per-trace origins, unless some trace spans
+        # beyond the i16 range (±8.19 km from its first point);
+        # preferred form is i8 per-step DELTAS of the i16 quanta —
+        # integer diffs cumsum back to the exact same absolutes on
+        # device, so it is bit-identical to the i16 path at half the
+        # bytes. The mode decision + buffer fill is the prepare
+        # entry (native C pass, or the byte-identical numpy form).
+        prep = native_prepare.prepare_slice(xys, b)
+        if prep is None:
+            prep = native_prepare.prepare_slice_python(xys, b)
+            self.metrics.count("prepare_python_total")
+        else:
+            self.metrics.count("prepare_native_total")
+        mode, pts, lens, origins, payload = prep
+        # Per-point GPS accuracy → emission distance scaling (see
+        # ops/match.match_traces). None for accuracy-less slices: the
+        # scale-free executable is traced separately, so the common
+        # case pays neither transfer nor compute for the feature.
+        scale = None
+        if any(traces[work[w][0]].accuracy is not None for w in ws):
+            scale = np.ones((B, b), np.float32)
+            for r, w in enumerate(ws):
+                i, lo, xy = work[w]
+                a = traces[i].accuracy
+                if a is not None:
+                    scale[r] = _accuracy_scale(
+                        a[lo:lo + len(xy)], self.params.sigma_z, b)
+        return PreparedSlice(b, list(ws), mode, pts, lens, origins,
+                             payload, scale)
+
+    def submit_prepared(self, ps: PreparedSlice):
+        """Async dispatch of a prepared slice. The wire programs are the
+        EXISTING entries (`ops.match.wire_from_*` via self._wire) — the
+        seam adds no wire fork, only a submission boundary. Returns the
+        in-flight wire device array (np.asarray harvests it)."""
+        if ps.mode == 2:
+            return self._wire.q8(ps.payload, ps.origins, ps.lens, ps.scale)
+        if ps.mode == 1:
+            return self._wire.q16(ps.payload, ps.origins, ps.lens, ps.scale)
+        return self._wire.f32(ps.pts, ps.lens, ps.scale)
+
+    def _submit_many(self, traces: Sequence[Trace]):
+        """Submit every trace slice to the device (async dispatches).
+
+        Returns (work, inflight): work[w] = (trace index, chunk offset,
+        xy); inflight = [(slice work indices, wire device array)] in
+        submission order. Harvesting an inflight wire (np.asarray) blocks
+        on the link; callers decide what to overlap with that wait.
+
+        Two phases: submit every slice (dispatches are async), then
+        harvest. Device compute and device→host transfers of slice k
+        overlap with the transfer of slice k-1 — on a remote-attached
+        chip the link round-trip otherwise serializes with compute.
+        """
+        work, sliced = self.plan_submit(traces)
         inflight = []
         for b, ws in sliced:
-            B = len(ws)
-            xys = [work[w][2] for w in ws]
-            # Quantized infeed (half the host→device bytes): i16 0.25 m
-            # offsets from per-trace origins, unless some trace spans
-            # beyond the i16 range (±8.19 km from its first point);
-            # preferred form is i8 per-step DELTAS of the i16 quanta —
-            # integer diffs cumsum back to the exact same absolutes on
-            # device, so it is bit-identical to the i16 path at half the
-            # bytes. The mode decision + buffer fill is the prepare
-            # entry (native C pass, or the byte-identical numpy form).
-            prep = native_prepare.prepare_slice(xys, b)
-            if prep is None:
-                prep = native_prepare.prepare_slice_python(xys, b)
-                self.metrics.count("prepare_python_total")
-            else:
-                self.metrics.count("prepare_native_total")
-            mode, pts, lens, origins, payload = prep
-            # Per-point GPS accuracy → emission distance scaling (see
-            # ops/match.match_traces). None for accuracy-less slices: the
-            # scale-free executable is traced separately, so the common
-            # case pays neither transfer nor compute for the feature.
-            scale = None
-            if any(traces[work[w][0]].accuracy is not None for w in ws):
-                scale = np.ones((B, b), np.float32)
-                for r, w in enumerate(ws):
-                    i, lo, xy = work[w]
-                    a = traces[i].accuracy
-                    if a is not None:
-                        scale[r] = _accuracy_scale(
-                            a[lo:lo + len(xy)], self.params.sigma_z, b)
-            if mode == 2:
-                wire = self._wire.q8(payload, origins, lens, scale)
-            elif mode == 1:
-                wire = self._wire.q16(payload, origins, lens, scale)
-            else:
-                wire = self._wire.f32(pts, lens, scale)
-            inflight.append((ws, wire))
+            ps = self.prepare_submit_slice(traces, work, b, ws)
+            inflight.append((ws, self.submit_prepared(ps)))
         return work, inflight
 
     def _decode_many(self, traces: Sequence[Trace]):
@@ -901,8 +946,6 @@ class SegmentMatcher:
             with self.metrics.stage("walk"):
                 return self._walk_decoded(traces, decoded)
 
-        from reporter_tpu.ops.match import unpack_wire
-
         with self.metrics.stage("decode"):
             work, inflight = self._submit_many(traces)
         slice_cols: list = [None] * len(inflight)
@@ -910,21 +953,9 @@ class SegmentMatcher:
 
         def walk_slice(k, ws, arr):
             nonlocal unmatched
-            # mesh path pads rows to a device-count multiple: drop them
-            edges, offs, starts = unpack_wire(arr[:len(ws)], self._wire_spec)
-            B, T = edges.shape
-            times = np.zeros((B, T), np.float64)
-            pad = 0
-            for r, w in enumerate(ws):
-                i, _, xy = work[w]
-                times[r, :len(xy)] = traces[i].times[:len(xy)]
-                pad += T - len(xy)      # padded tail decodes unmatched
-            unmatched += int((edges < 0).sum()) - pad
-            cols = self._native_walker.walk_columns(
-                edges, offs, starts, times, self.params.backward_slack)
-            # slice row → global trace index (ws is Morton-sorted)
-            row_to_trace = np.asarray([work[w][0] for w in ws], np.int32)
-            slice_cols[k] = cols._replace(trace=row_to_trace[cols.trace])
+            cols, un = self.walk_wire_columns(traces, work, ws, arr)
+            unmatched += un
+            slice_cols[k] = cols
 
         with self.metrics.stage("walk"):
             _harvest_overlapped(inflight, walk_slice)
@@ -932,6 +963,30 @@ class SegmentMatcher:
         if quality_hold is not None:
             quality_hold["unmatched"] = unmatched
         return MatchBatch(_merge_columns(slice_cols), len(traces))
+
+    def walk_wire_columns(self, traces: Sequence[Trace], work,
+                          ws: "list[int]", arr: np.ndarray):
+        """Unpack + native column-walk of ONE harvested slice's wire
+        bytes → (RecordColumns with GLOBAL trace indices, unmatched
+        point count). The harvest half of the round-20 seam — requires
+        the native walker (the columnar product path's precondition)."""
+        from reporter_tpu.ops.match import unpack_wire
+
+        # mesh path pads rows to a device-count multiple: drop them
+        edges, offs, starts = unpack_wire(arr[:len(ws)], self._wire_spec)
+        B, T = edges.shape
+        times = np.zeros((B, T), np.float64)
+        pad = 0
+        for r, w in enumerate(ws):
+            i, lo, xy = work[w]
+            times[r, :len(xy)] = traces[i].times[lo:lo + len(xy)]
+            pad += T - len(xy)          # padded tail decodes unmatched
+        unmatched = int((edges < 0).sum()) - pad
+        cols = self._native_walker.walk_columns(
+            edges, offs, starts, times, self.params.backward_slack)
+        # slice row → global trace index (ws is Morton-sorted)
+        row_to_trace = np.asarray([work[w][0] for w in ws], np.int32)
+        return cols._replace(trace=row_to_trace[cols.trace]), unmatched
 
     def _walk_decoded(self, traces: Sequence[Trace],
                       decoded) -> list[list[SegmentRecord]]:

@@ -1,0 +1,89 @@
+"""Fixed-grid device aggregation scatter (backfill, round 20).
+
+Generalizes ``streaming/histogram.py``'s scatter discipline — an i32
+device accumulator updated by ONE jit'd scatter-add with a FIXED update
+batch shape (the r12 lesson: jit TRACE+LOWER is per process per shape and
+not covered by the persistent compile cache, so a shape-varying scatter
+drops ~150 ms of trace cost into whichever measured wave first hits a new
+cap) — from the histogram's [rows, bins] 2-D grid to an arbitrary FLAT
+grid. Callers (backfill/aggregate.py) own the host-side binning that
+turns an observation into a flat cell index; this module owns only the
+device residency + chunked padded scatter, so every backfill aggregate
+(speed × time-of-day histogram, next-segment turn counts) rides the same
+audited kernel instead of growing one scatter per grid shape.
+
+The numpy reference accumulation lives here too: the device scatter must
+stay bit-equal to it over the same index stream (property-tested across
+chunk boundaries and the pad path in tests/test_backfill.py, and
+re-asserted on every bench composite's ``detail.backfill`` leg).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# ONE update-batch shape for the jit'd scatter, same value and same
+# reason as SpeedHistogram._CAP: updates pad to it, bigger batches chunk
+# through it, and the executable compiles once in the warm-up chunk.
+_CAP = 4096
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_add(grid, idx, ok):
+    # dtype pinned exactly like histogram._accumulate: the bool cast
+    # materializes the update in i32 regardless of x64 mode (the
+    # device-contract x64 audit covers this jaxpr too).
+    upd = ok.astype(jnp.int32)
+    return grid.at[jnp.maximum(idx, 0)].add(upd)
+
+
+class FixedGridCounts:
+    """i32 flat [size] device counts; add() scatters host-binned flat
+    cell indices. Out-of-range / negative indices are masked (counted in
+    the return value as rejected), never clamped into a real cell."""
+
+    def __init__(self, size: int):
+        self.size = int(size)
+        assert 0 < self.size < 2 ** 31, self.size   # i32 index space
+        self._grid = jnp.zeros(self.size, jnp.int32)
+
+    def add(self, idx: np.ndarray) -> int:
+        """One observation per flat index; returns the accepted count."""
+        if len(idx) == 0:
+            return 0
+        idx = np.asarray(idx, np.int64)
+        ok = (idx >= 0) & (idx < self.size)
+        idx32 = np.where(ok, idx, -1).astype(np.int32)
+        for lo in range(0, len(idx32), _CAP):
+            i = idx32[lo:lo + _CAP]
+            o = ok[lo:lo + _CAP]
+            pad = _CAP - len(i)
+            if pad:
+                i = np.pad(i, (0, pad))
+                o = np.pad(o, (0, pad))
+            self._grid = _scatter_add(self._grid, jnp.asarray(i),
+                                      jnp.asarray(o))
+        return int(ok.sum())
+
+    def snapshot(self) -> np.ndarray:
+        """Host copy (the ONE readback — harvest/checkpoint only)."""
+        return np.asarray(self._grid)
+
+    def load(self, grid: np.ndarray) -> None:
+        grid = np.asarray(grid).reshape(-1)
+        assert grid.shape == (self.size,), (grid.shape, self.size)
+        self._grid = jnp.asarray(grid.astype(np.int32))
+
+
+def reference_counts(size: int, idx: np.ndarray) -> np.ndarray:
+    """Numpy reference of the device accumulation: what a FixedGridCounts
+    snapshot must equal bit-for-bit after add(idx) from zero state."""
+    grid = np.zeros(int(size), np.int32)
+    idx = np.asarray(idx, np.int64)
+    ok = (idx >= 0) & (idx < size)
+    np.add.at(grid, idx[ok], np.int32(1))
+    return grid

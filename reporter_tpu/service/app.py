@@ -25,6 +25,7 @@ import math
 import threading
 import time
 from typing import Any, Callable, Iterable
+from urllib.parse import parse_qs
 
 from reporter_tpu.utils import locks
 from reporter_tpu.config import Config
@@ -126,7 +127,8 @@ class ReporterApp:
 
     def __init__(self, tileset: TileSet, config: Config | None = None,
                  transport: Transport | None = None, mesh=None,
-                 matcher: "SegmentMatcher | None" = None):
+                 matcher: "SegmentMatcher | None" = None,
+                 aggregates=None):
         self.config = (config or Config()).validate()
         svc = self.config.service
         tracing.configure_from_service(svc)   # span recorder (global)
@@ -153,6 +155,11 @@ class ReporterApp:
             transport=transport,
             **publisher_kwargs(svc, metrics=self.matcher.metrics))
         self.min_segment_length = svc.min_segment_length
+        # queryable backfill aggregates (round 20): an AggregateStore a
+        # backfill run installed its harvested k-anonymized doc into —
+        # GET /aggregates serves it read-only; None ⇒ 404s (serving and
+        # backfill share a process only when the operator wires them)
+        self.aggregates = aggregates
         self._lock = locks.named_lock("app.combine")  # combine mode: one batch in flight
         self._pending: list[_Submission] = []
         self._pending_lock = locks.named_lock("app.pending")
@@ -412,6 +419,21 @@ class ReporterApp:
                 return _respond_text(
                     start_response, 200,
                     self.matcher.metrics.render_prometheus())
+            if path == "/aggregates" and method == "GET":
+                # backfill's harvested per-segment doc (round 20):
+                # already k-anonymized at harvest — this face only reads
+                if self.aggregates is None:
+                    return _respond(start_response, 404,
+                                    {"error": "no aggregates wired"})
+                qs = parse_qs(environ.get("QUERY_STRING", ""))
+                segment = (qs.get("segment") or [None])[0]
+                doc = self.aggregates.snapshot(segment)
+                if doc is None:
+                    return _respond(
+                        start_response, 404,
+                        {"error": ("unknown segment" if segment
+                                   else "no backfill harvest installed")})
+                return _respond(start_response, 200, doc)
             if path == "/report" and method == "POST":
                 body = _read_json(environ)
                 self._bump("requests")

@@ -1,0 +1,323 @@
+"""Open-loop backfill engine (round 20).
+
+Three layers, innermost out:
+
+  - ops/aggregate.FixedGridCounts: the device scatter must stay
+    BIT-equal to the numpy reference over the same flat index stream —
+    property-tested across ``_CAP`` chunk boundaries (the pad path
+    included) and across incremental add() splits.
+  - backfill/aggregate: SpeedTodHistogram / TurnCounts binning parity
+    (one flat_cells spelling shared by device and reference), the
+    turn-slot legend's first-seen + counted-overflow semantics, and the
+    k-anonymity cutoff's EXACTNESS — a below-k segment is ABSENT from
+    the harvested doc, never present-but-zeroed.
+  - backfill/engine e2e over BOTH format-pinned broker spools (records
+    and columnar), the device-vs-shadow identity bit, and the
+    checkpointed-resume chaos path: ``backfill:crash@N`` → fresh engine
+    → coverage-exact aggregates with a COUNTED replay tax.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from reporter_tpu import faults
+from reporter_tpu.backfill import BackfillConfig, BackfillEngine
+from reporter_tpu.backfill.aggregate import (SpeedTodHistogram, TurnCounts,
+                                             harvest_aggregates)
+from reporter_tpu.config import CompilerParams, Config
+from reporter_tpu.matcher.api import SegmentMatcher
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_fleet
+from reporter_tpu.ops.aggregate import _CAP, FixedGridCounts, reference_counts
+from reporter_tpu.streaming.columnar import pack_records
+from reporter_tpu.streaming.durable_columnar import DurableColumnarIngestQueue
+from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+from reporter_tpu.tiles.compiler import compile_network
+
+
+@pytest.fixture(scope="module")
+def tiles():
+    # the streaming-fixture compile shape: short OSMLR spans so segment
+    # transitions are directly observed and reports have BOTH boundary
+    # times (huge merged spans yield ~zero complete records)
+    return compile_network(
+        generate_city("tiny"),
+        CompilerParams(reach_radius=500.0, osmlr_max_length=200.0))
+
+
+@pytest.fixture(scope="module")
+def matcher(tiles):
+    m = SegmentMatcher(tiles, Config(matcher_backend="jax"))
+    if m._native_walker is None:
+        pytest.skip("backfill requires the native column walker")
+    return m
+
+
+def _fleet_records(ts, n_veh=16, n_pt=80, seed=5):
+    """Interleaved canonical record dicts (firehose arrival order)."""
+    probes = synthesize_fleet(ts, n_veh, num_points=n_pt, seed=seed,
+                              gps_sigma=3.0)
+    records = []
+    for t in range(max(len(p.times) for p in probes)):
+        for p in probes:
+            if t < len(p.times):
+                records.append({"uuid": p.uuid,
+                                "lat": float(p.lonlat[t, 1]),
+                                "lon": float(p.lonlat[t, 0]),
+                                "time": float(p.times[t])})
+    return records
+
+
+# ---------------------------------------------------------------------------
+# ops/aggregate: device scatter vs numpy reference
+
+
+@pytest.mark.parametrize("n", [0, 1, _CAP - 1, _CAP, _CAP + 1,
+                               3 * _CAP + 17])
+def test_scatter_matches_reference_across_chunk_boundaries(n):
+    """One add() call of every length around the fixed update-batch
+    shape — the pad path (n % _CAP != 0) and the multi-chunk path must
+    both equal the numpy accumulation bit-for-bit."""
+    size = 257
+    rng = np.random.default_rng(n)
+    # in-range, negative, and past-the-end indices all in one stream:
+    # rejects must be masked out of the grid, never clamped into cell 0
+    idx = rng.integers(-5, size + 5, size=n)
+    g = FixedGridCounts(size)
+    accepted = g.add(idx)
+    ref = reference_counts(size, idx)
+    np.testing.assert_array_equal(g.snapshot(), ref)
+    assert accepted == int(((idx >= 0) & (idx < size)).sum())
+    assert g.snapshot().sum() == accepted    # rejected rows hit NO cell
+
+
+def test_scatter_incremental_adds_equal_one_stream():
+    """Splitting a stream across add() calls (uneven splits straddling
+    _CAP) accumulates identically to the whole stream at once."""
+    size = 97
+    rng = np.random.default_rng(7)
+    idx = rng.integers(-3, size + 3, size=2 * _CAP + 31)
+    g = FixedGridCounts(size)
+    cuts = [0, 13, _CAP - 1, _CAP + 500, len(idx)]
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        g.add(idx[lo:hi])
+    np.testing.assert_array_equal(g.snapshot(), reference_counts(size, idx))
+
+
+def test_scatter_load_roundtrip():
+    g = FixedGridCounts(11)
+    g.add(np.array([1, 1, 4]))
+    snap = g.snapshot()
+    g2 = FixedGridCounts(11)
+    g2.load(snap)
+    g2.add(np.array([4]))
+    expected = snap.copy()
+    expected[4] += 1
+    np.testing.assert_array_equal(g2.snapshot(), expected)
+
+
+# ---------------------------------------------------------------------------
+# backfill/aggregate: binning parity + turn-slot semantics
+
+
+def test_speed_tod_histogram_matches_reference():
+    edges = [0.0, 2.0, 5.0, 10.0, 20.0]
+    h = SpeedTodHistogram(num_rows=7, speed_edges=edges, tod_bins=6)
+    rng = np.random.default_rng(3)
+    n = _CAP + 123                       # force the chunked path once
+    rows = rng.integers(-1, 8, size=n)   # includes unknown rows
+    times = rng.uniform(-1e5, 2e5, size=n)   # mod-day wrap both ways
+    speeds = rng.uniform(-1.0, 30.0, size=n)  # negatives → no cell
+    h.update(rows, times, speeds)
+    np.testing.assert_array_equal(h.snapshot(),
+                                  h.reference(rows, times, speeds))
+    # negative speed / unknown row contribute to NO cell
+    cells = h.flat_cells(rows, times, speeds)
+    assert (cells[(speeds < 0) | (rows < 0) | (rows >= 7)] == -1).all()
+    assert h.snapshot().sum() == int((cells >= 0).sum())
+
+
+def test_turn_counts_match_reference_and_legend_is_first_seen():
+    t = TurnCounts(num_rows=4, slots=2)
+    rows = np.array([0, 0, 0, 1, 0, -1, 2])
+    nxt = np.array([9, 9, 7, 7, 9, 5, -1])
+    t.update(rows, nxt)
+    np.testing.assert_array_equal(t.snapshot(), t.reference(rows, nxt))
+    # within one update the legend fills in sorted-unique (row, next)
+    # order (flat_cells loops over np.unique pairs); across updates it
+    # is first-seen. No successor / unknown row = no cell.
+    assert t._legend[0] == [7, 9]
+    assert t._legend[1] == [7]
+    assert 2 not in t._legend            # nxt < 0 never opens a legend
+    snap = t.snapshot()
+    assert snap[0, 1] == 3 and snap[0, 0] == 1 and snap[1, 0] == 1
+    assert snap.sum() == 5
+    # a LATER update never reshuffles established slots
+    t.update(np.array([0]), np.array([9]))
+    assert t._legend[0] == [7, 9] and t.snapshot()[0, 1] == 4
+
+
+def test_turn_counts_overflow_lands_in_other_slot():
+    """Successors past ``slots`` are COUNTED in the final slot, never
+    silently dropped — ratio denominators stay exact."""
+    t = TurnCounts(num_rows=1, slots=2)
+    rows = np.zeros(6, np.int64)
+    nxt = np.array([10, 11, 12, 13, 12, 10])   # 4 distinct, 2 slots
+    t.update(rows, nxt)
+    snap = t.snapshot()
+    assert t._legend[0] == [10, 11]
+    assert snap[0, 0] == 2 and snap[0, 1] == 1   # 10×2, 11×1
+    assert snap[0, 2] == 3                        # 12, 13, 12 → other
+    assert snap.sum() == len(nxt)
+    np.testing.assert_array_equal(snap, t.reference(rows, nxt))
+
+
+def test_turn_legend_dump_load_roundtrip():
+    t = TurnCounts(num_rows=3, slots=2)
+    t.update(np.array([0, 2]), np.array([5, 8]))
+    t2 = TurnCounts(num_rows=3, slots=2)
+    t2.load_legend(json.loads(json.dumps(t.dump_legend())))
+    assert t2._legend == t._legend
+    # restored legend keeps slot assignment stable for known successors
+    cells = t2.flat_cells(np.array([0]), np.array([5]))
+    assert cells[0] == 0 * 3 + 0
+
+
+# ---------------------------------------------------------------------------
+# k-anonymity: below-k segments are ABSENT, never zeroed
+
+
+def _tiny_aggregates(counts_per_row, turn_rows=(), turn_nxt=()):
+    """hist with ``counts_per_row[r]`` observations in row r."""
+    h = SpeedTodHistogram(num_rows=len(counts_per_row),
+                          speed_edges=[0.0, 5.0], tod_bins=2)
+    for r, c in enumerate(counts_per_row):
+        if c:
+            h.update(np.full(c, r), np.zeros(c), np.ones(c))
+    t = TurnCounts(num_rows=len(counts_per_row), slots=2)
+    if len(turn_rows):
+        t.update(np.asarray(turn_rows), np.asarray(turn_nxt))
+    return h, t
+
+
+def test_kanon_below_threshold_segment_is_absent():
+    h, t = _tiny_aggregates([5, 3, 0])
+    ids = np.array([100, 101, 102])
+    doc = harvest_aggregates(h, t, ids, k=4)
+    assert set(doc["segments"]) == {"100"}        # 101 withheld, 102 empty
+    assert doc["kanon_dropped"] == 1              # only OBSERVED-but-cut
+    assert doc["segments"]["100"]["observations"] == 5
+    # the withheld segment must be indistinguishable from unobserved:
+    # absent key, not a zeroed block
+    assert "101" not in doc["segments"] and "102" not in doc["segments"]
+
+
+def test_kanon_zero_still_requires_one_observation():
+    h, t = _tiny_aggregates([0, 2])
+    doc = harvest_aggregates(h, t, np.array([7, 8]), k=0)
+    assert set(doc["segments"]) == {"8"}
+    assert doc["kanon_dropped"] == 0
+
+
+def test_kanon_cutoff_is_per_aggregate():
+    """A row can clear k on turns while its histogram stays withheld —
+    each aggregate's own total gates its block."""
+    h, t = _tiny_aggregates([1, 0], turn_rows=[0, 0, 0], turn_nxt=[9, 9, 9])
+    doc = harvest_aggregates(h, t, np.array([40, 41]), k=3)
+    seg = doc["segments"]["40"]
+    assert "speed_tod" not in seg                 # hist total 1 < 3
+    assert seg["turns"]["total"] == 3 and seg["turns"]["counts"] == {"9": 3}
+    assert doc["kanon_dropped"] == 0              # the row IS published
+
+
+# ---------------------------------------------------------------------------
+# engine e2e: both broker formats, shadow identity, chaos resume
+
+
+def _bf(ck=None, **kw):
+    kw.setdefault("slice_traces", 32)
+    kw.setdefault("max_inflight", 2)
+    # per partition per wave: 2 partitions × 256 over the ~1280-record
+    # fleet ⇒ ≥3 waves, so a crash@2 plan has a 3rd wave to fire on
+    kw.setdefault("poll_records", 256)
+    kw.setdefault("k_anonymity", 1)
+    return BackfillConfig(checkpoint_path=ck, checkpoint_every_waves=1,
+                          **kw)
+
+
+def test_engine_columnar_spool_e2e(tiles, matcher, tmp_path):
+    records = _fleet_records(tiles)
+    broker = str(tmp_path / "spool")
+    q = DurableColumnarIngestQueue(broker, 2)
+    for lo in range(0, len(records), 300):
+        q.append_columns(pack_records(records[lo:lo + 300]))
+    q.close()
+
+    eng = BackfillEngine(tiles, matcher=matcher, bf=_bf())
+    eng.enable_shadow_reference()
+    stats = eng.run(broker)
+    assert stats["format"] == "columnar"
+    assert stats["records"] == len(records)
+    assert stats["records_total"] == len(records)
+    assert stats["replay_tax_records"] == 0
+    assert stats["reports"] > 0 and stats["waves"] > 0
+    # device grids == host np.add.at twin over the same flat_cells
+    assert eng.shadow_identical() is True
+    doc = eng.store.snapshot()
+    assert doc["segments"] and doc["k_anonymity"] == 1
+    seg_id = next(iter(doc["segments"]))
+    one = eng.store.snapshot(seg_id)
+    assert one["segment_id"] == seg_id and "aggregate" in one
+    assert eng.store.snapshot("no-such-segment") is None
+
+
+def test_engine_records_spool_chaos_resume_is_coverage_exact(
+        tiles, matcher, tmp_path):
+    """Crash mid-spool via the ``backfill`` fault site, restart a fresh
+    engine from the checkpoint: final aggregates BYTE-equal the clean
+    run's, and every re-processed record is counted as replay tax."""
+    records = _fleet_records(tiles, seed=6)
+    broker = str(tmp_path / "spool")
+    q = DurableIngestQueue(broker, 2)
+    q.append_many(records)
+    q.close()
+
+    clean = BackfillEngine(tiles, matcher=matcher,
+                           bf=_bf(str(tmp_path / "ck_clean")))
+    stats_clean = clean.run(broker)
+    assert stats_clean["format"] == "records"
+    assert stats_clean["records"] == len(records)
+    doc_clean = clean.store.snapshot()
+
+    ck = str(tmp_path / "ck_chaos")
+    with pytest.raises(faults.InjectedCrash):
+        with faults.use(faults.FaultPlan.parse("backfill:crash@2")):
+            BackfillEngine(tiles, matcher=matcher, bf=_bf(ck)).run(broker)
+    assert os.path.exists(ck + ".npz")   # waves 0-1 checkpointed pre-crash
+
+    resumed = BackfillEngine(tiles, matcher=matcher, bf=_bf(ck))
+    stats = resumed.run(broker)
+    # coverage-exact: the resumed doc is the clean doc, bit for bit
+    assert (json.dumps(resumed.store.snapshot(), sort_keys=True)
+            == json.dumps(doc_clean, sort_keys=True))
+    # the tax is COUNTED, not hidden: total processed = spool + replay
+    assert stats["records_total"] >= len(records)
+    assert (stats["replay_tax_records"]
+            == stats["records_total"] - len(records))
+
+
+def test_config_validation_and_env_overrides():
+    with pytest.raises(ValueError, match="trace-count rung"):
+        BackfillConfig(slice_traces=33).validate()
+    with pytest.raises(ValueError, match="max_inflight"):
+        BackfillConfig(max_inflight=0).validate()
+    cfg = BackfillConfig().with_env_overrides(
+        {"RTPU_BACKFILL_K": "9", "RTPU_BACKFILL_INFLIGHT": "2",
+         "RTPU_BACKFILL_READAHEAD": ""})
+    assert cfg.k_anonymity == 9 and cfg.max_inflight == 2
+    assert cfg.readahead_slices == BackfillConfig().readahead_slices
+    with pytest.raises(ValueError, match="RTPU_BACKFILL_K"):
+        BackfillConfig().with_env_overrides({"RTPU_BACKFILL_K": "many"})

@@ -336,6 +336,46 @@ def test_bench_legs_topology_cli(tmp_path):
     assert res["worker_exit_reports_ok"] is True
 
 
+def test_bench_legs_backfill_cli(tmp_path):
+    """Round-20 acceptance: `python bench.py --legs backfill` runs the
+    self-contained open-vs-closed spool replay on the no-chip path —
+    both arms drain the same durable columnar spool, the open loop is
+    no slower (the one-core acceptance bar), the device-vs-reference
+    aggregate identity bit is green — journals the leg, records the bf
+    summary token, and writes the PARTIAL detail file only."""
+    env = dict(os.environ)
+    env["REPORTER_BENCH_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    cpu_capture = os.path.join(os.path.dirname(_BENCH),
+                               "BENCH_DETAIL_CPU.json")
+    committed = (open(cpu_capture).read()
+                 if os.path.exists(cpu_capture) else None)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(_BENCH), "--legs", "backfill"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        timeout=420, env=env, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stdout[-2000:]
+    summary = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    krows, vs_soak, agg_ok, kanon = summary["bf"]
+    assert krows and krows > 0
+    assert vs_soak is not None and vs_soak >= 1.0   # open ≥ closed (CPU)
+    assert agg_ok == 1                    # device == numpy reference
+    assert kanon is not None and kanon >= 0
+    if committed is not None:             # no-clobber (r15 rule)
+        assert open(cpu_capture).read() == committed
+    journal_path = os.path.join(os.path.dirname(os.path.abspath(_BENCH)),
+                                "bench_journal.jsonl")
+    entries = [json.loads(ln)
+               for ln in open(journal_path).read().splitlines()]
+    legs = {e.get("leg"): e for e in entries[1:]}
+    assert "backfill" in legs
+    res = legs["backfill"]["result"]
+    assert res["open_ge_closed_ok"] is True
+    assert res["open_loop"]["agg_identical"] is True
+    assert res["open_loop"]["replay_tax_records"] == 0
+    assert res["records"] > 0 and res["open_loop"]["reports"] > 0
+
+
 def test_bench_rejects_unknown_legs():
     env = dict(os.environ)
     out = subprocess.run(

@@ -142,7 +142,9 @@ def test_summary_line_carries_roofline_era_fields():
     assert line["coe2e_kpps"][3] == 1800    # bayarea-xl fourth
     assert line["sweep_kpps"] == [3500, 3000, 3700, 1]
     assert line["mxu"] == [3.7, 2.9, 1]
-    assert line["svc_edge"] == 512
+    # r20 compaction: the overload boundary rides the svc array's LAST
+    # slot (the dedicated svc_edge key paid for the bf token)
+    assert line["svc"][-1] == 512
     # one False identity bit anywhere → the acceptance slot reads 0
     doc["detail"]["xl"]["sweep_ab"]["wires_identical_after_paging"] = False
     assert bench._summary_line(doc)["mxu"] == [3.7, 2.9, 0]
@@ -561,6 +563,49 @@ def test_summary_line_carries_topo_token():
     empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
                                  "vs_baseline": 1.0, "detail": {}})
     assert empty["topo"] == [None] * 8
+
+
+BACKFILL_KEYS = (
+    "records", "open_loop", "krows_per_s", "seconds", "waves", "chunks",
+    "reports", "replay_tax_records", "kept_segments", "kanon_dropped",
+    "agg_identical", "closed_loop", "posts", "vs_soak_x",
+    "open_ge_closed_ok",
+)
+
+
+def test_backfill_leg_schema_keys():
+    """Pin detail.backfill (round 20): open-loop engine vs closed-loop
+    drain of the SAME spool, device-vs-shadow aggregate identity, the
+    counted k-anonymity cutoff, and the (zero on a clean run) replay
+    tax. Extend, never drop."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._backfill_bench)
+    for key in BACKFILL_KEYS:
+        assert f'"{key}"' in src, key
+
+
+def test_summary_line_carries_bf_token():
+    """bf = [open-loop krows/s (1 decimal), open/closed-loop speedup
+    (2 decimals), device-vs-reference aggregate-identity bit,
+    k-anonymity-withheld segment count]."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "backfill": {
+                   "open_loop": {"krows_per_s": 84.237,
+                                 "agg_identical": True,
+                                 "kanon_dropped": 27},
+                   "vs_soak_x": 2.504,
+               },
+           }}
+    line = bench._summary_line(doc)
+    assert line["bf"] == [84.2, 2.5, 1, 27]
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["bf"] == [None] * 4
 
 
 def test_service_ab_records_draw_spread():

@@ -162,6 +162,15 @@ def _synthetic_doc():
             "aggregation": {"fidelity_ok": True},
             "stitch": {"ok": True},
         },
+        # widths honest-worst for the leg's FIXED tiny scale (see
+        # _backfill_bench): 5-digit krows/s, 2-digit ratio, 4-digit
+        # withheld count
+        "backfill": {
+            "open_loop": {"krows_per_s": 12345.678,
+                          "agg_identical": True,
+                          "kanon_dropped": 1234},
+            "vs_soak_x": 12.34,
+        },
         "link_health": {"rtt_ms": 1129.22, "mbps": 125.13,
                         "mood": "degraded", "samples": 123,
                         "probe_duty_pct": 0.4123},
