@@ -3289,7 +3289,9 @@ def _backfill_bench(tpu_ok: bool) -> dict:
     between waves), the engine's device-vs-reference aggregate identity
     bit (shadow reference: the same flat_cells binning through np.add.at
     instead of the device scatter), and the k-anonymity harvest
-    counts."""
+    counts. Round 21 adds the mesh arm: the same engine data-parallel
+    over every visible device, with its three identity bits (see the
+    mesh-arm comment below)."""
     import shutil
     import tempfile
 
@@ -3379,6 +3381,69 @@ def _backfill_bench(tpu_ok: bool) -> dict:
 
         vs = round(ostats["krows_per_s"] / max(closed["krows_per_s"],
                                                1e-9), 2)
+
+        # ---- mesh arm (round 21): same spool, data-parallel engine ---
+        # Shards every rung slice over ALL devices through the SAME
+        # undecorated wire bodies (dp_e2e.mesh_wire_fn) and keeps a
+        # per-device partial aggregate grid, merged bucket-wise at the
+        # one harvest sync. Three identity bits ride the capture: the
+        # mesh arm's own device-vs-reference shadow, mesh-vs-single
+        # aggregate grid equality, and prepared-seam wire-byte identity
+        # (one probe slice through both matchers; the mesh harvest is
+        # sliced to the real row count, the single arm's bytes must be
+        # its prefix). Skipped with a note on a 1-device composite (the
+        # axon chip); no-chip composites always have the 8-device
+        # virtual host platform forced in main().
+        import jax
+        import numpy as np
+
+        ndev = len(jax.devices())
+        if ndev >= 2:
+            from reporter_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(dp=ndev)
+
+            def _mesh_run(shadow: bool):
+                meng = BackfillEngine(ts, cfg, bf, mesh=mesh)
+                if shadow:
+                    meng.enable_shadow_reference()
+                return meng, meng.run(broker_dir)
+
+            _mesh_run(False)                  # warm (compile, untimed)
+            meng, mstats = _mesh_run(True)
+
+            probe, _, _ = eng._wave_traces(batches[0])
+            padded = eng._pad_to_rung(probe[:32])
+            w1, sl1 = eng.matcher.plan_submit(padded)
+            w2, sl2 = meng.matcher.plan_submit(padded)
+            wire_ok = len(sl1) == len(sl2)
+            for (b1, ws1), (b2, ws2) in zip(sl1, sl2):
+                a1 = np.asarray(eng.matcher.submit_prepared(
+                    eng.matcher.prepare_submit_slice(padded, w1, b1, ws1)))
+                a2 = np.asarray(meng.matcher.submit_prepared(
+                    meng.matcher.prepare_submit_slice(padded, w2, b2, ws2)))
+                wire_ok = wire_ok and bool(
+                    np.array_equal(a1, a2[:a1.shape[0]]))
+
+            mesh_doc = {
+                "devices": ndev,
+                "krows_per_s": mstats["krows_per_s"],
+                "seconds": mstats["seconds"],
+                "vs_single_x": round(
+                    mstats["krows_per_s"]
+                    / max(ostats["krows_per_s"], 1e-9), 2),
+                "agg_identical": meng.shadow_identical(),
+                "agg_equal_single": bool(
+                    np.array_equal(eng.hist.snapshot(),
+                                   meng.hist.snapshot())
+                    and np.array_equal(eng.qhist.snapshot(),
+                                       meng.qhist.snapshot())),
+                "wire_bytes_identical": wire_ok,
+            }
+        else:
+            mesh_doc = {"devices": ndev,
+                        "note": "single device - mesh arm skipped"}
+
         return {
             "config": (f"{n_veh} vehicles x {n_pt} pts = {total} records "
                        f"over a {nparts}-partition durable columnar "
@@ -3396,6 +3461,7 @@ def _backfill_bench(tpu_ok: bool) -> dict:
                 "agg_identical": eng.shadow_identical(),
             },
             "closed_loop": closed,
+            "mesh": mesh_doc,
             "vs_soak_x": vs,
             "open_ge_closed_ok": bool(
                 ostats["krows_per_s"] >= closed["krows_per_s"]),
@@ -3773,6 +3839,15 @@ def main() -> None:
         # Emit a real (CPU-backend) measurement rather than hanging; the
         # label makes the degraded environment visible to the reader.
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # 8-device VIRTUAL mesh (round 21): a no-chip composite still
+        # exercises detail.backfill's mesh arm (data-parallel spool
+        # reprocessing + sharded aggregate) — the flag must land BEFORE
+        # the first jax import or the host platform stays single-device.
+        # Unsharded legs are unaffected: their dispatches ride device 0.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
     if not tpu_ok:
@@ -4707,16 +4782,27 @@ def _topo_token(_g) -> list:
 def _bf_token(_g) -> list:
     """bf = [open-loop krows/s (1 decimal), open/closed speedup vs the
     same spool's closed-loop drain (the acceptance bar: ≥ 1 on a CPU
-    capture), device-vs-reference aggregate identity bit (shadow
-    reference — same flat_cells binning, np.add.at twin), k-anonymity-
-    withheld segment count] — full leg in detail.backfill."""
+    capture), identity bit, k-anonymity-withheld segment count,
+    mesh-arm krows/s (1 decimal; None on a 1-device composite)] — full
+    leg in detail.backfill. The identity slot folds EVERY recorded
+    identity bit (the mxu-token style): single-arm shadow, and when the
+    mesh arm ran, its shadow + mesh-vs-single aggregate equality +
+    prepared-seam wire-byte identity — any recorded False reads 0, an
+    unexercised bit is simply absent from the fold, never vacuous
+    green."""
     kr = _g("backfill", "open_loop", "krows_per_s")
     vs = _g("backfill", "vs_soak_x")
-    agg = _g("backfill", "open_loop", "agg_identical")
+    bits = [b for b in (_g("backfill", "open_loop", "agg_identical"),
+                        _g("backfill", "mesh", "agg_identical"),
+                        _g("backfill", "mesh", "agg_equal_single"),
+                        _g("backfill", "mesh", "wire_bytes_identical"))
+            if b is not None]
+    mkr = _g("backfill", "mesh", "krows_per_s")
     return [None if kr is None else round(kr, 1),
             None if vs is None else round(vs, 2),
-            None if agg is None else int(bool(agg)),
-            _g("backfill", "open_loop", "kanon_dropped")]
+            None if not bits else int(all(bits)),
+            _g("backfill", "open_loop", "kanon_dropped"),
+            None if mkr is None else round(mkr, 1)]
 
 
 def _summary_line(doc: dict) -> dict:
@@ -4766,7 +4852,10 @@ def _summary_line(doc: dict) -> dict:
         "vs_baseline": doc["vs_baseline"],
         "device": dev,
         "tiles_kpps": tiles_kpps,
-        "e2e_over_decode": d.get("e2e_over_decode"),
+        # per-mille int (r21 compaction — the bf mesh slot needed the
+        # bytes); the exact ratio keeps its name in the detail file
+        "e2e_od_pm": (None if d.get("e2e_over_decode") is None
+                      else int(round(d["e2e_over_decode"] * 1e3))),
         # fixed-order array [single-trace e2e p50 (whole ms, r18
         # compaction), matcher-only p50] — the two r18 keys folded into
         # one (r20 compaction: the bf token needed the bytes); exact

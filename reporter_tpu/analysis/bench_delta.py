@@ -59,6 +59,9 @@ _HIGHER_SUFFIXES = (
     # r20 backfill leg: the open-loop engine's speedup over the same
     # spool's closed-loop drain (the leg's acceptance ratio)
     "vs_soak_x",
+    # r21 mesh backfill arm: mesh-over-single-device open-loop ratio
+    # (mesh krows/s itself classifies via the krows_per_s suffix)
+    "vs_single_x",
 )
 _LOWER_SUFFIXES = (
     "_ms", "disagreement", "miss_rate", "step_miss_rate", "lag",
@@ -196,6 +199,12 @@ _SKIP_KEYS = {
     # compared claims
     # lint: allow[bench-coverage] 2026-08-06 r20 detail.backfill rows land with this round's capture (the leg is new; no committed composite carries it yet) — they guard the next committed capture, CPU and chip flavors alike
     "records", "waves", "chunks", "kept_segments", "kanon_dropped",
+    # r21 mesh backfill arm: the shard count is a placement descriptor
+    # (the CPU composite's 8 virtual devices, a chip slice's real count),
+    # never a perf claim — mesh krows_per_s / vs_single_x above carry
+    # the compared numbers
+    # lint: allow[bench-coverage] 2026-08-06 r21 detail.backfill.mesh rows land with this round's capture (the mesh arm is new; no committed composite carries it yet)
+    "devices",
 }
 
 # every throughput/latency number measured THROUGH the remote link is
@@ -207,7 +216,7 @@ _LINK_FREE_TOKENS = re.compile(
     r"|disagreement|point_edge|point_segment|matcher_only"
     r"|cpu_reference|python_|miss_rate|lost|duplicated|dead_letter"
     r"|errors|rejected|dropped|overhead_pct|speedup|probe_duty"
-    r"|replay_tax|vs_soak",
+    r"|replay_tax|vs_soak|vs_single",
     re.IGNORECASE)
 
 

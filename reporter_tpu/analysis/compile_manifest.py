@@ -130,6 +130,16 @@ def compute_manifest() -> "dict[str, Any]":
             "grids": ["speed_tod", "turns"],
             "tod_bins_default": bagg.DEFAULT_TOD_BINS,
             "turn_slots_default": bagg.DEFAULT_TURN_SLOTS,
+            # r21: the mesh arm keeps per-device partial grids and
+            # scatters cap_rows indices PER SHARD ([ndev, cap] blocks
+            # through ONE jit(shard_map) program per mesh — still two
+            # scatter executables per tile per process, mesh or not);
+            # partials merge bucket-wise at the one harvest readback
+            "mesh": {
+                "cap_rows_per_shard": agg._CAP,
+                "executables_per_grid": 1,
+                "merge": "host i32 bucket sum at snapshot()",
+            },
         },
         # round 17: the per-metro self-tuning plan space — the cap-rung
         # × kernel-arm matrix the tuner may pick from, fully enumerated
@@ -177,6 +187,10 @@ GOLDEN: "dict[str, Any]" = \
               'staged_member': 'tuned_plan'},
  'backfill_scatter': {'cap_rows': 4096,
                       'grids': ['speed_tod', 'turns'],
+                      'mesh': {'cap_rows_per_shard': 4096,
+                               'executables_per_grid': 1,
+                               'merge': 'host i32 bucket sum at '
+                                        'snapshot()'},
                       'tod_bins_default': 24,
                       'turn_slots_default': 8},
  'dense_sweep': {'chunk_sub_bboxes': 8,

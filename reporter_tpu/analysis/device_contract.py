@@ -369,22 +369,34 @@ def _audit_histogram() -> "list[Finding]":
                        ("reporter_tpu/streaming/histogram.py", 1))
 
 
-def _audit_backfill_scatter() -> "list[Finding]":
+def _audit_backfill_scatter(mesh) -> "list[Finding]":
     """Round 20: the backfill aggregates' shared FLAT scatter
     (ops/aggregate.py) — same fixed-batch-shape discipline as the
-    histogram, audited under the same x64 widening rules."""
+    histogram, audited under the same x64 widening rules. Round 21 adds
+    the mesh-sharded case through the SAME program builder the serving
+    path uses (agg.mesh_scatter_fn — per-device partial grids, leading
+    dim sharded; the jaxpr structure is device-count independent, so the
+    1-device audit mesh suffices here too)."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from reporter_tpu.ops import aggregate as agg
 
     cap = agg._CAP
+    site = ("reporter_tpu/ops/aggregate.py", 1)
     closed = jax.make_jaxpr(agg._scatter_add)(
         jax.ShapeDtypeStruct((4096,), jnp.int32),
         jax.ShapeDtypeStruct((cap,), jnp.int32),
         jax.ShapeDtypeStruct((cap,), jnp.bool_))
-    return audit_jaxpr(closed, "backfill/scatter",
-                       ("reporter_tpu/ops/aggregate.py", 1))
+    findings = audit_jaxpr(closed, "backfill/scatter", site)
+    ndev = int(np.prod(tuple(mesh.shape.values())))
+    closed_mesh = jax.make_jaxpr(agg.mesh_scatter_fn(mesh))(
+        jax.ShapeDtypeStruct((ndev, 4096), jnp.int32),
+        jax.ShapeDtypeStruct((ndev, cap), jnp.int32),
+        jax.ShapeDtypeStruct((ndev, cap), jnp.bool_))
+    findings.extend(audit_jaxpr(closed_mesh, "backfill/scatter-mesh", site))
+    return findings
 
 
 def _merge_across_cases(findings: "list[Finding]") -> "list[Finding]":
@@ -444,7 +456,7 @@ def run_device_contract(root: str = REPO_ROOT) -> "list[Finding]":
             findings.extend(check_wire_avals(closed.out_avals, case.layout,
                                              case.label, site))
         findings.extend(_audit_histogram())
-        findings.extend(_audit_backfill_scatter())
+        findings.extend(_audit_backfill_scatter(mesh))
 
     findings = _merge_across_cases(findings)
     by_path: "dict[str, list[Finding]]" = {}

@@ -37,15 +37,18 @@ _DAY_S = 86400.0
 
 
 class SpeedTodHistogram:
-    """i32 [rows, tod_bins, speed_bins] counts on device (flat grid)."""
+    """i32 [rows, tod_bins, speed_bins] counts on device (flat grid).
+    ``mesh`` shards the accumulator per-device (FixedGridCounts' r21
+    partial-grid form); binning and snapshots are unchanged."""
 
-    def __init__(self, num_rows: int, speed_edges, tod_bins: int = DEFAULT_TOD_BINS):
+    def __init__(self, num_rows: int, speed_edges,
+                 tod_bins: int = DEFAULT_TOD_BINS, mesh=None):
         self.speed_edges = np.asarray(speed_edges, np.float64)
         self.num_bins = len(self.speed_edges)    # last bin open-ended
         self.tod_bins = int(tod_bins)
         self.num_rows = int(num_rows)
         self._grid = FixedGridCounts(
-            self.num_rows * self.tod_bins * self.num_bins)
+            self.num_rows * self.tod_bins * self.num_bins, mesh=mesh)
 
     def flat_cells(self, rows, times, speeds) -> np.ndarray:
         """THE binning: (segment row, start time s, speed m/s) → flat
@@ -93,10 +96,12 @@ class TurnCounts:
     the final "other" slot, counted, so the ratio denominators stay
     exact even for pathological fanout."""
 
-    def __init__(self, num_rows: int, slots: int = DEFAULT_TURN_SLOTS):
+    def __init__(self, num_rows: int, slots: int = DEFAULT_TURN_SLOTS,
+                 mesh=None):
         self.num_rows = int(num_rows)
         self.slots = int(slots)
-        self._grid = FixedGridCounts(self.num_rows * (self.slots + 1))
+        self._grid = FixedGridCounts(self.num_rows * (self.slots + 1),
+                                     mesh=mesh)
         self._legend: "dict[int, list[int]]" = {}
 
     def _slot(self, row: int, next_id: int) -> int:

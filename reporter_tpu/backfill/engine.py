@@ -22,6 +22,18 @@ service curve, the wave-paced soak); this loop keeps the device busy as
 long as the spool has records — which is why ``detail.backfill`` pins
 open-loop krows/s ≥ the same tile's closed-loop soak pps.
 
+Mesh-native (round 21): ``mesh=`` (or ``BackfillConfig.mesh_devices`` /
+``RTPU_BACKFILL_MESH`` when the engine builds its own matcher) shards
+every rung slice across the flattened data axis through the SAME
+undecorated wire bodies ``parallel/dp_e2e.mesh_wire_fn`` serves
+(``SegmentMatcher(mesh=...)`` — no wire fork; the prepared seam is
+placement-blind host work, so stages 1–2 are untouched and host prepare
+feeds N shards concurrently with device execution), and both aggregate
+scatters keep PER-DEVICE partial grids (``ops/aggregate.FixedGridCounts``
+mesh form) merged bucket-wise at the one harvest/checkpoint readback —
+bit-identical to single-device accumulation, test- and bench-asserted
+the same way fleet wire bytes are.
+
 Checkpointed resume REUSES streaming/state.py's npz schema (ONE
 checkpoint spelling in the repo): committed offsets are the commit floor
 of fully-aggregated waves, and the snapshot is taken exactly at a wave
@@ -87,6 +99,11 @@ class BackfillConfig:
     turn_slots: int = DEFAULT_TURN_SLOTS
     checkpoint_path: "str | None" = None
     checkpoint_every_waves: int = 8
+    mesh_devices: int = 0          # 0 = single-device; N ≥ 1 builds a
+    #   ("tile", "dp") data-parallel mesh over the first N local devices
+    #   (parallel/mesh.make_mesh) when the engine constructs its own
+    #   matcher. A caller-provided matcher/mesh always wins — the knob is
+    #   the CLI/env face, not a second placement authority.
 
     def validate(self) -> "BackfillConfig":
         from reporter_tpu.service.scheduler import _TRACE_RUNGS
@@ -99,7 +116,7 @@ class BackfillConfig:
         for f, lo in (("max_inflight", 1), ("readahead_slices", 1),
                       ("poll_records", 1), ("k_anonymity", 0),
                       ("tod_bins", 1), ("turn_slots", 1),
-                      ("checkpoint_every_waves", 1)):
+                      ("checkpoint_every_waves", 1), ("mesh_devices", 0)):
             if getattr(self, f) < lo:
                 raise ValueError(f"backfill.{f} must be >= {lo}")
         return self
@@ -114,7 +131,9 @@ class BackfillConfig:
                 ("RTPU_BACKFILL_INFLIGHT", env.get("RTPU_BACKFILL_INFLIGHT"),
                  "max_inflight"),
                 ("RTPU_BACKFILL_READAHEAD",
-                 env.get("RTPU_BACKFILL_READAHEAD"), "readahead_slices")):
+                 env.get("RTPU_BACKFILL_READAHEAD"), "readahead_slices"),
+                ("RTPU_BACKFILL_MESH", env.get("RTPU_BACKFILL_MESH"),
+                 "mesh_devices")):
             if raw is None or raw == "":
                 continue
             try:
@@ -150,16 +169,35 @@ class BackfillEngine:
 
     def __init__(self, tileset, config: "Config | None" = None,
                  bf: "BackfillConfig | None" = None, matcher=None,
-                 store: "AggregateStore | None" = None):
+                 store: "AggregateStore | None" = None, mesh=None):
         self.ts = tileset
-        self.matcher = matcher or SegmentMatcher(tileset, config)
+        self.bf = (bf or BackfillConfig()).with_env_overrides().validate()
+        # mesh resolution (round 21): a provided matcher's wire mesh is
+        # authoritative — the aggregate partials MUST live on the mesh
+        # the wire dispatches shard over, so the two can never be placed
+        # apart; the explicit ``mesh=`` / ``mesh_devices`` knobs only
+        # steer a matcher the engine builds itself
+        if matcher is not None:
+            if mesh is not None and matcher.wire_mesh is not mesh:
+                raise ValueError(
+                    "backfill mesh= must be the provided matcher's "
+                    "wire_mesh (the aggregate partials shard over the "
+                    "mesh the wire dispatches on) — pass one or the "
+                    "other, not two placements")
+            mesh = matcher.wire_mesh
+            self.matcher = matcher
+        else:
+            if mesh is None and self.bf.mesh_devices:
+                from reporter_tpu.parallel.mesh import make_mesh
+                mesh = make_mesh(dp=self.bf.mesh_devices)
+            self.matcher = SegmentMatcher(tileset, config, mesh=mesh)
+        self.mesh = mesh
         if self.matcher._native_walker is None:
             raise RuntimeError(
                 "backfill requires the native column walker (the "
                 "columnar product path's precondition) — unset "
                 "REPORTER_TPU_NO_NATIVE / fix the native build")
         self.config = self.matcher.config
-        self.bf = (bf or BackfillConfig()).with_env_overrides().validate()
         self.metrics = self.matcher.metrics
         self.store = store or AggregateStore()
         self._osmlr_ids = np.asarray(tileset.osmlr_id)
@@ -170,8 +208,8 @@ class BackfillEngine:
         # _qhist_flushed (flush baselines are vestigial here — backfill
         # publishes once at harvest, so they stay empty)
         self.hist = SpeedTodHistogram(rows, self.config.streaming.speed_bins,
-                                      self.bf.tod_bins)
-        self.qhist = TurnCounts(rows, self.bf.turn_slots)
+                                      self.bf.tod_bins, mesh=mesh)
+        self.qhist = TurnCounts(rows, self.bf.turn_slots, mesh=mesh)
         self._hist_flushed = np.zeros(0, np.int32)
         self._qhist_flushed = np.zeros(0, np.int32)
         self._records_prior = 0        # records processed by earlier

@@ -376,6 +376,16 @@ class SegmentMatcher:
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
 
+    @property
+    def wire_mesh(self):
+        """The jax.sharding.Mesh this matcher's wire dispatch shards
+        over, or None on every single-device/reference path — THE
+        public seam for layers that must co-shard with the matcher (the
+        backfill engine places its aggregate partials on the same mesh
+        so one constructor argument can never drift from the wire)."""
+        wire = getattr(self, "_wire", None)
+        return getattr(wire, "mesh", None)
+
     # ---- fleet residency (device-table paging) ---------------------------
 
     @property

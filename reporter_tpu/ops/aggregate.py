@@ -1,4 +1,4 @@
-"""Fixed-grid device aggregation scatter (backfill, round 20).
+"""Fixed-grid device aggregation scatter (backfill, round 20; mesh r21).
 
 Generalizes ``streaming/histogram.py``'s scatter discipline — an i32
 device accumulator updated by ONE jit'd scatter-add with a FIXED update
@@ -12,15 +12,27 @@ device residency + chunked padded scatter, so every backfill aggregate
 (speed × time-of-day histogram, next-segment turn counts) rides the same
 audited kernel instead of growing one scatter per grid shape.
 
+Mesh sharding (round 21): ``FixedGridCounts(size, mesh=...)`` keeps a
+PER-DEVICE partial grid ([ndev, size], leading dim sharded over the
+flattened data axis — the same ``dp_e2e.data_pspec`` spelling the wire
+dispatch uses) and scatters each device's slice of the index stream into
+its own partial with zero cross-device communication; the partials are
+merged BUCKET-WISE (i32 sum over the shard axis — addition of unit
+increments commutes, so the merged grid is bit-identical to single-device
+accumulation, the r19 fixed-grid merge discipline) at ``snapshot()``,
+which is already the ONE harvest/checkpoint readback. The mesh program is
+built by ``mesh_scatter_fn`` — one spelling, two callers: the add() path
+below and the device-contract jaxpr audit (analysis/device_contract.py),
+so the audited mesh scatter can never drift from the served one.
+
 The numpy reference accumulation lives here too: the device scatter must
 stay bit-equal to it over the same index stream (property-tested across
-chunk boundaries and the pad path in tests/test_backfill.py, and
-re-asserted on every bench composite's ``detail.backfill`` leg).
+chunk boundaries and the pad path in tests/test_backfill.py — mesh and
+single-device — and re-asserted on every bench composite's
+``detail.backfill`` leg).
 """
 
 from __future__ import annotations
-
-import functools
 
 import numpy as np
 import jax
@@ -29,11 +41,13 @@ import jax.numpy as jnp
 # ONE update-batch shape for the jit'd scatter, same value and same
 # reason as SpeedHistogram._CAP: updates pad to it, bigger batches chunk
 # through it, and the executable compiles once in the warm-up chunk.
+# The mesh path scatters _CAP indices PER SHARD (one [ndev, _CAP] block
+# per dispatch), so its effective chunk is ndev × _CAP — still one
+# compiled shape per process per mesh.
 _CAP = 4096
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _scatter_add(grid, idx, ok):
+def _scatter_body(grid, idx, ok):
     # dtype pinned exactly like histogram._accumulate: the bool cast
     # materializes the update in i32 regardless of x64 mode (the
     # device-contract x64 audit covers this jaxpr too).
@@ -41,15 +55,69 @@ def _scatter_add(grid, idx, ok):
     return grid.at[jnp.maximum(idx, 0)].add(upd)
 
 
+# the single-device executable keeps its r20 spelling (jit + donated
+# grid); the mesh program wraps the SAME body so the two paths cannot
+# fork semantically
+_scatter_add = jax.jit(_scatter_body, donate_argnums=(0,))
+
+
+def mesh_scatter_fn(mesh):
+    """``jit(shard_map(_scatter_body))`` over ``mesh`` — THE mesh scatter
+    program builder. One spelling, two callers: FixedGridCounts' mesh
+    path and the device-contract audit, which abstractly traces the same
+    callable so the audited program can never drift from the served one.
+    Operands are [ndev, size] / [ndev, _CAP] / [ndev, _CAP] with the
+    leading dim sharded over the flattened data axis; each device updates
+    ONLY its own partial row — no collective in the jaxpr."""
+    from reporter_tpu.parallel.compat import shard_map
+    from reporter_tpu.parallel.dp_e2e import data_pspec
+
+    from jax.sharding import PartitionSpec as P
+
+    shard = P(tuple(data_pspec(mesh))[0], None)
+
+    def local(grid, idx, ok):
+        return _scatter_body(grid[0], idx[0], ok[0])[None]
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(shard,) * 3, out_specs=shard,
+        check_vma=False),   # same constant-carry caveat as parallel/dp
+        donate_argnums=(0,))
+
+
 class FixedGridCounts:
     """i32 flat [size] device counts; add() scatters host-binned flat
     cell indices. Out-of-range / negative indices are masked (counted in
-    the return value as rejected), never clamped into a real cell."""
+    the return value as rejected), never clamped into a real cell.
 
-    def __init__(self, size: int):
+    ``mesh``: shard the accumulator per-device ([ndev, size] partials,
+    round-robin index blocks) — snapshot() merges bucket-wise, bit-
+    identical to the single-device grid over the same stream."""
+
+    def __init__(self, size: int, mesh=None):
         self.size = int(size)
         assert 0 < self.size < 2 ** 31, self.size   # i32 index space
-        self._grid = jnp.zeros(self.size, jnp.int32)
+        self.mesh = mesh
+        if mesh is None:
+            self.ndev = 1
+            self._grid = jnp.zeros(self.size, jnp.int32)
+            self._mesh_fn = None
+        else:
+            from reporter_tpu.parallel.dp_e2e import flat_device_count
+
+            self.ndev = flat_device_count(mesh)
+            self._grid = self._place(
+                np.zeros((self.ndev, self.size), np.int32))
+            self._mesh_fn = mesh_scatter_fn(mesh)
+
+    def _place(self, arr2d: np.ndarray):
+        from reporter_tpu.parallel.dp_e2e import data_pspec
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shard = P(tuple(data_pspec(self.mesh))[0], None)
+        return jax.device_put(jnp.asarray(arr2d),
+                              NamedSharding(self.mesh, shard))
 
     def add(self, idx: np.ndarray) -> int:
         """One observation per flat index; returns the accepted count."""
@@ -58,25 +126,45 @@ class FixedGridCounts:
         idx = np.asarray(idx, np.int64)
         ok = (idx >= 0) & (idx < self.size)
         idx32 = np.where(ok, idx, -1).astype(np.int32)
-        for lo in range(0, len(idx32), _CAP):
-            i = idx32[lo:lo + _CAP]
-            o = ok[lo:lo + _CAP]
-            pad = _CAP - len(i)
+        step = self.ndev * _CAP
+        for lo in range(0, len(idx32), step):
+            i = idx32[lo:lo + step]
+            o = ok[lo:lo + step]
+            pad = step - len(i)
             if pad:
                 i = np.pad(i, (0, pad))
                 o = np.pad(o, (0, pad))
-            self._grid = _scatter_add(self._grid, jnp.asarray(i),
-                                      jnp.asarray(o))
+            if self.mesh is None:
+                self._grid = _scatter_add(self._grid, jnp.asarray(i),
+                                          jnp.asarray(o))
+            else:
+                self._grid = self._mesh_fn(
+                    self._grid,
+                    jnp.asarray(i.reshape(self.ndev, _CAP)),
+                    jnp.asarray(o.reshape(self.ndev, _CAP)))
         return int(ok.sum())
 
     def snapshot(self) -> np.ndarray:
-        """Host copy (the ONE readback — harvest/checkpoint only)."""
-        return np.asarray(self._grid)
+        """Host copy (the ONE readback — harvest/checkpoint only). On a
+        mesh this is the bucket-wise merge: per-device partials summed in
+        i32 (unit increments commute, so the merged grid is bit-identical
+        to single-device accumulation — wrap semantics included)."""
+        if self.mesh is None:
+            return np.asarray(self._grid)
+        return np.asarray(self._grid).sum(axis=0, dtype=np.int32)
 
     def load(self, grid: np.ndarray) -> None:
         grid = np.asarray(grid).reshape(-1)
         assert grid.shape == (self.size,), (grid.shape, self.size)
-        self._grid = jnp.asarray(grid.astype(np.int32))
+        if self.mesh is None:
+            self._grid = jnp.asarray(grid.astype(np.int32))
+            return
+        # checkpointed grids are the MERGED form; resume places the whole
+        # restored grid in partial row 0 (rows are partials, not owners —
+        # any distribution summing to the grid is equivalent)
+        arr = np.zeros((self.ndev, self.size), np.int32)
+        arr[0] = grid.astype(np.int32)
+        self._grid = self._place(arr)
 
 
 def reference_counts(size: int, idx: np.ndarray) -> np.ndarray:

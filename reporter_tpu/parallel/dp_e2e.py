@@ -40,6 +40,20 @@ _IMPLS = {"f32": wire_from_f32, "q16": wire_from_q16, "q8": wire_from_q8}
 _NARGS = {"f32": 2, "q16": 3, "q8": 3}
 
 
+def data_pspec(mesh: Mesh) -> P:
+    """THE data-parallel PartitionSpec: leading dim sharded over every
+    mesh axis flattened into one logical data axis. One spelling, every
+    mesh consumer (wire dispatch below, the backfill aggregate scatter in
+    ops/aggregate.py) — two spellings would let a placement drift."""
+    return P(tuple(mesh.axis_names))
+
+
+def flat_device_count(mesh: Mesh) -> int:
+    """Total devices under the flattened data axis (== rows per padded
+    dispatch block)."""
+    return int(np.prod(tuple(mesh.shape.values())))
+
+
 def mesh_wire_fn(mesh: Mesh, kind: str, meta, params: MatcherParams,
                  spec: "tuple | None", tables_pytree, has_acc: bool):
     """``jit(shard_map(wire_from_<kind>))`` over ``mesh`` — THE product-
@@ -51,7 +65,7 @@ def mesh_wire_fn(mesh: Mesh, kind: str, meta, params: MatcherParams,
     ShapeDtypeStructs work as well as placed arrays."""
     impl = _IMPLS[kind]
     nargs = _NARGS[kind]
-    data = P(tuple(mesh.axis_names))         # rows over ALL mesh axes
+    data = data_pspec(mesh)                  # rows over ALL mesh axes
     tbl_specs = jax.tree.map(lambda _: P(), tables_pytree)
 
     if has_acc:
@@ -78,7 +92,7 @@ class DpWireMatcher:
     def __init__(self, mesh: Mesh, ts: TileSet, params: MatcherParams,
                  spec: "tuple | None"):
         self.mesh = mesh
-        self.ndev = int(np.prod(tuple(mesh.shape.values())))
+        self.ndev = flat_device_count(mesh)
         self.meta = ts.meta
         self.params = params
         self.spec = spec

@@ -28,10 +28,11 @@ from reporter_tpu.backfill import BackfillConfig, BackfillEngine
 from reporter_tpu.backfill.aggregate import (SpeedTodHistogram, TurnCounts,
                                              harvest_aggregates)
 from reporter_tpu.config import CompilerParams, Config
-from reporter_tpu.matcher.api import SegmentMatcher
+from reporter_tpu.matcher.api import SegmentMatcher, Trace
 from reporter_tpu.netgen.synthetic import generate_city
 from reporter_tpu.netgen.traces import synthesize_fleet
 from reporter_tpu.ops.aggregate import _CAP, FixedGridCounts, reference_counts
+from reporter_tpu.parallel.mesh import make_mesh
 from reporter_tpu.streaming.columnar import pack_records
 from reporter_tpu.streaming.durable_columnar import DurableColumnarIngestQueue
 from reporter_tpu.streaming.durable_queue import DurableIngestQueue
@@ -321,3 +322,156 @@ def test_config_validation_and_env_overrides():
     assert cfg.readahead_slices == BackfillConfig().readahead_slices
     with pytest.raises(ValueError, match="RTPU_BACKFILL_K"):
         BackfillConfig().with_env_overrides({"RTPU_BACKFILL_K": "many"})
+    # r21 mesh knob: strict int parse, 0 = single-device default
+    mcfg = BackfillConfig().with_env_overrides({"RTPU_BACKFILL_MESH": "8"})
+    assert mcfg.mesh_devices == 8
+    assert BackfillConfig().mesh_devices == 0
+    with pytest.raises(ValueError, match="RTPU_BACKFILL_MESH"):
+        BackfillConfig().with_env_overrides({"RTPU_BACKFILL_MESH": "all"})
+
+
+# ---------------------------------------------------------------------------
+# mesh arm (round 21): data-parallel engine + device-sharded aggregation.
+# conftest forces an 8-device virtual host platform, so every tier-1 run
+# exercises the real shard_map programs.
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(dp=8)
+
+
+@pytest.fixture(scope="module")
+def mesh_matcher(tiles, mesh):
+    m = SegmentMatcher(tiles, Config(matcher_backend="jax"), mesh=mesh)
+    if m._native_walker is None:
+        pytest.skip("backfill requires the native column walker")
+    assert m.wire_mesh is mesh               # the public co-sharding seam
+    return m
+
+
+@pytest.mark.parametrize("n", [0, 1, _CAP - 1, 8 * _CAP, 8 * _CAP + 17,
+                               3 * 8 * _CAP + 5])
+def test_mesh_grid_counts_match_reference_across_shard_boundaries(mesh, n):
+    """The mesh grid keeps one partial per device and scatters
+    [ndev, _CAP] blocks per step — every pad/multi-step length must
+    still equal the numpy accumulation bit-for-bit after the bucket-wise
+    snapshot() merge (i32 unit increments commute, so shard assignment
+    can never change a count)."""
+    size = 257
+    rng = np.random.default_rng(n % 1000)
+    idx = rng.integers(-5, size + 5, size=n)
+    g = FixedGridCounts(size, mesh=mesh)
+    assert g.ndev == 8
+    accepted = g.add(idx)
+    np.testing.assert_array_equal(g.snapshot(), reference_counts(size, idx))
+    assert accepted == int(((idx >= 0) & (idx < size)).sum())
+    # single-device spelling of the same stream: bit-identical
+    s = FixedGridCounts(size)
+    s.add(idx)
+    np.testing.assert_array_equal(g.snapshot(), s.snapshot())
+
+
+def test_mesh_grid_load_resumes_in_partial_row_zero(mesh):
+    """A checkpointed (already-merged) grid restores into partial row 0;
+    further adds scatter across shards and the merge still reconciles."""
+    g = FixedGridCounts(11, mesh=mesh)
+    g.add(np.array([1, 1, 4]))
+    snap = g.snapshot()
+    g2 = FixedGridCounts(11, mesh=mesh)
+    g2.load(snap)
+    g2.add(np.arange(11))
+    np.testing.assert_array_equal(g2.snapshot(), snap + 1)
+
+
+def test_mesh_prepared_seam_wire_bytes_identical(tiles, matcher,
+                                                 mesh_matcher):
+    """plan_submit → prepare_submit_slice → submit_prepared through the
+    mesh matcher yields byte-identical wire results to the single-device
+    matcher (the mesh harvest is row-padded to a device multiple; the
+    single arm's rows must be its exact prefix) — the engine's dispatch
+    path never forks the wire programs."""
+    probes = synthesize_fleet(tiles, 8, num_points=60, seed=9,
+                              gps_sigma=3.0)
+    traces = [Trace(uuid=p.uuid, xy=p.xy.astype(np.float32), times=p.times)
+              for p in probes]
+    w1, sl1 = matcher.plan_submit(traces)
+    w2, sl2 = mesh_matcher.plan_submit(traces)
+    assert [b for b, _ in sl1] == [b for b, _ in sl2]
+    for (b1, ws1), (b2, ws2) in zip(sl1, sl2):
+        a1 = np.asarray(matcher.submit_prepared(
+            matcher.prepare_submit_slice(traces, w1, b1, ws1)))
+        a2 = np.asarray(mesh_matcher.submit_prepared(
+            mesh_matcher.prepare_submit_slice(traces, w2, b2, ws2)))
+        assert a1.dtype == a2.dtype
+        np.testing.assert_array_equal(a1, a2[:a1.shape[0]])
+
+
+def test_engine_mesh_aggregates_bit_identical_to_single(
+        tiles, matcher, mesh_matcher, tmp_path):
+    """The mesh engine over the same spool: per-shard partial grids
+    merged at harvest BYTE-equal the single-device run's aggregates,
+    the mesh arm's own np.add.at shadow twin agrees, and the harvested
+    k-anonymized docs are JSON-identical."""
+    records = _fleet_records(tiles)
+    broker = str(tmp_path / "spool")
+    q = DurableColumnarIngestQueue(broker, 2)
+    for lo in range(0, len(records), 300):
+        q.append_columns(pack_records(records[lo:lo + 300]))
+    q.close()
+
+    single = BackfillEngine(tiles, matcher=matcher, bf=_bf())
+    single.run(broker)
+
+    eng = BackfillEngine(tiles, matcher=mesh_matcher, bf=_bf())
+    assert eng.mesh is mesh_matcher.wire_mesh
+    eng.enable_shadow_reference()
+    stats = eng.run(broker)
+    assert stats["records"] == len(records)
+    assert eng.shadow_identical() is True
+    np.testing.assert_array_equal(eng.hist.snapshot(),
+                                  single.hist.snapshot())
+    np.testing.assert_array_equal(eng.qhist.snapshot(),
+                                  single.qhist.snapshot())
+    assert (json.dumps(eng.store.snapshot(), sort_keys=True)
+            == json.dumps(single.store.snapshot(), sort_keys=True))
+
+
+def test_engine_mesh_chaos_resume_is_coverage_exact(
+        tiles, mesh_matcher, tmp_path):
+    """backfill:crash@N on the MESH arm: the checkpoint carries the
+    merged grid (restored into partial row 0), and the resumed run's
+    doc byte-equals the clean mesh run's with the replay tax counted."""
+    records = _fleet_records(tiles, seed=6)
+    broker = str(tmp_path / "spool")
+    q = DurableIngestQueue(broker, 2)
+    q.append_many(records)
+    q.close()
+
+    clean = BackfillEngine(tiles, matcher=mesh_matcher,
+                           bf=_bf(str(tmp_path / "ck_clean")))
+    clean.run(broker)
+    doc_clean = clean.store.snapshot()
+
+    ck = str(tmp_path / "ck_chaos")
+    with pytest.raises(faults.InjectedCrash):
+        with faults.use(faults.FaultPlan.parse("backfill:crash@2")):
+            BackfillEngine(tiles, matcher=mesh_matcher,
+                           bf=_bf(ck)).run(broker)
+    assert os.path.exists(ck + ".npz")
+
+    resumed = BackfillEngine(tiles, matcher=mesh_matcher, bf=_bf(ck))
+    stats = resumed.run(broker)
+    assert (json.dumps(resumed.store.snapshot(), sort_keys=True)
+            == json.dumps(doc_clean, sort_keys=True))
+    assert (stats["replay_tax_records"]
+            == stats["records_total"] - len(records))
+
+
+def test_engine_rejects_mesh_conflicting_with_matcher(tiles, matcher,
+                                                      mesh):
+    """mesh= must agree with a provided matcher's wire_mesh — a silent
+    override would aggregate on a mesh the dispatches never shard
+    over."""
+    with pytest.raises(ValueError, match="wire_mesh"):
+        BackfillEngine(tiles, matcher=matcher, mesh=mesh)

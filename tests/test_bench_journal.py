@@ -337,12 +337,16 @@ def test_bench_legs_topology_cli(tmp_path):
 
 
 def test_bench_legs_backfill_cli(tmp_path):
-    """Round-20 acceptance: `python bench.py --legs backfill` runs the
-    self-contained open-vs-closed spool replay on the no-chip path —
-    both arms drain the same durable columnar spool, the open loop is
-    no slower (the one-core acceptance bar), the device-vs-reference
-    aggregate identity bit is green — journals the leg, records the bf
-    summary token, and writes the PARTIAL detail file only."""
+    """Round-20 acceptance (+ r21 mesh arm): `python bench.py --legs
+    backfill` runs the self-contained open-vs-closed spool replay on
+    the no-chip path — both arms drain the same durable columnar spool,
+    the open loop is no slower (the one-core acceptance bar), the
+    device-vs-reference aggregate identity bit is green — journals the
+    leg, records the bf summary token, and writes the PARTIAL detail
+    file only. The no-chip path forces an 8-device virtual host
+    platform, so the mesh arm ALWAYS runs here: its shadow, its
+    mesh-vs-single aggregate equality, and the prepared-seam wire-byte
+    identity must all be recorded True."""
     env = dict(os.environ)
     env["REPORTER_BENCH_FORCE_CPU"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
@@ -356,11 +360,12 @@ def test_bench_legs_backfill_cli(tmp_path):
         timeout=420, env=env, cwd=str(tmp_path))
     assert out.returncode == 0, out.stdout[-2000:]
     summary = json.loads(out.stdout.decode().strip().splitlines()[-1])
-    krows, vs_soak, agg_ok, kanon = summary["bf"]
+    krows, vs_soak, agg_ok, kanon, mesh_krows = summary["bf"]
     assert krows and krows > 0
     assert vs_soak is not None and vs_soak >= 1.0   # open ≥ closed (CPU)
-    assert agg_ok == 1                    # device == numpy reference
+    assert agg_ok == 1                    # every recorded identity bit
     assert kanon is not None and kanon >= 0
+    assert mesh_krows and mesh_krows > 0  # 8 virtual devices forced
     if committed is not None:             # no-clobber (r15 rule)
         assert open(cpu_capture).read() == committed
     journal_path = os.path.join(os.path.dirname(os.path.abspath(_BENCH)),
@@ -374,6 +379,12 @@ def test_bench_legs_backfill_cli(tmp_path):
     assert res["open_loop"]["agg_identical"] is True
     assert res["open_loop"]["replay_tax_records"] == 0
     assert res["records"] > 0 and res["open_loop"]["reports"] > 0
+    mesh = res["mesh"]
+    assert mesh["devices"] == 8
+    assert mesh["agg_identical"] is True        # mesh shadow twin
+    assert mesh["agg_equal_single"] is True     # bucket-wise merge ==
+    #                                             single-device grids
+    assert mesh["wire_bytes_identical"] is True  # same wire programs
 
 
 def test_bench_rejects_unknown_legs():

@@ -570,14 +570,20 @@ BACKFILL_KEYS = (
     "reports", "replay_tax_records", "kept_segments", "kanon_dropped",
     "agg_identical", "closed_loop", "posts", "vs_soak_x",
     "open_ge_closed_ok",
+    # r21 mesh arm: device count, mesh/single throughput ratio, and the
+    # two mesh-only identity bits (aggregate grids equal the single
+    # arm's bit-for-bit; prepared-seam wire bytes identical)
+    "mesh", "devices", "vs_single_x", "agg_equal_single",
+    "wire_bytes_identical",
 )
 
 
 def test_backfill_leg_schema_keys():
-    """Pin detail.backfill (round 20): open-loop engine vs closed-loop
-    drain of the SAME spool, device-vs-shadow aggregate identity, the
-    counted k-anonymity cutoff, and the (zero on a clean run) replay
-    tax. Extend, never drop."""
+    """Pin detail.backfill (round 20; mesh arm round 21): open-loop
+    engine vs closed-loop drain of the SAME spool, device-vs-shadow
+    aggregate identity, the counted k-anonymity cutoff, the (zero on a
+    clean run) replay tax, and the data-parallel mesh arm with its
+    identity bits. Extend, never drop."""
     import inspect
 
     bench = _load_bench()
@@ -588,8 +594,11 @@ def test_backfill_leg_schema_keys():
 
 def test_summary_line_carries_bf_token():
     """bf = [open-loop krows/s (1 decimal), open/closed-loop speedup
-    (2 decimals), device-vs-reference aggregate-identity bit,
-    k-anonymity-withheld segment count]."""
+    (2 decimals), folded identity bit, k-anonymity-withheld segment
+    count, mesh-arm krows/s (1 decimal; None on 1-device composites)].
+    The identity slot folds every RECORDED bit (mxu-token style): a
+    single-device composite folds the one shadow bit, a mesh composite
+    folds all four — one recorded False reads 0."""
     bench = _load_bench()
     doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
            "unit": "probes/s", "vs_baseline": 1.0,
@@ -602,10 +611,21 @@ def test_summary_line_carries_bf_token():
                },
            }}
     line = bench._summary_line(doc)
-    assert line["bf"] == [84.2, 2.5, 1, 27]
+    assert line["bf"] == [84.2, 2.5, 1, 27, None]
     empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
                                  "vs_baseline": 1.0, "detail": {}})
-    assert empty["bf"] == [None] * 4
+    assert empty["bf"] == [None] * 5
+
+    # mesh arm recorded: slot 4 carries its krows/s and slot 2 folds
+    # the mesh bits — one False anywhere reads 0
+    doc["detail"]["backfill"]["mesh"] = {
+        "devices": 8, "krows_per_s": 412.561, "vs_single_x": 4.9,
+        "agg_identical": True, "agg_equal_single": True,
+        "wire_bytes_identical": True}
+    line = bench._summary_line(doc)
+    assert line["bf"] == [84.2, 2.5, 1, 27, 412.6]
+    doc["detail"]["backfill"]["mesh"]["agg_equal_single"] = False
+    assert bench._summary_line(doc)["bf"][2] == 0
 
 
 def test_service_ab_records_draw_spread():

@@ -164,11 +164,17 @@ def _synthetic_doc():
         },
         # widths honest-worst for the leg's FIXED tiny scale (see
         # _backfill_bench): 5-digit krows/s, 2-digit ratio, 4-digit
-        # withheld count
+        # withheld count; mesh arm populated (r21 — the line must fit
+        # when every identity bit and the mesh krows/s slot ride)
         "backfill": {
             "open_loop": {"krows_per_s": 12345.678,
                           "agg_identical": True,
                           "kanon_dropped": 1234},
+            "mesh": {"devices": 8, "krows_per_s": 12345.678,
+                     "vs_single_x": 12.34,
+                     "agg_identical": True,
+                     "agg_equal_single": True,
+                     "wire_bytes_identical": True},
             "vs_soak_x": 12.34,
         },
         "link_health": {"rtt_ms": 1129.22, "mbps": 125.13,
