@@ -38,6 +38,13 @@ LOCK_ORDER_EDGES: "dict[tuple[str, str], str]" = {
     ("scheduler.cv", "metrics.registry"): "2026-08-04 admission/inflight "
         "gauges published at the decision point under the condvar; the "
         "registry lock is a leaf (O(1) dict write, never calls out)",
+    ("scheduler.cv", "readahead.tasks"): "2026-08-06 a closing batch "
+        "submits its prepare-ahead ticket under the condvar — the worker "
+        "may pop the job immediately, so the ticket must exist before "
+        "the work-queue put (r22 pipelined prepare); the read-ahead lock "
+        "is a LEAF by construction (guards the task deque only; "
+        "submitted callables run strictly outside it — "
+        "utils/readahead.py docstring)",
     # ---- metrics as a leaf under component locks -------------------------
     ("scheduler.stats", "metrics.registry"): "2026-08-04 padding stats + "
         "occupancy gauge in one section (pad_traces); leaf write",

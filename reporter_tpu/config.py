@@ -304,6 +304,16 @@ class ServiceConfig:
     admission_queue_limit: int = 8192  # queued traces admitted before the
     #                                    service sheds with 503 (bounded
     #                                    memory; counted rejections)
+    # Pipelined wave prepare (r22): run the PURE host prepare for wave
+    # N+1 (column gather + lonlat→xy + native quantize/pack through the
+    # matcher's prepared seam) on a read-ahead thread while wave N
+    # occupies the device. Stateful steps (cache merge/retain, commit
+    # floor, checkpoint) stay strictly in wave order, so wire bytes and
+    # report streams are bit-identical to the serial loop — test- and
+    # bench-asserted. False = the serial loop, kept as the same-run A/B
+    # arm (r7-scheduler style). Only engages where overlap exists
+    # (streaming pipeline_depth >= 1; scheduler prefab path).
+    pipeline_prepare: bool = True
     # Publisher resilience (service/datastore.py). Defaults keep the
     # pre-chaos behavior exactly (one attempt, failures counted+dropped):
     # retries/dead-letter are DEPLOYMENT policy, opted into per worker.
@@ -370,6 +380,16 @@ class ServiceConfig:
             kw["publish_backoff_ms"] = float(e["DATASTORE_BACKOFF_MS"])
         if "DATASTORE_DEAD_LETTER_DIR" in e:
             kw["dead_letter_dir"] = e["DATASTORE_DEAD_LETTER_DIR"]
+        if "RTPU_PIPELINE_PREPARE" in e:
+            from reporter_tpu.utils.tracing import env_flag
+
+            try:
+                kw["pipeline_prepare"] = env_flag(
+                    e["RTPU_PIPELINE_PREPARE"], strict=True)
+            except ValueError:
+                raise ValueError(
+                    f"RTPU_PIPELINE_PREPARE={e['RTPU_PIPELINE_PREPARE']!r}: "
+                    "expected a boolean (1/0/true/false/yes/no/on/off)")
         if "RTPU_TRACE" in e:
             from reporter_tpu.utils.tracing import env_flag
 

@@ -67,6 +67,21 @@ class PreparedSlice(NamedTuple):
     scale: "np.ndarray | None"   # accuracy → emission scale, or None
 
 
+class PreparedBatch(NamedTuple):
+    """A whole match_many call's host prepare, done ahead of dispatch.
+
+    The round-22 wave-level seam: ``prepare_many`` runs the full plan +
+    per-slice prepare (all pure host work) so a read-ahead thread can
+    overlap wave N+1's prepare with wave N's device occupancy; passing
+    the result back via ``match_many(traces, prepared=...)`` makes the
+    dispatch loop submit the prebuilt slices instead of re-preparing.
+    Bit-identical by construction — the SAME plan_submit /
+    prepare_submit_slice calls in the SAME order, only moved in time."""
+
+    work: Any                    # plan_submit's work list
+    slices: "list[PreparedSlice]"   # in submission order
+
+
 class DispatchTimeout(RuntimeError):
     """A device dispatch exceeded ``matcher.dispatch_timeout_s``.
 
@@ -532,10 +547,14 @@ class SegmentMatcher:
 
     # ---- batched API (the TPU throughput path) --------------------------
 
-    def match_many(self, traces: Sequence[Trace],
+    def match_many(self, traces: Sequence[Trace], *,
+                   prepared: "PreparedBatch | None" = None,
                    ) -> "Sequence[list[SegmentRecord]]":
         """Sequence of per-trace record lists; the jax fast path returns a
-        lazy columnar MatchBatch (read .columns for bulk consumers)."""
+        lazy columnar MatchBatch (read .columns for bulk consumers).
+        ``prepared`` (from ``prepare_many`` on a read-ahead thread)
+        skips the inline host prepare — dispatch submits the prebuilt
+        slices; everything downstream is identical."""
         from reporter_tpu.utils.profiling import device_trace
 
         tr = tracing.tracer()
@@ -544,7 +563,7 @@ class SegmentMatcher:
             if self.backend == "reference_cpu":
                 out = [self._match_cpu(t) for t in traces]
             else:
-                out = self._guarded_jax_many(traces)
+                out = self._guarded_jax_many(traces, prepared)
         self.metrics.count("traces", len(traces))
         probes = sum(len(t.xy) for t in traces)
         self.metrics.count("probes", probes)
@@ -581,7 +600,8 @@ class SegmentMatcher:
             # (hold survives) are audit-eligible.
             quality_audit.maybe_audit(self, traces, result)
 
-    def _guarded_jax_many(self, traces: Sequence[Trace]):
+    def _guarded_jax_many(self, traces: Sequence[Trace],
+                          prepared: "PreparedBatch | None" = None):
         """Device dispatch under the watchdog (dispatch_timeout_s > 0).
 
         The watchdog runs the dispatch on a fresh daemon thread and
@@ -610,7 +630,7 @@ class SegmentMatcher:
         timeout = float(self.params.dispatch_timeout_s)
         if timeout <= 0:
             faults.fire("dispatch")
-            return self._match_jax_many(traces, hold)
+            return self._match_jax_many(traces, hold, prepared)
         if self._watchdog.tripped:
             # circuit open: enough abandoned dispatches are already stuck
             # on the dead link — degrade IMMEDIATELY rather than pin yet
@@ -631,7 +651,7 @@ class SegmentMatcher:
         # forever still shows up in the post-mortem as the last thing
         # the matcher started)
         out = self._watchdog.run(
-            lambda: self._match_jax_many(traces, hold),
+            lambda: self._match_jax_many(traces, hold, prepared),
             timeout, fault_site="dispatch")
         if out is not watchdog_mod.TIMED_OUT:
             return out
@@ -902,6 +922,36 @@ class SegmentMatcher:
             inflight.append((ws, self.submit_prepared(ps)))
         return work, inflight
 
+    # prepare_many is safe to call from a read-ahead thread; match_many
+    # consumers probe for this attribute before preparing ahead (a
+    # monkeypatched or duck-typed matcher without the seam gets the
+    # plain match_many call, no prepared kwarg).
+    supports_prepared = True
+
+    def prepare_many(self, traces: Sequence[Trace],
+                     ) -> "PreparedBatch | None":
+        """Pure host prepare of a whole batch, ahead of dispatch (r22).
+
+        Returns None (declining — the caller falls back to the plain
+        ``match_many(traces)`` call) unless the interleaved columnar
+        path would serve this batch: jax backend, tables staged, native
+        walker up, >1 trace, every trace within the largest bucket.
+        The decline checks mirror ``_match_jax_many``'s interleave
+        predicate so a prepared batch is only ever handed to the code
+        path that can consume it. Checks ``self._tables`` directly
+        rather than ``_require_staged`` — a fleet-demoted matcher on
+        the read-ahead thread must decline quietly (the promotion/lease
+        discipline re-runs prepare inline after promote), not raise on
+        a thread with no held lease."""
+        if (self.backend != "jax" or self._tables is None
+                or self._native_walker is None or len(traces) <= 1
+                or any(len(t.xy) > _BUCKETS[-1] for t in traces)):
+            return None
+        work, sliced = self.plan_submit(traces)
+        slices = [self.prepare_submit_slice(traces, work, b, ws)
+                  for b, ws in sliced]
+        return PreparedBatch(work, slices)
+
     def _decode_many(self, traces: Sequence[Trace]):
         """JAX decode for a list of traces → per-trace (edges, offsets,
         chain_starts) numpy triples, bucketed by padded length."""
@@ -936,6 +986,7 @@ class SegmentMatcher:
 
     def _match_jax_many(self, traces: Sequence[Trace],
                         quality_hold: "dict | None" = None,
+                        prepared: "PreparedBatch | None" = None,
                         ) -> "Sequence[list[SegmentRecord]]":
         # Interleaved harvest + walk: np.asarray on the next slice blocks
         # on the LINK (remote-attached chip) with the GIL released, and the
@@ -957,7 +1008,15 @@ class SegmentMatcher:
                 return self._walk_decoded(traces, decoded)
 
         with self.metrics.stage("decode"):
-            work, inflight = self._submit_many(traces)
+            if prepared is not None:
+                # read-ahead path: the host prepare already ran (same
+                # calls, same order — see PreparedBatch); only the async
+                # dispatches happen here, in the prepared slice order.
+                work = prepared.work
+                inflight = [(ps.ws, self.submit_prepared(ps))
+                            for ps in prepared.slices]
+            else:
+                work, inflight = self._submit_many(traces)
         slice_cols: list = [None] * len(inflight)
         unmatched = 0
 

@@ -256,9 +256,17 @@ class ReporterApp:
             if until is not None and until.done.is_set():
                 return
 
-    def _process_validated(self,
-                           validated: "list[tuple[str, list[dict]]]",
-                           ) -> list[dict]:
+    def _prefab_validated(self,
+                          validated: "list[tuple[str, list[dict]]]",
+                          ) -> tuple:
+        """The dispatch-free head of ``_process_validated``: in-batch
+        duplicate merge, cache merge (READ-only — retains are deferred
+        to the tail), Trace build, shape padding, and the matcher's
+        prepared seam. Safe to run AHEAD of the dispatch on the
+        scheduler's read-ahead thread (r22): the batch's uuids are
+        disjoint from every in-flight batch (per-uuid deferral), so the
+        cache tails it reads are exactly what an inline call would
+        read."""
         items = []
         in_batch: dict[str, list[dict]] = {}   # uuid → merged-so-far points
         for uuid, pts in validated:
@@ -284,8 +292,26 @@ class ReporterApp:
             # real traces are unchanged (batch-composition independence,
             # tests/test_determinism.py).
             traces = self.scheduler.pad_traces(traces)
+        prepared = None
+        if (self.config.service.pipeline_prepare
+                and getattr(self.matcher, "supports_prepared", False)
+                and "match_many" not in getattr(self.matcher,
+                                                "__dict__", {})):
+            prepared = self.matcher.prepare_many(traces)
+        return items, traces, n_real, prepared
+
+    def _process_validated(self,
+                           validated: "list[tuple[str, list[dict]]]",
+                           prefab: "tuple | None" = None,
+                           ) -> list[dict]:
+        if prefab is None:
+            prefab = self._prefab_validated(validated)
+        items, traces, n_real, prepared = prefab
         t0 = time.perf_counter()
-        per_trace = self.matcher.match_many(traces)
+        if prepared is not None:
+            per_trace = self.matcher.match_many(traces, prepared=prepared)
+        else:
+            per_trace = self.matcher.match_many(traces)
         dt = time.perf_counter() - t0
         if len(traces) > n_real:
             # match_many metered the padded list; the /stats north-star

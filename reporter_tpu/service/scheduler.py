@@ -51,6 +51,7 @@ from typing import TYPE_CHECKING, Sequence
 
 from reporter_tpu.utils import locks
 from reporter_tpu.utils import tracing
+from reporter_tpu.utils.readahead import ReadAheadWorker
 
 if TYPE_CHECKING:                            # pragma: no cover
     from reporter_tpu.matcher.api import Trace
@@ -138,6 +139,16 @@ class BatchScheduler:
                       "max_inflight_seen": 0}
         self.inflight_hist: dict[int, int] = {}   # dispatches at depth k
         self.padding_by_bucket: dict[int, int] = {}
+        # Prepare-ahead (r22): a closed batch's dispatch-free head
+        # (cache merge, Trace build, padding, the matcher's prepared
+        # seam — app._prefab_validated) runs on a read-ahead thread
+        # while earlier batches occupy the device. Per-uuid deferral
+        # makes it safe: a batch only closes with uuids disjoint from
+        # every in-flight batch, so the prefab reads exactly the cache
+        # tails an inline call would. Off (pipeline_prepare=False) =
+        # the serial arm, workers compute the head inline.
+        self._prefab = (ReadAheadWorker(name="sched-prepare")
+                        if svc.pipeline_prepare else None)
         self._work: "_queue.Queue" = _queue.Queue()
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True,
@@ -231,7 +242,16 @@ class BatchScheduler:
                 # and mis-tag its trace spans.
                 serial = self._dispatch_serial
                 self._dispatch_serial += 1
-                self._work.put((batch, uuids, serial))
+                # prepare-ahead ticket UNDER _cv too: the worker may pop
+                # the job immediately, so the ticket must exist before
+                # the put. (scheduler.cv → readahead.tasks is a dated
+                # contract edge; the submit only appends to a deque.)
+                ticket = None
+                if self._prefab is not None:
+                    combined = [pair for s in batch for pair in s.pairs]
+                    ticket = self._prefab.submit(
+                        lambda c=combined: self.app._prefab_validated(c))
+                self._work.put((batch, uuids, serial, ticket))
             now = self._clock()
             for s in batch:
                 self.metrics.observe("sched_queue_age_seconds",
@@ -292,13 +312,13 @@ class BatchScheduler:
     # ---- executor side ---------------------------------------------------
 
     def _run_batch(self, batch: "list[_ScheduledSubmission]", uuids,
-                   serial: int) -> None:
+                   serial: int, ticket=None) -> None:
         try:
             combined = [pair for s in batch for pair in s.pairs]
             with tracing.tracer().span("sched_batch", wave=serial,
                                        submissions=len(batch),
                                        traces=len(combined)):
-                self._run_batch_traced(batch, combined)
+                self._run_batch_traced(batch, combined, ticket)
         except Exception as exc:
             for s in batch:
                 s.error = exc
@@ -312,9 +332,18 @@ class BatchScheduler:
                 s.done.set()
 
     def _run_batch_traced(self, batch: "list[_ScheduledSubmission]",
-                          combined) -> None:
+                          combined, ticket=None) -> None:
         try:
-            results = self.app._process_validated(combined)
+            prefab = None
+            if ticket is not None:
+                try:
+                    prefab = ticket.result()
+                except Exception:
+                    # prepare-ahead failure (incl. a closed read-ahead
+                    # worker during drain) degrades to the inline head —
+                    # same work, same error surface, just not overlapped
+                    prefab = None
+            results = self.app._process_validated(combined, prefab=prefab)
             lo = 0
             for s in batch:
                 s.results = results[lo:lo + len(s.pairs)]
@@ -456,5 +485,10 @@ class BatchScheduler:
             s.done.set()
         for w in self._workers:
             w.join(timeout=_left(0.1))
+        if self._prefab is not None:
+            # after the workers: a draining worker's ticket must resolve
+            # before the read-ahead thread goes away (an unstarted
+            # ticket fails loudly and the worker recomputes inline)
+            self._prefab.close(timeout=_left(0.1))
         self.metrics.gauge("sched_inflight_batches", 0)
         self.metrics.gauge("sched_admission_depth", 0)

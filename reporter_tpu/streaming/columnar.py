@@ -706,8 +706,8 @@ class _InflightWave:
     oldest offset, so a checkpoint taken with the wave in flight replays
     it — at-least-once, never lost."""
 
-    __slots__ = ("id", "future", "uuids", "merged", "merged_flat", "codes",
-                 "holds", "arrive", "n_points", "published",
+    __slots__ = ("id", "future", "prep", "uuids", "merged", "merged_flat",
+                 "codes", "holds", "arrive", "n_points", "published",
                  "t_prep0", "t_submit", "t_result")
 
     def __init__(self, wid: int, codes: np.ndarray,
@@ -715,6 +715,8 @@ class _InflightWave:
                  n_points: int):
         self.id = wid
         self.future = None
+        self.prep = None        # read-ahead ticket → (traces, prepared);
+        #                         None once consumed / on the serial arm
         self.uuids: "list[str]" = []
         self.merged: "list[tuple]" = []
         # (lat, lon, time, acc, bounds) flat wave columns — the merged
@@ -823,6 +825,19 @@ class ColumnarStreamPipeline:
         self._pool = None                       # lazy 1-thread match executor
         self._inflight: "list[_InflightWave]" = []   # match leg (FIFO)
         self._pending: "list[_InflightWave]" = []    # publish attempt pending
+        # pipelined wave PREPARE (r22): with pipeline_prepare on, the
+        # pure half of wave prepare (trace build + the matcher's
+        # prepared seam) runs on a read-ahead thread while earlier
+        # waves occupy the device; stateful steps (cache merge/retain,
+        # commit floor, checkpoint) stay on this thread in wave order —
+        # wire bytes and report streams are bit-identical to the serial
+        # arm (test- and bench-asserted).
+        self._pp = bool(svc.pipeline_prepare) and self._depth > 0
+        self._ra = None                         # lazy read-ahead worker
+        self._staged: "list[_InflightWave]" = []   # staged ahead (FIFO),
+        #                                            not yet on the device
+        self._overlap_hits = 0    # read-ahead builds that overlapped a
+        self._overlap_total = 0   # device-occupied window (gauge basis)
         self._wave_serial = 0
         self._wave_ctl = (_WaveController(sc.flush_min_points,
                                           sc.wave_min_points,
@@ -912,8 +927,16 @@ class ColumnarStreamPipeline:
         if len(ripe):
             if self._depth == 0:
                 n_reports += self._flush(ripe)
+            elif self._pp:
+                # stage up to ONE wave beyond the device depth: its pure
+                # prepare runs on the read-ahead thread while the
+                # in-flight waves ride the link
+                if (len(self._inflight) + len(self._staged)
+                        < self._depth + 1):
+                    self._stage_readahead(ripe)
             elif len(self._inflight) < self._depth:
                 self._submit_wave(ripe)
+        self._promote_staged()
         self._commit()
         self._tick(now)
         self.steps += 1
@@ -928,6 +951,7 @@ class ColumnarStreamPipeline:
         and wait for the publisher — after this the pipelined worker is
         observably identical to the sequential one."""
         sc = self.config.streaming
+        self._promote_staged(drain=True)
         n = self._harvest(block=True)
         self._poll_all(sc.poll_max_records)
         stalls = 0
@@ -940,7 +964,11 @@ class ColumnarStreamPipeline:
             if self._depth == 0:
                 n += self._flush(ripe)
             else:
-                if not self._submit_wave(ripe):
+                if self._pp:
+                    if not self._stage_readahead(ripe):
+                        break
+                    self._promote_staged(drain=True)
+                elif not self._submit_wave(ripe):
                     break
                 n += self._harvest(block=True)
             if (self.dispatch_timeouts > before_to
@@ -982,9 +1010,10 @@ class ColumnarStreamPipeline:
         tails are retained at harvest, so a second merge now would read
         stale points. (Publish-pending waves don't bite — their retains
         already ran.)"""
-        if not self._inflight or not len(ripe):
+        busy_waves = self._inflight + self._staged
+        if not busy_waves or not len(ripe):
             return ripe
-        busy = np.concatenate([w.codes for w in self._inflight])
+        busy = np.concatenate([w.codes for w in busy_waves])
         return ripe[~np.isin(ripe, busy)]
 
     def _tick(self, now: float) -> None:
@@ -1006,6 +1035,11 @@ class ColumnarStreamPipeline:
                 len(self._inflight) + len(self._pending))
         m.gauge("stream_publish_pending", self.publisher.pending)
         m.gauge("stream_wave_points", self._wave_points)
+        if self._pp:
+            m.gauge("readahead_depth", len(self._staged))
+            total = self._overlap_total
+            m.gauge("prepare_overlap_pct",
+                    100.0 * self._overlap_hits / total if total else 0.0)
 
     def _poll_batches(self, p: int, offset: int, max_records: int,
                       ) -> "list[tuple[np.ndarray, ProbeColumns]]":
@@ -1104,11 +1138,14 @@ class ColumnarStreamPipeline:
 
     # ---- flush -----------------------------------------------------------
 
-    def _prepare_wave(self, ripe_codes: np.ndarray,
-                      ) -> "tuple[_InflightWave, list] | None":
-        """Select the ripe rows, merge cache tails, and build the matcher
-        traces (the host leg, caller's thread). The rows stay in the log
-        marked held=wave-id until the result is processed."""
+    def _stage_wave(self, ripe_codes: np.ndarray,
+                    ) -> "_InflightWave | None":
+        """STATEFUL half of wave prepare (pipeline thread ONLY): select
+        the ripe rows, merge cache tails in wave order, compute the
+        commit-floor holds, and mark the rows held=wave-id. Everything
+        the next wave's selection or the commit floor can observe
+        happens here — which is what lets ``_build_traces`` run on a
+        read-ahead thread without reordering any stateful step."""
         t_prep0 = self.clock()
         L = self._log
         # direct lookup, not np.isin: codes are dense interned ints, so a
@@ -1141,8 +1178,33 @@ class ColumnarStreamPipeline:
         lat_m, lon_m, t_m, acc_m, mb = self.cache.merge_wave(
             uuids, lat_w, lon_w, t_w, acc_w, bounds)
 
+        # commit-floor holds + arrival copy, then mark the rows held
+        parts = L.part[rows]
+        offs = L.off[rows]
+        holds = [(int(p), int(offs[parts == p].min()))
+                 for p in np.unique(parts)]
+        self._wave_serial += 1
+        # codes_sorted is sorted, so its run starts ARE the unique codes
+        wave = _InflightWave(self._wave_serial, codes_sorted[starts],
+                             holds, L.arrive[rows].copy(),
+                             n_points=int(mb[-1]))
+        wave.uuids = uuids
+        wave.merged_flat = (lat_m, lon_m, t_m, acc_m, mb)
+        wave.t_prep0 = t_prep0
+        L.held[rows] = wave.id
+        self._count[ripe_codes] = 0
+        return wave
+
+    def _build_traces(self, wave: "_InflightWave") -> list:
+        """PURE half of wave prepare: lonlat→xy + accuracy cleaning +
+        matcher Trace construction from the wave's already-merged flat
+        columns. Reads only the wave and immutable tileset metadata —
+        safe on the read-ahead thread while later waves stage."""
+        lat_m, lon_m, t_m, acc_m, mb = wave.merged_flat
+        uuids = wave.uuids
+
         # one lonlat→xy conversion for every flushed point
-        n_pts = int(mb[-1])
+        n_pts = wave.n_points
         lonlat = np.empty((n_pts, 2))
         lonlat[:, 0] = lon_m
         lonlat[:, 1] = lat_m
@@ -1167,24 +1229,19 @@ class ColumnarStreamPipeline:
             traces.append(Trace(
                 uuid=u, xy=xy[lo:hi], times=t_m[lo:hi],
                 accuracy=(acc_clean[lo:hi] if has_acc[v] else None)))
-
-        # commit-floor holds + arrival copy, then mark the rows held
-        parts = L.part[rows]
-        offs = L.off[rows]
-        holds = [(int(p), int(offs[parts == p].min()))
-                 for p in np.unique(parts)]
-        self._wave_serial += 1
-        # codes_sorted is sorted, so its run starts ARE the unique codes
-        wave = _InflightWave(self._wave_serial, codes_sorted[starts],
-                             holds, L.arrive[rows].copy(),
-                             n_points=n_pts)
-        wave.uuids = uuids
         wave.merged = merged
-        wave.merged_flat = (lat_m, lon_m, t_m, acc_m, mb)
-        wave.t_prep0 = t_prep0
+        return traces
+
+    def _prepare_wave(self, ripe_codes: np.ndarray,
+                      ) -> "tuple[_InflightWave, list] | None":
+        """Serial-arm wave prepare (the r6 shape): stateful staging +
+        trace build inline on the caller's thread. The rows stay in the
+        log marked held=wave-id until the result is processed."""
+        wave = self._stage_wave(ripe_codes)
+        if wave is None:
+            return None
+        traces = self._build_traces(wave)
         wave.t_submit = self.clock()
-        L.held[rows] = wave.id
-        self._count[ripe_codes] = 0
         return wave, traces
 
     def _match_pool(self):
@@ -1212,6 +1269,85 @@ class ColumnarStreamPipeline:
         self._inflight.append(wave)
         return True
 
+    # ---- pipelined wave prepare (r22) -----------------------------------
+
+    def _ra_worker(self):
+        if self._ra is None:
+            from reporter_tpu.utils.readahead import ReadAheadWorker
+            self._ra = ReadAheadWorker(name="wave-prepare")
+        return self._ra
+
+    def _stage_readahead(self, ripe_codes: np.ndarray) -> bool:
+        """Pipelined-prepare submit half: run the STATEFUL staging here
+        (wave order preserved), hand the pure trace build + matcher
+        prepare to the read-ahead thread, and queue the wave for
+        promotion once a device slot frees."""
+        wave = self._stage_wave(ripe_codes)
+        if wave is None:
+            return False
+        # The prepared seam needs the REAL matcher (prepare_many +
+        # match_many(prepared=...)). A duck-typed or monkeypatched
+        # stand-in (the test harnesses' gate matchers) gets the plain
+        # match_many call — the read-ahead thread still overlaps the
+        # trace build, just not the pack.
+        use_prepared = (getattr(self.matcher, "supports_prepared", False)
+                        and "match_many" not in getattr(
+                            self.matcher, "__dict__", {}))
+        wave.prep = self._ra_worker().submit(
+            lambda: self._build_prepared(wave, use_prepared))
+        self._staged.append(wave)
+        return True
+
+    def _build_prepared(self, wave: "_InflightWave", use_prepared: bool):
+        """Read-ahead thread body: the PURE prepare for one staged wave
+        (trace build + plan/pack through the matcher's prepared seam).
+        Touches no pipeline state — only the wave and endpoint-sampled
+        overlap counters (single-writer ints; the gauge is an
+        estimate)."""
+        overlapped = bool(self._inflight)
+        t0 = self.clock()
+        traces = self._build_traces(wave)
+        prepared = (self.matcher.prepare_many(traces)
+                    if use_prepared else None)
+        if self._tracer.enabled:
+            # the overlapped prepare attributes to its OWN span; the
+            # wave's `prepare` stage component still covers
+            # t_prep0→t_submit so the telescoping stays arithmetic
+            self._tracer.add("prepare_readahead", t0, self.clock(),
+                             wave=wave.id, traces=len(traces),
+                             packed=prepared is not None)
+        self._overlap_total += 1
+        if overlapped or self._inflight:
+            self._overlap_hits += 1
+        return traces, prepared
+
+    def _promote_staged(self, drain: bool = False) -> None:
+        """Move staged waves onto the device executor as slots free
+        (FIFO — wave order is the parity contract). ``drain`` ignores
+        the depth bound: shutdown must flush every staged wave."""
+        while self._staged and (drain
+                                or len(self._inflight) < self._depth):
+            wave = self._staged.pop(0)
+            wave.future = self._match_pool().submit(
+                self._timed_match_staged, wave)
+            self._inflight.append(wave)
+
+    def _timed_match_staged(self, wave: "_InflightWave"):
+        """Match-pool thread body for a read-ahead wave: wait for the
+        prepare ticket, stamp t_submit (so the `prepare` stage component
+        absorbs read-ahead queueing + slot wait and the components still
+        telescope), then dispatch — with the prebuilt pack when the
+        prepared seam produced one."""
+        traces, prepared = wave.prep.result()
+        wave.prep = None
+        wave.t_submit = self.clock()
+        t0 = time.perf_counter()
+        if prepared is not None:
+            result = self.matcher.match_many(traces, prepared=prepared)
+        else:
+            result = self.matcher.match_many(traces)
+        return result, time.perf_counter() - t0
+
     def _harvest(self, block: bool) -> int:
         """Process completed waves in submission order (FIFO: wave N+1
         must not retain cache tails before wave N). The non-blocking form
@@ -1222,6 +1358,16 @@ class ColumnarStreamPipeline:
             try:
                 result, match_dt = wave.future.result()
                 wave.t_result = self.clock()
+                # the pop freed a device slot: promote a staged wave
+                # BEFORE building this one's reports, so wave N+1
+                # occupies the device while wave N's report build runs
+                # (the three-stage overlap; prepare for N+2 rides the
+                # read-ahead thread). Stateful order is safe: in-flight
+                # waves are code-disjoint (_without_busy), so N+1's
+                # merge_wave touched no vehicle N's retain_wave will.
+                # Promote only on the success path — a failed wave's
+                # rows must go back in play before anything advances.
+                self._promote_staged()
                 n += self._complete_wave(wave, result, match_dt)
             except DispatchTimeout:
                 # graceful degradation, not death: the watchdog bounded a
@@ -1518,7 +1664,7 @@ class ColumnarStreamPipeline:
         # (in-flight waves' rows are still in the log — the scan above
         # already covers them; the explicit holds make it airtight)
         self._pending = [w for w in self._pending if not w.published]
-        for w in self._inflight + self._pending:
+        for w in self._inflight + self._staged + self._pending:
             holds.extend(w.holds)
         self.committed = commit_floor(self._consumed, holds)
 
@@ -1544,6 +1690,11 @@ class ColumnarStreamPipeline:
             "qhist_rows": int(len(self.qhist.nonzero_rows())),
             # pipelined-flush observability (mirrored as metrics gauges)
             "inflight_waves": len(self._inflight),
+            "staged_waves": len(self._staged),
+            "pipeline_prepare": bool(self._pp),
+            "prepare_overlap_pct": (
+                100.0 * self._overlap_hits / self._overlap_total
+                if self._overlap_total else 0.0),
             "publish_pending": sum(1 for w in self._pending
                                    if not w.published),
             "wave_points": int(self._wave_points),
@@ -1569,9 +1720,16 @@ class ColumnarStreamPipeline:
     def close(self) -> None:
         """Stop the background machinery (call drain() first for a
         graceful shutdown; close alone joins whatever is in flight)."""
+        # order matters: the match pool first (promoted waves' tickets
+        # need the read-ahead worker ALIVE to resolve), then the
+        # read-ahead worker (never-promoted tickets fail loudly — a
+        # stale ticket wait must error, not hang)
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
+        if self._ra is not None:
+            self._ra.close()
+            self._ra = None
         self.publisher.close()
 
     # ---- checkpoint / resume (StreamPipeline-compatible npz) -------------
@@ -1586,6 +1744,7 @@ class ColumnarStreamPipeline:
         ``committed`` was clamped below every then-unpublished wave (see
         _commit) — replay covers the wave, at-least-once, never lost."""
         from reporter_tpu.streaming.state import save_checkpoint
+        self._promote_staged(drain=True)
         self._harvest(block=True)
         self.publisher.drain()
         self._commit()
@@ -1601,6 +1760,7 @@ class ColumnarStreamPipeline:
         self._log = _Log()
         self._count[:] = 0
         self._inflight = []
+        self._staged = []
         self._pending = []
         self._prev_lag = 0
         self._last_flush_p50 = None

@@ -1,0 +1,123 @@
+"""Single-thread read-ahead executor for pipelined wave prepare (r22).
+
+The closed-loop serving paths (streaming columnar worker, batch
+scheduler) overlap the PURE host prepare for wave N+1 with wave N's
+device occupancy by handing the prepare callable to this worker. One
+daemon thread per worker instance — prepare is CPU-bound Python on a
+one-core host, so more threads would only contend; the win is
+overlapping host compute with the device/link wait, not host-host
+parallelism.
+
+Discipline (the r14 lockdep rules):
+
+  - the ONE lock is ``locks.named_condition("readahead.tasks")`` — a
+    single stable class name; per-instance names would blow up the
+    golden lock graph (the per-metro build-lock precedent).
+  - submitted callables run strictly OUTSIDE the condition: the lock
+    only guards the task deque. A task's own lock acquisitions
+    (cache.entries, metrics.registry, ...) therefore start from an
+    empty held-set and add no contract edges.
+  - tickets resolve via a per-ticket ``threading.Event`` (not a
+    condvar wait): ``Event.wait`` is not a patched blocking call, and
+    waiters never hold ``readahead.tasks`` while waiting.
+
+``close()`` fails every never-started ticket with ``RuntimeError`` so
+a consumer waiting on a ticket after shutdown gets a loud error, never
+a hang. Tasks already running complete normally (their ticket resolves
+with the real result).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable
+
+from reporter_tpu.utils import locks
+
+
+class ReadAheadClosed(RuntimeError):
+    """Ticket failed because the worker was closed before it ran."""
+
+
+class Ticket:
+    """Handle for one submitted prepare task. ``result()`` blocks until
+    the task ran (or the worker closed) and re-raises the task's error
+    in the caller's thread — the prepare exception surfaces on the wave
+    that would have consumed the prepare, which is exactly where the
+    serial loop would have raised it."""
+
+    __slots__ = ("_done", "_result", "_error")
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._result: Any = None
+        self._error: "BaseException | None" = None
+
+    def _resolve(self, result: Any = None,
+                 error: "BaseException | None" = None) -> None:
+        self._result = result
+        self._error = error
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: "float | None" = None) -> Any:
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError("read-ahead ticket not resolved in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class ReadAheadWorker:
+    """One daemon thread draining a FIFO of prepare callables."""
+
+    def __init__(self, name: str = "readahead") -> None:
+        self._cv = locks.named_condition("readahead.tasks")
+        self._tasks: "deque[tuple[Ticket, Callable[[], Any]]]" = deque()
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def submit(self, fn: Callable[[], Any]) -> Ticket:
+        t = Ticket()
+        with self._cv:
+            if self._closed:
+                raise ReadAheadClosed("read-ahead worker is closed")
+            self._tasks.append((t, fn))
+            self._cv.notify()
+        return t
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._tasks:
+                    if self._closed:
+                        return
+                    self._cv.wait()
+                ticket, fn = self._tasks.popleft()
+            # run OUTSIDE the condition: the lock guards the deque only
+            try:
+                ticket._resolve(result=fn())
+            except BaseException as exc:  # resolve, never kill the thread
+                ticket._resolve(error=exc)
+
+    def close(self, timeout: "float | None" = 5.0) -> None:
+        """Stop accepting work, fail queued-but-unstarted tickets, join
+        the thread (bounded — a task wedged on a dead link must not
+        wedge shutdown; the thread is a daemon). Idempotent."""
+        with self._cv:
+            if self._closed:
+                pending: "list[Ticket]" = []
+            else:
+                self._closed = True
+                pending = [t for t, _ in self._tasks]
+                self._tasks.clear()
+            self._cv.notify_all()
+        for t in pending:
+            t._resolve(error=ReadAheadClosed(
+                "read-ahead worker closed before task ran"))
+        self._thread.join(timeout=timeout)

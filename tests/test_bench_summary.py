@@ -73,7 +73,12 @@ def _synthetic_doc():
         },
         "streaming": {"probes_per_sec": 435000.7},
         "streaming_soak": {"sustained_pps": 104000.8, "end_lag": 0,
-                           "p50_probe_to_report_ms": 2480.9},
+                           "p50_probe_to_report_ms": 2480.9,
+                           # r22 prepare A/B: speedup rides x100 int +
+                           # one folded identity bit
+                           "prepare_ab": {"pipelined_speedup": 12.34,
+                                          "wire_bytes_identical": True,
+                                          "reports_identical": True}},
         "streaming_capacity": {"best_held_pps": 150000.1},
         "streaming_overload": {"broker_rejected": 1234567},
         "device_compute": {"colocated_probes_per_sec": 3150000.2,

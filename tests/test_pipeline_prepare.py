@@ -1,0 +1,517 @@
+"""Pipelined wave prepare (r22): the closed serving loop overlaps the
+PURE host prepare for wave N+1 (trace build + plan/quantize/pack through
+the matcher's prepared seam) with wave N's device flight, on a
+read-ahead thread. Stateful steps — cache merge_wave/retain_wave,
+commit-floor holds, checkpoint — stay strictly in wave order, so the
+contract is BIT-IDENTITY with the serial loop:
+
+  - wire inputs through submit_prepared (both arms funnel through the
+    one seam) are byte-identical, wave for wave, slice for slice;
+  - published report streams, commit floors, histograms, and cache
+    contents are equal;
+  - checkpoints cross-restore between arms, including a mid-wave kill
+    (in-flight read-ahead) resumed by the OTHER arm;
+  - the scheduler's per-uuid deferral ordering is unchanged when its
+    prepare-ahead prefab runs (batches still close uuid-disjoint from
+    the in-flight set).
+
+The matcher-level seam (prepare_many → match_many(prepared=...)) and
+the read-ahead worker's ticket semantics get direct unit coverage too.
+"""
+
+import hashlib
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from reporter_tpu.config import (CompilerParams, Config, ServiceConfig,
+                                 StreamingConfig)
+from reporter_tpu.matcher.api import SegmentMatcher
+from reporter_tpu.matcher.segments import SegmentRecord
+from reporter_tpu.netgen.synthetic import generate_city
+from reporter_tpu.netgen.traces import synthesize_probe
+from reporter_tpu.service.app import make_app
+from reporter_tpu.streaming import ColumnarStreamPipeline
+from reporter_tpu.tiles.compiler import compile_network
+from reporter_tpu.utils.readahead import ReadAheadClosed, ReadAheadWorker
+
+
+@pytest.fixture(scope="module")
+def tiles():
+    return compile_network(
+        generate_city("tiny"),
+        CompilerParams(reach_radius=500.0, osmlr_max_length=200.0))
+
+
+# ---------------------------------------------------------------------------
+# read-ahead worker ticket semantics
+
+
+class TestReadAheadWorker:
+    def test_results_in_submission_order(self):
+        w = ReadAheadWorker(name="t-order")
+        try:
+            tickets = [w.submit(lambda k=k: k * k) for k in range(8)]
+            assert [t.result(5.0) for t in tickets] == \
+                   [k * k for k in range(8)]
+        finally:
+            w.close()
+
+    def test_error_rethrown_at_result(self):
+        w = ReadAheadWorker(name="t-err")
+        try:
+            def boom():
+                raise ValueError("prepared boom")
+
+            t = w.submit(boom)
+            with pytest.raises(ValueError, match="prepared boom"):
+                t.result(5.0)
+            # the worker survives a failing task
+            assert w.submit(lambda: "alive").result(5.0) == "alive"
+        finally:
+            w.close()
+
+    def test_close_fails_pending_and_rejects_new(self):
+        w = ReadAheadWorker(name="t-close")
+        gate = threading.Event()
+        running = threading.Event()
+
+        def wait_gate():
+            running.set()
+            assert gate.wait(5.0)
+            return "ran"
+
+        t1 = w.submit(wait_gate)
+        assert running.wait(5.0)
+        t2 = w.submit(lambda: "never")       # queued behind the gate
+        gate.set()
+        w.close()
+        assert t1.result(5.0) == "ran"       # in-flight task completes
+        with pytest.raises(ReadAheadClosed):
+            t2.result(0.0)                   # queued-only task fails loudly
+        with pytest.raises(ReadAheadClosed):
+            w.submit(lambda: 1)
+
+
+# ---------------------------------------------------------------------------
+# matcher-level prepared seam
+
+
+def _probe_traces(tiles, n, seed0=300, num_points=40):
+    from reporter_tpu.matcher.api import Trace
+
+    traces = []
+    for i in range(n):
+        p = synthesize_probe(tiles, seed=seed0 + i, num_points=num_points,
+                             gps_sigma=3.0)
+        traces.append(Trace(uuid=f"pp-{i}", xy=p.xy.astype(np.float32),
+                            times=p.times))
+    return traces
+
+
+def _capture_wire(matcher, sink):
+    """Wrap submit_prepared so every dispatched slice's wire INPUT bytes
+    land in ``sink`` as a digest — both arms funnel through this one
+    seam, so equal digests mean equal wire bytes by construction."""
+    real = matcher.submit_prepared
+
+    def wrapped(ps):
+        h = hashlib.sha256()
+        h.update(np.int64([ps.b, ps.mode]).tobytes())
+        h.update(np.asarray(ps.ws, np.int64).tobytes())
+        payload = ps.payload if ps.mode else ps.pts
+        h.update(np.ascontiguousarray(payload).tobytes())
+        h.update(np.ascontiguousarray(ps.origins).tobytes()
+                 if ps.origins is not None else b"-")
+        h.update(np.ascontiguousarray(ps.lens).tobytes())
+        h.update(np.ascontiguousarray(ps.scale).tobytes()
+                 if ps.scale is not None else b"-")
+        sink.append(h.hexdigest())
+        return real(ps)
+
+    matcher.submit_prepared = wrapped
+
+
+def _record_rows(result):
+    rows = []
+    for recs in result:
+        rows.append([(r.segment_id, round(r.start_time, 9),
+                      round(r.end_time, 9), round(r.length, 6),
+                      r.internal, tuple(r.way_ids)) for r in recs])
+    return rows
+
+
+class TestPreparedSeam:
+    def test_prepared_match_bit_identical_to_inline(self, tiles):
+        traces = _probe_traces(tiles, 6)
+        m_a = SegmentMatcher(tiles, Config(matcher_backend="jax"))
+        m_b = SegmentMatcher(tiles, Config(matcher_backend="jax"))
+        wires_a, wires_b = [], []
+        _capture_wire(m_a, wires_a)
+        _capture_wire(m_b, wires_b)
+
+        inline = m_a.match_many(traces)
+        prepared = m_b.prepare_many(traces)
+        assert prepared is not None and len(prepared.slices) >= 1
+        ahead = m_b.match_many(traces, prepared=prepared)
+
+        assert wires_b == wires_a            # same slices, same bytes
+        assert _record_rows(ahead) == _record_rows(inline)
+
+    def test_prepare_many_declines_out_of_contract_batches(self, tiles):
+        m = SegmentMatcher(tiles, Config(matcher_backend="jax"))
+        traces = _probe_traces(tiles, 3)
+        assert m.prepare_many(traces[:1]) is None         # single trace
+        big = _probe_traces(tiles, 1, seed0=990, num_points=1200)
+        assert m.prepare_many(traces[:1] + big) is None   # over max bucket
+        ref = SegmentMatcher(tiles, Config(matcher_backend="reference_cpu"))
+        assert ref.prepare_many(traces) is None           # wrong backend
+
+    def test_prepare_many_counts_host_prepare_form(self, tiles):
+        m = SegmentMatcher(tiles, Config(matcher_backend="jax"))
+        traces = _probe_traces(tiles, 4)
+        before = (m.metrics.value("prepare_native_total")
+                  + m.metrics.value("prepare_python_total"))
+        assert m.prepare_many(traces) is not None
+        after = (m.metrics.value("prepare_native_total")
+                 + m.metrics.value("prepare_python_total"))
+        assert after > before                # the ahead-prepare is counted
+
+
+# ---------------------------------------------------------------------------
+# closed-loop arm parity: pipelined vs serial
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def _records(probes):
+    out = []
+    T = max(len(p.times) for p in probes)
+    for t in range(T):
+        for p in probes:
+            if t < len(p.times):
+                out.append({"uuid": p.uuid, "lat": float(p.lonlat[t, 1]),
+                            "lon": float(p.lonlat[t, 0]),
+                            "time": float(p.times[t])})
+    return out
+
+
+def _mk_pipe(tiles, pipelined, sink, queue=None, **stream_kw):
+    stream_kw.setdefault("flush_min_points", 16)
+    stream_kw.setdefault("flush_max_age", 5.0)
+    stream_kw.setdefault("poll_max_records", 400)
+    stream_kw.setdefault("hist_flush_interval", 0.0)
+    stream_kw.setdefault("pipeline_depth", 1)
+    cfg = Config(service=ServiceConfig(datastore_url="http://ds.test/",
+                                       pipeline_prepare=pipelined),
+                 streaming=StreamingConfig(**stream_kw))
+    clock = FakeClock()
+    pipe = ColumnarStreamPipeline(
+        tiles, cfg, clock=clock, queue=queue,
+        transport=lambda u, b: sink.append(json.loads(b)) or 200)
+    return pipe, clock
+
+
+def _published(sink):
+    rows = []
+    for payload in sink:
+        for r in payload.get("reports", []):
+            rows.append((r["id"], r["next_id"] if r["next_id"] is not None
+                         else -1, round(r["t0"], 6), round(r["t1"], 6),
+                         round(r["length"], 4)))
+    return sorted(rows)
+
+
+def _chunks(recs, n):
+    size = (len(recs) + n - 1) // n
+    return [recs[i:i + size] for i in range(0, len(recs), size)]
+
+
+def _run_chunks(pipe, clock, chunks):
+    """Deterministic flush schedule: each chunk is appended, stepped
+    once (the step-created wave's composition is fixed — the prior
+    drain left no busy codes), then drained to quiescence. Wave
+    boundaries are therefore schedule-determined in BOTH arms, which is
+    what makes byte-level comparison across runs meaningful (harvest
+    thread timing must not move points between waves)."""
+    for chunk in chunks:
+        pipe.queue.append_many(chunk)
+        clock.now += 1.0
+        pipe.step()
+        pipe.drain()
+
+
+class TestArmParity:
+    def test_pipelined_arm_matches_serial_arm_exactly(self, tiles):
+        probes = [synthesize_probe(tiles, seed=700 + s, num_points=40,
+                                   gps_sigma=3.0) for s in range(10)]
+        chunks = _chunks(_records(probes), 4)
+        runs = {}
+        for arm in (False, True):
+            sink: list = []
+            pipe, clock = _mk_pipe(tiles, arm, sink)
+            wires: list = []
+            _capture_wire(pipe.matcher, wires)
+            _run_chunks(pipe, clock, chunks)
+            hist = pipe.hist.snapshot().copy()
+            cache = {u: d["points"]
+                     for u, d in pipe.cache.dump().items()}
+            st = pipe.stats()
+            runs[arm] = dict(wires=wires, reports=_published(sink),
+                             committed=list(pipe.committed), hist=hist,
+                             cache=cache, stats=st)
+            pipe.close()
+        a, b = runs[False], runs[True]
+        assert b["wires"] == a["wires"]          # wire bytes, wave order
+        assert b["reports"] == a["reports"]      # published stream
+        assert b["committed"] == a["committed"]
+        np.testing.assert_array_equal(b["hist"], a["hist"])
+        assert b["cache"] == a["cache"]
+        # the pipelined arm really ran the read-ahead machinery
+        assert b["stats"]["pipeline_prepare"] and not a["stats"][
+            "pipeline_prepare"]
+        assert len(b["wires"]) >= 2              # multiple waves dispatched
+
+    def test_checkpoint_cross_restores_between_arms(self, tiles, tmp_path):
+        """A pipelined worker's checkpoint resumes under the serial arm
+        (and vice versa) with the combined report stream equal to one
+        uninterrupted run on the same schedule — the cut is a wave
+        boundary in both arms by construction (checkpoint promotes +
+        joins staged waves)."""
+        probes = [synthesize_probe(tiles, seed=740 + s, num_points=40,
+                                   gps_sigma=3.0) for s in range(8)]
+        chunks = _chunks(_records(probes), 4)
+
+        ref_sink: list = []
+        ref, ref_clock = _mk_pipe(tiles, False, ref_sink)
+        _run_chunks(ref, ref_clock, chunks)
+        expected = _published(ref_sink)
+        assert expected
+        ref.close()
+
+        for first_arm in (True, False):
+            sink: list = []
+            p1, c1 = _mk_pipe(tiles, first_arm, sink)
+            _run_chunks(p1, c1, chunks[:2])
+            path = str(tmp_path / f"cut-{first_arm}.npz")
+            p1.checkpoint(path)
+            p1.close()
+
+            # the replacement resumes over the SAME broker (the restored
+            # offsets index into it), under the OTHER arm
+            p2, c2 = _mk_pipe(tiles, not first_arm, sink,
+                              queue=p1.queue)
+            p2.restore(path)
+            c2.now = c1.now
+            _run_chunks(p2, c2, chunks[2:])
+            p2.drain()
+            assert _published(sink) == expected, first_arm
+            p2.close()
+
+
+class GateMatcher:
+    """match_many stand-in (blocks on ``gate``) — its presence in the
+    matcher __dict__ makes the read-ahead path decline the prepared
+    seam but still overlap the trace build, which is the machinery the
+    kill tests need to hold mid-flight."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.calls = 0
+
+    def __call__(self, traces):
+        self.calls += 1
+        assert self.gate.wait(10), "test gate never released"
+        out = []
+        for t in traces:
+            t0 = float(t.times[0]) if len(t.times) else 0.0
+            t1 = float(t.times[-1]) if len(t.times) else 1.0
+            out.append([SegmentRecord(segment_id=7001, way_ids=[1],
+                                      start_time=t0,
+                                      end_time=max(t1, t0 + 0.5),
+                                      length=50.0, internal=False)])
+        return out
+
+
+def _spin(pipe, predicate, seconds=5.0):
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        pipe.step()
+        if predicate(pipe.stats()):
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"condition never reached; stats={pipe.stats()}")
+
+
+class TestReadAheadFailure:
+    def test_readahead_prepare_failure_releases_wave_for_retry(
+            self, tiles):
+        """A transient failure ON the read-ahead thread (the ticket
+        resolves with an error) must put the wave's rows back in play
+        exactly like an inline failure: the ticket error re-raises at
+        promotion, _harvest releases the held rows, and the retry
+        publishes the full wave — never lost, never leaked held."""
+        sink: list = []
+        pipe, clock = _mk_pipe(tiles, True, sink, flush_min_points=8,
+                               flush_max_age=1e9)
+        boom = {"armed": True}
+        real = pipe.matcher.prepare_many
+
+        def flaky(traces):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("transient prepare failure")
+            return real(traces)
+
+        pipe.matcher.prepare_many = flaky
+        probe = synthesize_probe(tiles, seed=910, num_points=20,
+                                 gps_sigma=3.0)
+        pipe.queue.append_many(_records([probe]))
+        with pytest.raises(RuntimeError, match="transient prepare"):
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                pipe.step()
+                time.sleep(0.005)
+        assert min(pipe.committed) == 0      # floor still under the wave
+        _spin(pipe, lambda s: s["reports"] >= 1)
+        pipe.drain()
+        assert pipe.committed == pipe._consumed
+        assert len([r for p in sink
+                    for r in p.get("reports", [])]) >= 1
+        pipe.close()
+
+
+class TestMidWaveKill:
+    def test_kill_with_readahead_in_flight_resumes_in_other_arm(
+            self, tiles):
+        """At-least-once across arms: kill a pipelined worker while a
+        staged wave's read-ahead prepare is in flight (match gated); a
+        serial-arm replacement built from the committed offsets replays
+        the wave — zero lost rows, and the replay publishes exactly the
+        wave's reports (zero duplicates: the first worker never
+        published)."""
+        sink1: list = []
+        p1, c1 = _mk_pipe(tiles, True, sink1, flush_min_points=3,
+                          flush_max_age=1e9)
+        gate = GateMatcher()
+        p1.matcher.match_many = gate
+        queue = p1.queue
+        gate.gate.clear()
+        queue.append_many([{"uuid": "veh-k", "lat": 37.7749 + 1e-5 * t,
+                            "lon": -122.4194, "time": float(t)}
+                           for t in range(4)])
+        p1.step()
+        st = p1.stats()
+        assert st["inflight_waves"] + st["staged_waves"] == 1
+        assert min(p1.committed) == 0       # floor held under the wave
+        committed = list(p1.committed)
+
+        sink2: list = []
+        p2, c2 = _mk_pipe(tiles, False, sink2, flush_min_points=3,
+                          flush_max_age=1e9)
+        p2.matcher.match_many = GateMatcher()
+        p2.queue = queue
+        p2._consumed = list(committed)
+        p2.committed = list(committed)
+        _spin(p2, lambda s: s["reports"] >= 1)
+        p2.drain()
+        assert len([r for payload in sink2
+                    for r in payload.get("reports", [])]) == 1
+        assert sink1 == []                  # the dead worker never published
+        gate.gate.set()                     # release the zombie's threads
+        p1.close()
+        p2.close()
+
+
+# ---------------------------------------------------------------------------
+# scheduler prepare-ahead: deferral ordering + bit-identity
+
+
+def _payload(uuid, n=6, t0=0.0):
+    return {"uuid": uuid, "trace": [
+        {"lat": 37.7749 + 1e-5 * (t0 + i), "lon": -122.4194,
+         "time": t0 + float(i)} for i in range(n)]}
+
+
+def _bg(fn, *args):
+    out: dict = {}
+
+    def run():
+        try:
+            out["result"] = fn(*args)
+        except Exception as exc:
+            out["error"] = exc
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    out["thread"] = th
+    return out
+
+
+class TestSchedulerPrefab:
+    def test_deferral_ordering_unchanged_under_prepare_ahead(self, tiles):
+        """uuid X's second request must still wait out X's in-flight
+        batch when the prefab thread runs requests' host prepare ahead
+        — prepare-ahead must never let a deferred uuid's merge read the
+        cache before the prior batch's retain."""
+        from tests.test_scheduler import GateMatcher as SchedGate
+
+        cfg = Config(matcher_backend="jax",
+                     service=ServiceConfig(batch_close_ms=1.0,
+                                           max_inflight_batches=2,
+                                           pipeline_prepare=True))
+        app = make_app(tiles, cfg, transport=lambda u, b: 200)
+        assert app.scheduler._prefab is not None     # prepare-ahead armed
+        fake = SchedGate()
+        app.matcher.match_many = fake
+        fake.gate.clear()
+        j1 = _bg(app.report_one, _payload("x", n=6))
+        deadline = time.monotonic() + 5.0
+        while not fake.sizes and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert fake.sizes
+        j2 = _bg(app.report_one, _payload("x", n=6, t0=6.0))
+        time.sleep(0.1)
+        assert len(fake.sizes) == 1         # deferred, not dispatched
+        fake.gate.set()
+        for j in (j1, j2):
+            j["thread"].join(5.0)
+            assert "result" in j, j.get("error")
+        assert len(fake.sizes) == 2
+        assert app.scheduler.snapshot()["deferred"] >= 1
+        app.close()
+
+    def test_prefab_reports_identical_to_prefab_off(self, tiles):
+        payloads = []
+        for i in range(6):
+            p = synthesize_probe(tiles, seed=860 + i, num_points=40,
+                                 gps_sigma=3.0).to_report_json()
+            p["uuid"] = f"pf-{i}"
+            payloads.append(p)
+        results = {}
+        for arm in (False, True):
+            app = make_app(tiles, Config(
+                matcher_backend="jax",
+                service=ServiceConfig(batching="scheduler",
+                                      batch_close_ms=5.0,
+                                      pipeline_prepare=arm)),
+                transport=lambda u, b: 200)
+            assert (app.scheduler._prefab is not None) == arm
+            jobs = [_bg(app.report_one, p) for p in payloads]
+            for j in jobs:
+                j["thread"].join(60.0)
+                assert "result" in j, j.get("error")
+            results[arm] = [json.dumps(j["result"], sort_keys=True)
+                            for j in jobs]
+            app.close()
+        assert results[True] == results[False]

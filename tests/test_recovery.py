@@ -280,15 +280,20 @@ def _record_chunks(fleet, k=10):
 
 
 def _drive(ts, fleet, plan=None, timeout_s=0.0, fallback="retry",
-           queue=None, transport=None):
+           queue=None, transport=None, pipelined=True, crash_ok=False):
     """Feed the fleet through a pipelined columnar worker under a fault
-    plan; returns (published report-row keys, stats)."""
+    plan; returns (published report-row keys, stats). ``pipelined``
+    selects the r22 read-ahead arm (the default, as in production) vs
+    the serial prepare loop; ``crash_ok`` swallows-and-counts injected
+    crashes surfacing from a step (the wave-release retry path re-runs
+    them on the next step)."""
     queue = queue or ColumnarIngestQueue(4)
     cfg = Config(
         matcher_backend="jax",
         matcher=MatcherParams(dispatch_timeout_s=timeout_s,
                               dispatch_fallback=fallback),
-        service=ServiceConfig(datastore_url="http://sink.invalid/"),
+        service=ServiceConfig(datastore_url="http://sink.invalid/",
+                              pipeline_prepare=pipelined),
         streaming=StreamingConfig(flush_min_points=20,
                                   hist_flush_interval=0.0,
                                   pipeline_depth=1))
@@ -296,18 +301,30 @@ def _drive(ts, fleet, plan=None, timeout_s=0.0, fallback="retry",
     pipe = ColumnarStreamPipeline(
         ts, cfg, queue=queue,
         transport=transport or (lambda u, b: (captured.append(b), 200)[1]))
+    crashes = 0
+
+    def step(force_flush=False):
+        nonlocal crashes
+        try:
+            pipe.step(force_flush=force_flush)
+        except faults.InjectedCrash:
+            if not crash_ok:
+                raise
+            crashes += 1      # wave released by _harvest; next step retries
+
     with faults.use(plan):
         for batch in _record_chunks(fleet):
             queue.append_many(batch)
-            pipe.step()
+            step()
         for _ in range(30):
-            pipe.step()
+            step()
             st = pipe.stats()
             if (queue.lag(pipe.committed) == 0
                     and st["buffered_points"] == 0):
                 break
-        pipe.drain()
+        step(force_flush=True)
     st = pipe.stats()
+    st["injected_crashes"] = crashes
     pipe.close()
     rows = []
     for body in captured:
@@ -329,6 +346,39 @@ def test_dispatch_timeout_releases_wave_and_retry_is_bit_identical(
     plan = faults.FaultPlan.parse("dispatch:hang(1.5)@1")
     rows1, st1 = _drive(chaos_tiles, chaos_fleet, plan=plan, timeout_s=0.4)
     assert st1["dispatch_timeouts"] == 1
+    assert rows1 == rows0
+
+
+def test_dispatch_timeout_retry_bit_identical_across_prepare_arms(
+        chaos_tiles, chaos_fleet):
+    """r22: the watchdog-release-retry contract holds in BOTH prepare
+    arms, and the retried pipelined stream equals the uninterrupted
+    SERIAL stream — the injected hang fires inside a wave whose
+    successor's read-ahead prepare is already staged, so the release
+    path is exercised with a ticket in flight."""
+    rows0, st0 = _drive(chaos_tiles, chaos_fleet, pipelined=False)
+    assert len(rows0) > 0 and st0["pipeline_prepare"] is False
+    plan = faults.FaultPlan.parse("dispatch:hang(1.5)@1")
+    rows1, st1 = _drive(chaos_tiles, chaos_fleet, plan=plan, timeout_s=0.4,
+                        pipelined=True)
+    assert st1["pipeline_prepare"] is True
+    assert st1["dispatch_timeouts"] == 1
+    assert rows1 == rows0                 # zero lost, zero duplicated
+
+
+def test_injected_crash_in_pipelined_wave_retry_bit_identical(
+        chaos_tiles, chaos_fleet):
+    """Backfill-style chaos (``site:crash@N`` → InjectedCrash) inside
+    the pipelined wave path: the crash surfaces through the match
+    future, _harvest releases the wave's held rows and re-raises, the
+    driver retries — published rows identical to the serial
+    uninterrupted run, zero lost/dup."""
+    rows0, _ = _drive(chaos_tiles, chaos_fleet, pipelined=False)
+    plan = faults.FaultPlan.parse("dispatch:crash@1")
+    rows1, st1 = _drive(chaos_tiles, chaos_fleet, plan=plan,
+                        pipelined=True, crash_ok=True)
+    assert st1["injected_crashes"] == 1
+    assert st1["pipeline_prepare"] is True
     assert rows1 == rows0
 
 

@@ -212,6 +212,39 @@ def test_lockdep_blocking_allowlist_waives():
     assert dep.violations == []
 
 
+def test_lockdep_readahead_task_under_tasks_lock_is_flagged():
+    """r22 seeded violation: the read-ahead discipline (utils/readahead)
+    is that submitted tasks run OUTSIDE the "readahead.tasks" condvar —
+    a task body executed while the deque lock is held is exactly the
+    regression this detector must catch (blocking prepare work under
+    the lock would serialize the pipeline and stall every submitter)."""
+    dep = locks.Lockdep()
+    cv = locks.NamedCondition("readahead.tasks", dep=dep)
+    with locks.use(dep):
+        with cv:
+            time.sleep(0)            # a task body's blocking work
+    assert any(v["kind"] == "blocking-under-lock"
+               and "readahead.tasks" in v["held"] for v in dep.violations)
+
+
+def test_readahead_worker_runs_tasks_outside_its_lock():
+    """r22 clean twin: the REAL worker pops under its condvar and runs
+    the callable outside it, so a blocking task body records nothing in
+    the session-armed global ledger (which the conftest gate asserts
+    clean around every tier-1 test) — assert it directly too so this
+    twin fails next to its seeded pair, not one fixture away."""
+    from reporter_tpu.utils.readahead import ReadAheadWorker
+
+    before = len(locks.global_dep().violations)
+    w = ReadAheadWorker(name="lockdep-twin")
+    try:
+        t = w.submit(lambda: time.sleep(0) or "done")
+        assert t.result(5.0) == "done"
+    finally:
+        w.close()
+    assert locks.global_dep().violations[before:] == []
+
+
 def test_lockdep_foreign_condvar_wait_is_flagged():
     dep = locks.Lockdep()
     outer = locks.NamedLock("syn.outer", dep=dep)
