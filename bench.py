@@ -3164,6 +3164,245 @@ def _fleet_bench(tpu_ok: bool, n_metros: int = 8) -> dict:
     }
 
 
+def _topology_lease_arm(workdir: str, tiles_path: str, cfg_path: str,
+                        batches, n_pt: int, cycles: int = 2,
+                        timeout: float = 120.0) -> dict:
+    """detail.topology.lease (round 23) — ELASTIC membership under
+    in-worker chaos, riding the main arm's tile/config/compile-cache:
+    2 lease-mode workers bootstrap over a 4-partition broker through
+    the epoch-fenced lease table (distributed/lease.py), a cold worker
+    JOINS mid-soak (supervisor rebalance → revoke toward the newcomer
+    → adoption at committed floors), a leased worker takes a SIGKILL
+    (lease expiry → orphan → reassignment), and worker lease-a runs an
+    RTPU_FAULTS plan INSIDE itself (publisher faults the retry
+    machinery absorbs + an injected mid-checkpoint crash that kills the
+    process hard). Asserted: join→first-acquire and kill→reacquire
+    latency, fencing (the killed worker's stale-epoch commit rejected),
+    offset-granularity conservation (floors reach end offsets with
+    commit spans never overlapping — zero lost, zero duplicated), and
+    per-worker fault stats surfaced through the snapshot gauges (the
+    crashed incarnation prints no exit report — the spool is the
+    surviving record)."""
+    from reporter_tpu.distributed import Supervisor, worker_member
+    from reporter_tpu.distributed.lease import LeaseTable, StaleLeaseError
+    from reporter_tpu.streaming.durable_queue import DurableIngestQueue
+
+    arm_dir = os.path.join(workdir, "lease_arm")
+    broker_dir = os.path.join(arm_dir, "broker")
+    lease_dir = os.path.join(arm_dir, "leases")
+    os.makedirs(arm_dir, exist_ok=True)
+    # ttl must comfortably exceed the worker's first-flush compile
+    # stall on this one-core box (~2-4 s even cache-warm): a shorter
+    # ttl makes every startup a lease-loss storm (measured at 1.2 s:
+    # 12 lease_lost events, ~12 s of discard/reconsume churn)
+    ttl_s = 2.4
+    def _stage(cyc: int) -> "list[int]":
+        # reopen-append: the durable log continues its offsets, so a
+        # mid-soak tranche is indistinguishable from a live producer
+        qq = DurableIngestQueue(broker_dir, 4)
+        for b in batches:
+            tt = b.time + cyc * float(n_pt)
+            for i in range(b.n):
+                qq.append({"uuid": str(b.uuid[i]),
+                           "lat": float(b.lat[i]),
+                           "lon": float(b.lon[i]),
+                           "time": float(tt[i])})
+        ends = [qq.end_offset(p) for p in range(4)]
+        qq.close()
+        return ends
+
+    per_cycle = sum(b.n for b in batches)
+    produced = cycles * per_cycle
+    end_offsets = _stage(0)
+    table = LeaseTable(lease_dir, num_partitions=4, ttl_s=ttl_s)
+    # dispatch hangs at calls 1-2 are the RECOVERABLE chaos: the site
+    # fires at every flush wave (the first lands ~1 s in — reports and
+    # therefore the publish site only materialize near drain, far too
+    # late for a worker that dies mid-run), a hang is a plain sleep
+    # with the watchdog off, and the fired count spools well before
+    # the crash; the first checkpoint call at index >= 4 then raises
+    # InjectedCrash → the CLI dies via os._exit(17), no exit report —
+    # the snapshot spool is the surviving record. The window is
+    # open-ended on purpose: checkpoint calls are wall-clock gated, so
+    # one-core flush stalls consolidate gate openings and a fixed high
+    # index is intermittently never reached before drain; call 3 lands
+    # inside the first hang iteration, so >= 4 guarantees one full
+    # snapshot-spooling iteration after the first dispatch fire.
+    fault_spec = "dispatch:hang(0.6)@1-3;checkpoint:crash@4-"
+
+    def _member(name: str, env: "dict | None" = None):
+        return worker_member(name, tiles_path, broker_dir, arm_dir,
+                             config=cfg_path, lease_dir=lease_dir,
+                             lease_ttl_s=ttl_s, env=env)
+
+    members = [
+        # in-worker chaos rides MemberSpec.env: recoverable publisher
+        # faults + a mid-checkpoint InjectedCrash → os._exit(17)
+        _member("lease-a", env={"RTPU_FAULTS": fault_spec,
+                                "RTPU_FAULT_SEED": "11"}),
+        _member("lease-b"),
+    ]
+    # restart=False: an elastically-leased topology survives by
+    # REBALANCING onto the survivors, not restart-in-place — dead
+    # members' leases expire and their partitions move
+    sup = Supervisor(members, arm_dir, restart=False, max_restarts=0,
+                     poll_s=0.05, lease_dir=lease_dir,
+                     base_env={"JAX_PLATFORMS": "cpu",
+                               "RTPU_TOPO_SNAPSHOT_INTERVAL_S": "0.3"})
+    note = None
+    join_s = reacquire_s = None
+    fenced = None
+    try:
+        sup.start()
+
+        def _wait(pred, lim) -> bool:
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < lim:
+                if pred():
+                    return True
+                time.sleep(0.03)
+            return False
+
+        # workers are up once the table shows lease activity — the
+        # startup acquire storm is the soak's first act (waiting for
+        # sink rows instead would idle through both workers' first-
+        # flush compile stalls)
+        if not _wait(lambda: any(e["event"] == "acquire"
+                                 for e in table.events()), 20.0):
+            note = "no lease activity"
+
+        # ---- mid-soak JOIN of a cold worker -------------------------
+        t_join = time.time()
+        sup.add_member(_member("lease-c"))
+
+        def _acquires_c():
+            return [e for e in table.events()
+                    if e["event"] == "acquire"
+                    and e.get("member") == "lease-c"]
+
+        # ---- second tranche lands while the join is still cold ------
+        # the SIGKILL below must never race a completed drain: fresh
+        # backlog guarantees the orphaned partition still has records
+        # for its next owner to serve, and keeps every survivor's loop
+        # alive through the whole choreography
+        for c2 in range(1, cycles):
+            end_offsets = _stage(c2)
+
+        # ---- SIGKILL of a leased worker + zombie fencing probe ------
+        def _owned_by_b():
+            return sorted(
+                (int(p), int(ent["epoch"]))
+                for p, ent in table.state()["partitions"].items()
+                if ent["owner"] == "lease-b")
+
+        _wait(lambda: bool(_owned_by_b()), 10.0)
+        owned_b = _owned_by_b()
+        if owned_b:
+            p_vic, epoch_vic = owned_b[0]
+            t_kill = time.time()
+            sup.kill_member("lease-b")
+
+            def _reacquired():
+                ent = table.state()["partitions"][str(p_vic)]
+                return (ent["owner"] not in (None, "lease-b")
+                        and int(ent["epoch"]) > epoch_vic)
+
+            if _wait(_reacquired, 20.0):
+                acq = [e for e in table.events()
+                       if e["event"] == "acquire"
+                       and e.get("partition") == p_vic
+                       and e["t"] >= t_kill]
+                reacquire_s = round(max(
+                    0.0, (acq[0]["t"] if acq else time.time()) - t_kill),
+                    2)
+                # the zombie's stale-epoch commit MUST be fenced out —
+                # the lease arm's whole point
+                try:
+                    table.commit("lease-b", p_vic, epoch_vic,
+                                 table.committed(p_vic) + 1)
+                    fenced = False
+                except StaleLeaseError:
+                    fenced = True
+            else:
+                note = (note or "") + " victim never reacquired"
+        else:
+            note = (note or "") + " lease-b never owned a partition"
+
+        # ---- join latency: cold spawn → first acquire ---------------
+        # measured LAST so the wait overlaps the kill/fence work above
+        # (the joiner's first acquire usually lands during it)
+        if _wait(lambda: bool(_acquires_c()), 25.0):
+            join_s = round(max(0.0, _acquires_c()[0]["t"] - t_join), 2)
+        else:
+            note = (note or "") + " join never acquired"
+
+        # ---- drain + offset-granularity conservation ----------------
+        def _drained():
+            floors = table.floors()
+            return (sup.drained()
+                    and sum(max(0, end_offsets[p] - floors[p])
+                            for p in range(4)) == 0)
+
+        if not _wait(_drained, timeout):
+            note = (note or "") + " drain timed out"
+        sup.poll_once()
+        floors = table.floors()
+        lost = sum(max(0, end_offsets[p] - floors[p]) for p in range(4))
+        levents = table.events()
+        dup = commits = 0
+        last_to = [0] * 4
+        for e in levents:
+            if e["event"] != "commit":
+                continue
+            commits += 1
+            p = int(e["partition"])
+            dup += max(0, last_to[p] - int(e["floor_from"]))
+            last_to[p] = max(last_to[p], int(e["floor_to"]))
+        stale_evts = sum(1 for e in levents
+                         if e["event"] == "commit_rejected")
+        lev_counts: dict = {}
+        for e in levents:
+            lev_counts[e["event"]] = lev_counts.get(e["event"], 0) + 1
+        snaps = sup.snapshots()
+        a_gauges = (((snaps.get("lease-a") or {}).get("metrics")
+                     or {}).get("gauges") or {})
+        fault_fired = a_gauges.get("fault_fired")
+        health = sup.health()
+        rebalances = sum(1 for e in sup.events()
+                         if e["event"] == "rebalance")
+        out = {
+            "config": (f"2+1 lease-mode CPU workers over 4 leased "
+                       f"partitions ({produced} probes, ttl {ttl_s}s): "
+                       f"mid-soak join, SIGKILL lease-b, in-worker "
+                       f"chaos in lease-a"),
+            "ttl_s": ttl_s,
+            "workers_start": 2,
+            "workers_joined": 1,
+            "broker_probes": int(produced),
+            "deaths": int(health.get("deaths_total", 0)),
+            "join_to_first_acquire_seconds": join_s,
+            "kill_to_reacquire_seconds": reacquire_s,
+            "stale_commit_rejected": fenced,
+            "commit_rejected_events": int(stale_evts),
+            "lost_records": int(lost),
+            "zero_lost_ok": bool(lost == 0),
+            "duplicate_commits": int(dup),
+            "zero_dup_ok": bool(dup == 0),
+            "commits": int(commits),
+            "rebalances": int(rebalances),
+            "fault_spec": fault_spec,
+            "fault_fired": (None if fault_fired is None
+                            else int(fault_fired)),
+            "fault_stats_surfaced": bool(fault_fired),
+            "lease_event_counts": lev_counts,
+        }
+        if note:
+            out["note"] = note.strip()
+        return out
+    finally:
+        sup.stop()
+
+
 def _topology_bench(tpu_ok: bool, timeout: float = 420.0) -> dict:
     """detail.topology (round 19) — ROADMAP item 4 as a measured,
     journaled artifact: a REAL supervised topology (1 supervisor × 2
@@ -3181,7 +3420,12 @@ def _topology_bench(tpu_ok: bool, timeout: float = 420.0) -> dict:
     composite — the leg measures the topology plane, not the device, so
     a chip composite must not donate its chip to two subprocesses'
     startup compiles; ``aggregate.probes_per_sec_wall`` is one-core CPU
-    throughput by construction and the config says so."""
+    throughput by construction and the config says so. Round 23 adds
+    the LEASE arm (``_topology_lease_arm`` — detail.topology.lease):
+    elastic membership over the epoch-fenced lease table with a
+    mid-soak join, a leased-worker SIGKILL, and an in-worker
+    RTPU_FAULTS plan, asserting rebalance latency, fencing, and
+    offset-granularity conservation on every composite."""
     import shutil
     import tempfile
 
@@ -3446,6 +3690,12 @@ def _topology_bench(tpu_ok: bool, timeout: float = 420.0) -> dict:
                 for rep in reports_by_member.values()),
             "stitch": {**st, "ok": stitch_ok},
         }
+        # ---- round 23: the elastic-leasing + in-worker chaos arm ----
+        # (after the main arm's stop(): one CPU core — two live
+        # topologies would time-share it and blur both measurements)
+        out["lease"] = _topology_lease_arm(
+            workdir, tiles_path, cfg_path, batches, n_pt,
+            timeout=min(timeout, 60.0))
         if note:
             out["note"] = note.strip()
         return out
@@ -4954,24 +5204,42 @@ def _qual_token(_g) -> list:
 
 
 def _topo_token(_g) -> list:
-    """topo = [workers, aggregate probes/s over the soak wall (int —
-    CPU-pinned workers by construction, see _topology_bench), deaths,
-    restarts, recovery seconds (SIGKILL → the restarted worker spooling
-    snapshots again, 1 decimal), lost records across the replay (must
-    be 0), aggregation-fidelity bit (merged exposition == per-leaf sums
-    on every counter + histogram bucket), stitched-cross-pid bit]."""
+    """topo = [workers (main arm), aggregate probes/s over the soak
+    wall (int — CPU-pinned workers by construction, see
+    _topology_bench), deaths (main + lease arms summed), restarts,
+    recovery seconds (SIGKILL → the restarted worker spooling snapshots
+    again, 1 decimal), lost records across BOTH arms' replays (must be
+    0), lease-arm kill→reacquire seconds (1 decimal, the r23 rebalance
+    latency; None when the arm didn't run), folded identity bit]. The
+    fold (mxu-token style) covers every bit the leg recorded:
+    aggregation fidelity, cross-pid stitch, and the lease arm's
+    zero-lost + zero-dup + stale-commit-fenced + fault-stats-surfaced —
+    any recorded False reads 0; an unexercised bit is absent from the
+    fold, never vacuous green."""
     pps = _g("topology", "soak", "probes_per_sec_wall")
     rec_s = _g("topology", "recovery_seconds")
-    fid = _g("topology", "aggregation", "fidelity_ok")
-    stv = _g("topology", "stitch", "ok")
+    reb_s = _g("topology", "lease", "kill_to_reacquire_seconds")
+    deaths = [d for d in (_g("topology", "deaths"),
+                          _g("topology", "lease", "deaths"))
+              if d is not None]
+    lost = [v for v in (_g("topology", "lost_records"),
+                        _g("topology", "lease", "lost_records"))
+            if v is not None]
+    bits = [b for b in (_g("topology", "aggregation", "fidelity_ok"),
+                        _g("topology", "stitch", "ok"),
+                        _g("topology", "lease", "zero_lost_ok"),
+                        _g("topology", "lease", "zero_dup_ok"),
+                        _g("topology", "lease", "stale_commit_rejected"),
+                        _g("topology", "lease", "fault_stats_surfaced"))
+            if b is not None]
     return [_g("topology", "workers"),
             None if pps is None else int(pps),
-            _g("topology", "deaths"),
+            None if not deaths else int(sum(deaths)),
             _g("topology", "restarts"),
             None if rec_s is None else round(rec_s, 1),
-            _g("topology", "lost_records"),
-            None if fid is None else int(bool(fid)),
-            None if stv is None else int(bool(stv))]
+            None if not lost else int(sum(lost)),
+            None if reb_s is None else round(reb_s, 1),
+            None if not bits else int(all(bits))]
 
 
 def _bf_token(_g) -> list:

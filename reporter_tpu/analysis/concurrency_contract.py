@@ -207,6 +207,12 @@ BLOCKING_ALLOW: "dict[tuple[str, str], str]" = {
         "while holding the per-metro build lock — the same round-11 "
         "design as the device_put hold above: only THIS metro's "
         "traffic waits, and the wait is bounded by promote_wait_s",
+    ("lease.table", "os.fsync"): "2026-08-07 the lease state file is "
+        "the cross-process ownership truth (round 23): a transaction's "
+        "tmp-file fsync MUST complete under the table lock before the "
+        "os.replace, or a torn/reordered write could hand one "
+        "partition to two workers — the write is one small JSON doc "
+        "and the lock is otherwise a leaf (lease.py docstring)",
 }
 
 

@@ -1,12 +1,18 @@
-"""Topology observability plane (round 19): the supervised
-multi-process topology (supervisor.py), cross-worker metrics
+"""Topology observability + elasticity plane: the supervised
+multi-process topology (supervisor.py, round 19), cross-worker metrics
 aggregation over atomically spooled snapshots (aggregate.py +
-utils/metrics.merge_exports), and cross-pid trace stitching
-(stitch.py). See DISTRIBUTED.md "Topology observability" for the
-measured artifact."""
+utils/metrics.merge_exports), cross-pid trace stitching (stitch.py),
+and the epoch-fenced partition lease table that makes membership
+elastic (lease.py, round 23). See DISTRIBUTED.md "Topology
+observability" and "Partition leasing"."""
 
+from reporter_tpu.distributed.lease import (LeaseError, LeaseRunner,
+                                            LeaseTable, StaleLeaseError,
+                                            plan_rebalance)
 from reporter_tpu.distributed.supervisor import (MemberSpec, ReportSink,
                                                  Supervisor,
                                                  worker_member)
 
-__all__ = ["MemberSpec", "ReportSink", "Supervisor", "worker_member"]
+__all__ = ["MemberSpec", "ReportSink", "Supervisor", "worker_member",
+           "LeaseTable", "LeaseRunner", "LeaseError", "StaleLeaseError",
+           "plan_rebalance"]

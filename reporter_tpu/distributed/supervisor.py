@@ -29,6 +29,13 @@ gauges/counters) which merges into the exposition as member
 "supervisor", so the fleet view and the watcher's view arrive in one
 scrape.
 
+Elastic membership (round 23): with a ``lease_dir`` the supervisor
+drives the partition-lease rebalancer (distributed/lease.py) from its
+monitor loop — member join (``add_member``), leave (``remove_member``),
+or lease expiry orphans partitions, and the planner reassigns them to
+the least-loaded live workers. All lease-table I/O runs OUTSIDE the
+supervisor locks (the table has its own leaf lock + flock).
+
 Locking discipline (round 14): the member table rides
 ``supervisor.members``; the event log rides ``supervisor.events``; the
 sink counter rides ``supervisor.sink``. All three are LEAF locks —
@@ -163,11 +170,19 @@ def worker_member(name: str, tiles: str, broker_dir: str, workdir: str,
                   config: "str | None" = None,
                   exit_on_drain: bool = True,
                   extra_args: "list[str] | None" = None,
-                  env: "dict[str, str] | None" = None) -> MemberSpec:
+                  env: "dict[str, str] | None" = None,
+                  lease_dir: "str | None" = None,
+                  lease_ttl_s: "float | None" = None) -> MemberSpec:
     """MemberSpec for one ``streaming.__main__`` matcher worker — the
     standard member of a topology. Each worker gets its own checkpoint
     under the workdir (restarts replay from its committed offsets, the
-    r9 recovery mechanism)."""
+    r9 recovery mechanism). With ``lease_dir`` the worker takes its
+    partitions from the lease table instead of a static ``partitions``
+    list (the two are mutually exclusive)."""
+    if lease_dir and partitions is not None:
+        raise ValueError("lease_dir and a static partitions list are "
+                         "mutually exclusive (the lease table owns "
+                         "assignment)")
     cmd = [sys.executable, "-m", "reporter_tpu.streaming",
            "--tiles", tiles, "--broker-dir", broker_dir,
            "--checkpoint", os.path.join(workdir, f"{name}.ckpt"),
@@ -180,6 +195,10 @@ def worker_member(name: str, tiles: str, broker_dir: str, workdir: str,
         cmd.append("--exit-on-drain")
     if partitions is not None:
         cmd += ["--partitions"] + [str(p) for p in partitions]
+    if lease_dir:
+        cmd += ["--lease-dir", lease_dir, "--member", name]
+        if lease_ttl_s is not None:
+            cmd += ["--lease-ttl", str(lease_ttl_s)]
     cmd += list(extra_args or ())
     return MemberSpec(name=name, cmd=cmd, env=env)
 
@@ -191,7 +210,9 @@ class Supervisor:
                  restart: bool = True, max_restarts: int = 2,
                  poll_s: float = 0.05,
                  start_sink: bool = True,
-                 base_env: "dict[str, str] | None" = None):
+                 base_env: "dict[str, str] | None" = None,
+                 lease_dir: "str | None" = None,
+                 rebalance_interval_s: float = 0.25):
         os.makedirs(workdir, exist_ok=True)
         self.workdir = workdir
         self.snapshot_dir = os.path.join(workdir, "snapshots")
@@ -214,6 +235,16 @@ class Supervisor:
         # the same scrape as the fleet series
         self.metrics = metrics.MetricsRegistry()
         self.started_at: "float | None" = None
+        # Elastic membership (round 23): the lease table must already
+        # exist (its creator fixes num_partitions); opening it here
+        # fails fast on a misconfigured dir. All table I/O runs outside
+        # the supervisor locks.
+        self._lease_table = None
+        self._rebalance_interval = float(rebalance_interval_s)
+        self._last_rebalance = 0.0
+        if lease_dir is not None:
+            from reporter_tpu.distributed.lease import LeaseTable
+            self._lease_table = LeaseTable(lease_dir)
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -364,14 +395,18 @@ class Supervisor:
         for name in respawn:
             self.metrics.count("topo_restarts")
             self._spawn(name, reason="restart")
+        self._maybe_rebalance()
         self._publish_gauges()
 
     def stop(self, timeout: float = 30.0) -> None:
         """Graceful teardown: SIGTERM members (their CLI checkpoints and
         drains on it), join, stop the monitor/sink/HTTP face.
         IDEMPOTENT — error-path finallys may call it after a normal
-        stop."""
+        stop: the repeat is a safe no-op that still leaves an audit
+        event (round 23 satellite — silent no-ops hid double-teardown
+        bugs)."""
         if self._stopped:
+            self._event("stop_noop")
             return
         self._stopped = True
         with self._members_lock:
@@ -405,17 +440,106 @@ class Supervisor:
         if self.sink is not None:
             self.sink.close()
 
+    # ---- elastic membership (round 23) -----------------------------------
+
+    def add_member(self, spec: MemberSpec, reason: str = "join") -> None:
+        """Join a new member to a RUNNING topology. With a lease table
+        the newcomer heartbeats, the next rebalance pass revokes
+        surplus partitions toward it, and it picks them up at their
+        committed floors — scale-out under live load."""
+        if self._stopped:
+            raise RuntimeError("supervisor is stopped")
+        with self._members_lock:
+            if spec.name in self._members:
+                raise ValueError(f"member {spec.name!r} already exists")
+            self._members[spec.name] = _Member(spec)
+        self._event("member_join", member=spec.name)
+        self._spawn(spec.name, reason=reason)
+        self._publish_gauges()
+
+    def remove_member(self, name: str,
+                      timeout: float = 30.0) -> "dict | None":
+        """Graceful leave: SIGTERM the member (its CLI hands off leased
+        partitions and checkpoints on it), wait, and let the normal
+        claim path reap the exit. The member's history stays in the
+        table. No-op (with an event) for an unknown name. Returns the
+        member's exit report, if it printed one."""
+        with self._members_lock:
+            m = self._members.get(name)
+            if m is not None:
+                m.stopping = True
+                proc = m.proc
+        if m is None:
+            self._event("member_remove_noop", member=name)
+            return None
+        self._event("member_leave", member=name)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+        self.wait_member(name, timeout=timeout)
+        self.poll_once()
+        self._publish_gauges()
+        with self._members_lock:
+            return m.exit_report
+
+    def _maybe_rebalance(self) -> None:
+        if self._lease_table is None or self._stopped:
+            return
+        now = time.monotonic()
+        if now - self._last_rebalance < self._rebalance_interval:
+            return
+        self._last_rebalance = now
+        self.rebalance_once()
+
+    def rebalance_once(self) -> dict:
+        """One planner pass over the lease table (public so tests and
+        the bench leg can force one deterministically). The table
+        transaction takes its own leaf lock and the planner is pure;
+        the members lock is held only to snapshot the process table.
+        Members whose heartbeat is older than 2× the lease TTL read as
+        dead — and the supervisor's own process table SHORTENS that:
+        a member it watched die stops receiving assignments
+        immediately, not at heartbeat expiry."""
+        table = self._lease_table
+        if table is None:
+            return {}
+        from reporter_tpu.distributed.lease import plan_rebalance
+        with self._members_lock:
+            running = {name for name, m in self._members.items()
+                       if m.proc is not None and m.proc.poll() is None}
+        st = table.state()
+        now = table.clock()
+        orphans = sum(1 for ent in st["partitions"].values()
+                      if ent["owner"] is None
+                      or now > float(ent["expires"]))
+        self.metrics.gauge("topo_lease_orphans", float(orphans))
+        plan = plan_rebalance(st, now, member_ttl_s=table.ttl_s * 2.0,
+                              running=running)
+        if plan["assign"] or plan["revoke"] or plan["clear"]:
+            table.apply_plan(plan)
+        if plan["assign"] or plan["revoke"]:
+            self.metrics.count("topo_rebalances")
+            self._event(
+                "rebalance",
+                assign={str(p): m
+                        for p, m in sorted(plan["assign"].items())},
+                revoke={str(p): m
+                        for p, m in sorted(plan["revoke"].items())})
+        return plan
+
     # ---- chaos hooks -----------------------------------------------------
 
     def kill_member(self, name: str) -> "int | None":
         """A REAL SIGKILL (no drain, no checkpoint flush) — the bench
         topology leg's mid-soak fault. The monitor sees an unexpected
         death and runs the normal detect→count→post-mortem→restart
-        path; nothing is pre-acknowledged here."""
+        path; nothing is pre-acknowledged here. Killing an unknown or
+        already-exited member is a safe no-op that records an event
+        (round 23 satellite)."""
         with self._members_lock:
             m = self._members.get(name)
             proc = m.proc if m is not None else None
         if proc is None or proc.poll() is not None:
+            self._event("kill_noop", member=name)
             return None
         proc.kill()
         return proc.pid

@@ -58,6 +58,16 @@ even probabilistic plans replay exactly. Spec grammar (';'-separated):
     publish:fail@0-~0.25        every call fails w.p. 0.25 (seeded)
 
 ``hi`` omitted ⇒ ``lo+1``; ``hi`` empty (``@5-``) ⇒ open-ended.
+
+Parsing is STRICT (round 23): a malformed spec raises ``ValueError``
+naming the bad clause at plan construction — ``install()``/parse time,
+never silently at fire time. A typo'd site name, an empty window, a
+zero probability, a duration on a non-hang kind, a hang without one,
+or ``torn`` outside the broker site are all plans that can never fire
+the way their author meant (the r14 env-parse bug class, one layer
+up), so they are rejected where the author can see them. Validation
+lives in ``FaultPlan.__post_init__`` so hand-built plans get the same
+gate as parsed specs.
 """
 
 from __future__ import annotations
@@ -96,6 +106,22 @@ class FaultRule:
     def covers(self, i: int) -> bool:
         return self.lo <= i < self.hi
 
+    def clause(self, site: str) -> str:
+        """Canonical spec-grammar text for this rule (error messages
+        name the bad clause in the author's own notation)."""
+        secs = f"({self.seconds:g})" if self.seconds else ""
+        if self.hi == float("inf"):
+            span = f"{self.lo}-"
+        elif self.hi == self.lo + 1:
+            span = f"{self.lo}"
+        else:
+            span = f"{self.lo}-{int(self.hi)}"
+        # p == 1.0 exactly is the grammar default and elides; an
+        # out-of-range p must still render so validation errors can
+        # name the offending clause verbatim
+        prob = "" if self.p == 1.0 else f"~{self.p:g}"
+        return f"{site}:{self.kind}{secs}@{span}{prob}"
+
 
 _RULE_RE = re.compile(
     r"^(?P<site>\w+):(?P<kind>\w+)"
@@ -112,6 +138,42 @@ class FaultPlan:
     seed: int = 0
 
     def __post_init__(self):
+        # Strict validation (round 23): every rule that can never fire
+        # as written is an error HERE, with the clause spelled out —
+        # not a plan that silently does nothing (satellite of ISSUE 19;
+        # the r14 REPORTER_TPU_NO_NATIVE=0 bug class).
+        for site, site_rules in self.rules.items():
+            if site not in SITES:
+                raise ValueError(f"unknown fault site {site!r}; "
+                                 f"one of {SITES}")
+            for r in site_rules:
+                clause = r.clause(site)
+                if r.kind not in KINDS:
+                    raise ValueError(f"unknown fault kind {r.kind!r} in "
+                                     f"{clause!r}; one of {KINDS}")
+                if r.lo < 0:
+                    raise ValueError(
+                        f"negative call window start in {clause!r}")
+                if not r.hi > r.lo:
+                    raise ValueError(
+                        f"empty call window in {clause!r}: hi ({r.hi:g}) "
+                        f"must exceed lo ({r.lo})")
+                if not 0.0 < r.p <= 1.0:
+                    raise ValueError(
+                        f"fire probability {r.p:g} in {clause!r} outside "
+                        "(0, 1] — the rule would never/over fire")
+                if r.kind == "hang" and r.seconds <= 0:
+                    raise ValueError(
+                        f"hang rule {clause!r} needs a positive duration: "
+                        "write hang(seconds)")
+                if r.kind != "hang" and r.seconds:
+                    raise ValueError(
+                        f"duration only applies to hang rules, got "
+                        f"{clause!r}")
+                if r.kind == "torn" and site != "broker":
+                    raise ValueError(
+                        f"torn is a broker-site kind (the caller must "
+                        f"cooperate to tear a frame), got {clause!r}")
         self._lock = locks.named_lock("faults.plan")
         self.calls = {s: 0 for s in SITES}
         self.fired = {s: 0 for s in SITES}

@@ -13,6 +13,14 @@ out over one broker directory by giving each a disjoint --partitions
 subset and its own checkpoint — the consumer-group model (SURVEY.md
 §3.3, DISTRIBUTED.md "Ingest stays host-local").
 
+--lease-dir (round 23) replaces the static --partitions subset with
+ELASTIC assignment: the worker acquires epoch-fenced, time-bounded
+partition leases from the table (distributed/lease.py), renews them as
+it runs, adopts partitions the rebalancer assigns at their committed
+floors, and hands off gracefully when revoked — membership scales
+under live load with zero lost and zero duplicated records
+(DISTRIBUTED.md "Partition leasing").
+
 --stdin-format additionally accepts raw vendor payloads on stdin (one
 per line), normalized through ProbeFormatter into the broker before
 consuming — handy for piping a vendor feed straight into a worker.
@@ -82,8 +90,30 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="this worker's topology member name (snapshot "
                          "file + trace-dump naming; env twin "
                          "RTPU_TOPO_MEMBER; default worker-<pid>)")
+    # elastic partition leasing (round 23): env twins follow the
+    # RTPU_TOPO_* pattern — the supervisor can steer spawned workers
+    # without rebuilding command lines; explicit flags win
+    ap.add_argument("--lease-dir",
+                    default=os.environ.get("RTPU_LEASE_DIR") or None,
+                    help="partition lease-table directory "
+                         "(distributed/lease.py): take partitions from "
+                         "epoch-fenced leases instead of --partitions "
+                         "(env twin RTPU_LEASE_DIR)")
+    ap.add_argument("--lease-ttl", type=float,
+                    default=float(os.environ.get("RTPU_LEASE_TTL_S")
+                                  or 5.0),
+                    help="lease time-to-live in seconds; renewals run at "
+                         "~ttl/4 (env twin RTPU_LEASE_TTL_S; default 5)")
     args = ap.parse_args(argv)
     member = args.member or f"worker-{os.getpid()}"
+    if args.lease_dir and args.partitions is not None:
+        ap.error("--lease-dir and --partitions are mutually exclusive: "
+                 "the lease table owns partition assignment")
+    if args.lease_dir and args.columnar:
+        ap.error("--lease-dir requires the dict worker for now: the "
+                 "columnar pipeline's in-flight wave holds make "
+                 "mid-wave partition handoff a separate contract "
+                 "(DISTRIBUTED.md 'Partition leasing')")
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
@@ -131,8 +161,11 @@ def main(argv: "list[str] | None" = None) -> int:
         pipe = ColumnarStreamPipeline(ts, config, queue=queue,
                                       partitions=args.partitions)
     else:
+        # lease mode starts owning NOTHING: the first sync() below
+        # adopts whatever the table assigns, at its committed floors
         pipe = StreamPipeline(ts, config, queue=queue,
-                              partitions=args.partitions)
+                              partitions=([] if args.lease_dir
+                                          else args.partitions))
     if args.checkpoint and os.path.exists(
             args.checkpoint if args.checkpoint.endswith(".npz")
             else args.checkpoint + ".npz"):
@@ -154,6 +187,20 @@ def main(argv: "list[str] | None" = None) -> int:
         log.info("stdin feed: %d records normalized, %d dropped",
                  n, fmt.stats()["dropped"])
 
+    from reporter_tpu import faults
+
+    runner = None
+    if args.lease_dir:
+        from reporter_tpu.distributed.lease import LeaseRunner, LeaseTable
+
+        table = LeaseTable(args.lease_dir,
+                           num_partitions=config.streaming.num_partitions,
+                           ttl_s=args.lease_ttl)
+        runner = LeaseRunner(table, member, pipe)
+        runner.sync(force=True)
+        log.info("lease member %s: partitions %s", member,
+                 sorted(runner.epochs))
+
     stop = {"now": False}
 
     def _handle(signum, frame):
@@ -170,6 +217,17 @@ def main(argv: "list[str] | None" = None) -> int:
     def _spool_snapshot(seq: int, st: dict) -> None:
         from reporter_tpu.distributed import aggregate
 
+        # per-worker chaos accounting (round 23): an in-worker fault
+        # plan's stats ride the snapshot as gauges, so the supervisor's
+        # merged registry surfaces them per member even when the
+        # incarnation dies before printing an exit report
+        plan = faults.active()
+        if plan is not None:
+            fs = plan.stats()
+            matcher.metrics.gauge("fault_calls",
+                                  float(sum(fs["calls"].values())))
+            matcher.metrics.gauge("fault_fired",
+                                  float(sum(fs["fired"].values())))
         try:
             aggregate.write_snapshot(
                 aggregate.snapshot_path(args.snapshot_dir, member),
@@ -187,7 +245,11 @@ def main(argv: "list[str] | None" = None) -> int:
     stall, prev_lag = 0, None
     try:
         while not stop["now"]:
+            if runner is not None:
+                runner.sync()
             reports += pipe.step()
+            if runner is not None:
+                runner.push_commits()
             steps += 1
             if args.checkpoint and (time.monotonic() - last_ckpt
                                     >= args.checkpoint_interval):
@@ -202,6 +264,34 @@ def main(argv: "list[str] | None" = None) -> int:
                 _spool_snapshot(snap_seq, st)
                 last_snap = time.monotonic()
             if args.exit_on_drain:
+                if runner is not None:
+                    # lease mode drains GLOBALLY: end offsets vs TABLE
+                    # floors over every partition. A worker owning
+                    # nothing must idle, not stall-exit — partitions
+                    # can still rebalance onto it (a dead peer's lease
+                    # has to expire first); only the table saying all
+                    # floors have caught up ends the run. A lag pinned
+                    # by a sub-threshold buffered tail gets the
+                    # finally-drain's IN-LOOP analog: force-flush so
+                    # the floor can reach the end offsets, then keep
+                    # serving.
+                    if st["lag"] == 0:
+                        stall = 0
+                        if runner.lag() == 0:
+                            break
+                        time.sleep(args.poll_interval)
+                    elif (st["lag"] == prev_lag
+                            and st.get("inflight_waves", 0) == 0
+                            and st.get("publish_pending", 0) == 0):
+                        stall += 1
+                        if stall >= 3:
+                            reports += pipe.step(force_flush=True)
+                            runner.push_commits()
+                            stall = 0
+                    else:
+                        stall = 0
+                    prev_lag = st["lag"]
+                    continue
                 # drained = lag 0, OR lag pinned by a sub-threshold
                 # buffered tail with nothing in flight (the commit floor
                 # sits below buffered rows by design; the finally-drain
@@ -220,9 +310,21 @@ def main(argv: "list[str] | None" = None) -> int:
                 prev_lag = st["lag"]
             elif st["lag"] == 0:
                 time.sleep(args.poll_interval)
+    except faults.InjectedCrash:
+        # A chaos plan simulating process death must behave like one:
+        # no drain, no final checkpoint, no exit report — the next
+        # owner replays this worker's unflushed tail from the table
+        # floor. os._exit skips the finally below on purpose.
+        log.error("injected crash: dying hard")
+        os._exit(17)
     finally:
         reports += pipe.drain()
         pipe.flush_histograms()
+        if runner is not None:
+            # graceful exit: fenced final floors + release, so the
+            # partitions are instantly adoptable (no TTL wait)
+            runner.push_commits()
+            runner.shutdown()
         if getattr(pipe.publisher, "dead_letter_pending", 0):
             # an outage that covered the LAST wave leaves batches spooled
             # with no later success to auto-replay them — try once at
@@ -271,9 +373,20 @@ def main(argv: "list[str] | None" = None) -> int:
                 "empty_match_rate", "breakage_rate",
                 "discontinuity_rate", "violation_rate",
                 "rejection_rate", "unmatched_point_rate")}
+    # per-worker chaos accounting in the exit report (round 23): which
+    # sites an in-worker RTPU_FAULTS plan actually fired
+    plan = faults.active()
+    fault_stats = None
+    if plan is not None:
+        fs = plan.stats()
+        fault_stats = {"calls": int(sum(fs["calls"].values())),
+                       "fired": {s: int(n) for s, n in fs["fired"].items()
+                                 if n}}
+    lease_stats = None if runner is None else dict(runner.stats)
     print(json.dumps({"steps": steps, "reports": reports,
                       "committed": list(pipe.committed),
                       "member": member,
+                      "faults": fault_stats, "lease": lease_stats,
                       "link": link, "quality": quality,
                       **{k: v for k, v in st.items()
                          if k in ("lag", "published", "malformed",

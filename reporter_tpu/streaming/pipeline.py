@@ -259,6 +259,50 @@ class StreamPipeline:
             (b.first_offset for b in self._buffers.values()
              if b.first_offset is not None))
 
+    # ---- elastic membership (round 23: distributed/lease.py) -------------
+
+    def adopt_partition(self, partition: int, offset: int) -> None:
+        """Start consuming ``partition`` at ``offset`` — the lease
+        table's committed floor, so a rebalanced partition replays
+        exactly the previous owner's unflushed tail (at-least-once,
+        zero loss). Adopting an already-owned partition is a caller
+        bug: the lease protocol guarantees single ownership."""
+        sc = self.config.streaming
+        p = int(partition)
+        if p < 0 or p >= sc.num_partitions:
+            raise ValueError(f"partition {p} out of range "
+                             f"0..{sc.num_partitions - 1}")
+        if p in self.partitions:
+            raise ValueError(f"partition {p} already owned")
+        self.partitions = sorted(self.partitions + [p])
+        self.committed[p] = int(offset)
+        self._consumed[p] = int(offset)
+
+    def release_partition(self, partition: int, flush: bool = True) -> int:
+        """Stop consuming ``partition``. ``flush=True`` is the graceful
+        handoff: its buffered rows go through the matcher first so the
+        final committed floor covers them. ``flush=False`` is the
+        lost-lease path: buffered rows are DISCARDED (the new owner
+        replays them from the table's floor; publishing here would
+        duplicate reports). Returns the number of points discarded
+        (always 0 on the flush path). uuid-hash routing pins a
+        vehicle's records to one partition, so ``first_offset[0]``
+        identifies every affected buffer."""
+        p = int(partition)
+        if p not in self.partitions:
+            return 0
+        mine = [u for u, b in self._buffers.items()
+                if b.first_offset is not None and b.first_offset[0] == p]
+        dropped = 0
+        if flush and mine:
+            self._flush(mine)
+        else:
+            for u in mine:
+                dropped += len(self._buffers.pop(u).points)
+        self.partitions = [q for q in self.partitions if q != p]
+        self._commit()
+        return dropped
+
     def flush_histograms(self) -> int:
         """Publish the per-segment speed-histogram DELTA since the last
         flush (SURVEY.md §7.7 / BASELINE config 5: "online per-segment speed

@@ -296,8 +296,11 @@ def test_bench_legs_topology_cli(tmp_path):
     real supervised 2-worker topology with its mid-soak SIGKILL on the
     no-chip path — supervisor-observed death + restart + recovery,
     zero-lost accounting, aggregation fidelity, and a stitched
-    cross-pid trace — journals the leg, records the topo summary
-    token, and writes the PARTIAL detail file only (no-clobber)."""
+    cross-pid trace — PLUS the round-23 lease arm (elastic membership:
+    mid-soak join, leased-worker SIGKILL, in-worker injected crash,
+    epoch fencing, conservation) — journals the leg, records the topo
+    summary token, and writes the PARTIAL detail file only
+    (no-clobber)."""
     env = dict(os.environ)
     env["REPORTER_BENCH_FORCE_CPU"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
@@ -311,15 +314,17 @@ def test_bench_legs_topology_cli(tmp_path):
         timeout=420, env=env, cwd=str(tmp_path))
     assert out.returncode == 0, out.stdout[-2000:]
     summary = json.loads(out.stdout.decode().strip().splitlines()[-1])
-    workers, pps, deaths, restarts, rec_s, lost, fid, stitched = \
+    workers, pps, deaths, restarts, rec_s, lost, reacq_s, bits = \
         summary["topo"]
     assert workers == 2
-    assert deaths == 1 and restarts == 1      # the injected SIGKILL,
-    #                                           detected + restarted
+    # main arm's SIGKILL (detected + restarted) plus the lease arm's
+    # two deaths (leased-worker SIGKILL + in-worker injected crash)
+    assert deaths == 3 and restarts == 1
     assert rec_s is not None and rec_s > 0
-    assert lost == 0                          # zero-lost accounting
-    assert fid == 1                           # merged == union sums
-    assert stitched == 1                      # cross-pid causal track
+    assert lost == 0                          # zero-lost, BOTH arms
+    assert reacq_s is not None and reacq_s > 0  # rebalance latency
+    assert bits == 1       # fidelity + stitch + lease zero-lost/
+    #                        zero-dup/fenced/fault-surfaced, folded
     assert pps and pps > 0
     if committed is not None:                 # no-clobber (r15 rule)
         assert open(cpu_capture).read() == committed
@@ -334,6 +339,13 @@ def test_bench_legs_topology_cli(tmp_path):
     assert res["aggregation"]["fidelity_ok"] is True
     assert res["stitch"]["processes"] >= 2
     assert res["worker_exit_reports_ok"] is True
+    lease = res["lease"]
+    assert lease["zero_lost_ok"] is True and lease["zero_dup_ok"] is True
+    assert lease["stale_commit_rejected"] is True    # the zombie probe
+    assert lease["fault_stats_surfaced"] is True     # in-worker chaos
+    assert lease["deaths"] == 2
+    assert lease["kill_to_reacquire_seconds"] > 0
+    assert lease["join_to_first_acquire_seconds"] > 0
 
 
 def test_bench_legs_backfill_cli(tmp_path):

@@ -541,9 +541,11 @@ def test_topology_leg_schema_keys():
 
 
 def test_summary_line_carries_topo_token():
-    """topo = [workers, aggregate probes/s (int), deaths, restarts,
-    recovery seconds (1 decimal), lost records, aggregation-fidelity
-    bit, stitched-cross-pid bit]."""
+    """topo = [workers, aggregate probes/s (int), deaths (main + lease
+    arms summed), restarts, recovery seconds (1 decimal), lost records
+    (both arms), lease kill→reacquire seconds (None when the arm didn't
+    run), folded identity bit (fidelity/stitch + the lease arm's
+    zero-lost/zero-dup/fenced/fault-surfaced when recorded)]."""
     bench = _load_bench()
     doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
            "unit": "probes/s", "vs_baseline": 1.0,
@@ -559,7 +561,17 @@ def test_summary_line_carries_topo_token():
                },
            }}
     line = bench._summary_line(doc)
-    assert line["topo"] == [2, 163, 1, 1, 2.4, 0, 1, 1]
+    # no lease arm recorded: its timing slot is None and the fold
+    # covers only the two main-arm bits — never vacuous green
+    assert line["topo"] == [2, 163, 1, 1, 2.4, 0, None, 1]
+    doc["detail"]["topology"]["lease"] = {
+        "deaths": 2, "lost_records": 0,
+        "kill_to_reacquire_seconds": 2.38,
+        "zero_lost_ok": True, "zero_dup_ok": True,
+        "stale_commit_rejected": True, "fault_stats_surfaced": False,
+    }
+    line = bench._summary_line(doc)
+    assert line["topo"] == [2, 163, 3, 1, 2.4, 0, 2.4, 0]
     empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
                                  "vs_baseline": 1.0, "detail": {}})
     assert empty["topo"] == [None] * 8

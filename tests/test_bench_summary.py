@@ -166,6 +166,17 @@ def _synthetic_doc():
             "lost_records": 1234,
             "aggregation": {"fidelity_ok": True},
             "stitch": {"ok": True},
+            # r23 lease arm: deaths/lost fold into the main slots;
+            # kill→reacquire rides its own slot (3-digit worst width)
+            "lease": {
+                "deaths": 12,
+                "lost_records": 1234,
+                "kill_to_reacquire_seconds": 123.45,
+                "zero_lost_ok": True,
+                "zero_dup_ok": True,
+                "stale_commit_rejected": True,
+                "fault_stats_surfaced": True,
+            },
         },
         # widths honest-worst for the leg's FIXED tiny scale (see
         # _backfill_bench): 5-digit krows/s, 2-digit ratio, 4-digit

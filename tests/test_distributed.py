@@ -266,6 +266,52 @@ def test_supervisor_clean_exit_is_not_a_death(tmp_path):
         sup.stop()
 
 
+def test_supervisor_stop_is_idempotent(tmp_path):
+    """Round-23 satellite: error-path finallys may stop() after a normal
+    stop — the repeat is a safe no-op that still leaves an audit event
+    (silent no-ops hid double-teardown bugs)."""
+    sup = Supervisor(_specs(tmp_path)[:1], str(tmp_path), poll_s=0.02)
+    sup.start()
+    sup.stop()
+    sup.stop()
+    kinds = [e["event"] for e in sup.events()]
+    assert kinds.count("stop_noop") == 1
+    with pytest.raises(RuntimeError):
+        sup.add_member(MemberSpec("late", ["true"]))
+
+
+def test_supervisor_kill_and_remove_unknown_or_exited_are_noops(tmp_path):
+    sup = Supervisor(_specs(tmp_path)[:1], str(tmp_path), poll_s=0.02)
+    try:
+        sup.start()
+        assert sup.kill_member("ghost") is None          # unknown member
+        assert _wait(sup.drained)
+        sup.poll_once()
+        assert sup.kill_member("ok") is None             # already exited
+        assert sup.remove_member("ghost") is None
+        kinds = [e["event"] for e in sup.events()]
+        assert kinds.count("kill_noop") == 2
+        assert "member_remove_noop" in kinds
+    finally:
+        sup.stop()
+
+
+def test_supervisor_join_and_leave_record_events(tmp_path):
+    sup = Supervisor([], str(tmp_path), poll_s=0.02)
+    try:
+        sup.start()
+        sup.add_member(_specs(tmp_path)[0])
+        with pytest.raises(ValueError):
+            sup.add_member(_specs(tmp_path)[0])          # duplicate name
+        assert _wait(sup.drained)
+        report = sup.remove_member("ok")
+        kinds = [e["event"] for e in sup.events()]
+        assert "member_join" in kinds and "member_leave" in kinds
+        assert report is not None and report.get("steps") == 1
+    finally:
+        sup.stop()
+
+
 def test_supervisor_one_death_one_postmortem(tmp_path):
     """The r15 one-event-one-dump rule at the topology layer: a death
     TRANSITION dumps exactly one flight-recorder post-mortem (bounded

@@ -76,6 +76,45 @@ def test_fault_plan_bad_specs_rejected():
             faults.FaultPlan.parse(bad)
 
 
+def test_fault_plan_strict_validation_names_the_clause():
+    """Round-23 satellite: a rule that can never fire as written is an
+    error AT PARSE, with the clause spelled back in the author's own
+    grammar — not a plan that silently does nothing (the r14
+    REPORTER_TPU_NO_NATIVE=0 bug class)."""
+    cases = {
+        "publish:fail@5-5": "empty call window",
+        "publish:fail@0~0": "fire probability",
+        "publish:fail@0~1.5": "fire probability",
+        "dispatch:hang@0": "positive duration",
+        "publish:fail(2)@0": "duration only applies to hang",
+        "publish:torn@0": "broker-site kind",
+    }
+    for spec, needle in cases.items():
+        with pytest.raises(ValueError) as ei:
+            faults.FaultPlan.parse(spec)
+        msg = str(ei.value)
+        assert needle in msg, (spec, msg)
+        assert spec in msg, (spec, msg)      # the clause, verbatim
+
+
+def test_fault_plan_hand_built_rules_validate_like_parsed():
+    """parse() is just a front end: FaultPlan construction itself
+    rejects impossible rules, so programmatic plans get the same
+    strictness as spec strings."""
+    with pytest.raises(ValueError):
+        faults.FaultPlan(rules={"nosite": []})
+    with pytest.raises(ValueError) as ei:
+        faults.FaultPlan(rules={"publish": [faults.FaultRule("explode")]})
+    assert "explode" in str(ei.value)
+    with pytest.raises(ValueError) as ei:
+        faults.FaultPlan(
+            rules={"publish": [faults.FaultRule("fail", lo=-1)]})
+    assert "negative call window" in str(ei.value)
+    # a well-formed hand-built plan still constructs
+    faults.FaultPlan(
+        rules={"publish": [faults.FaultRule("hang", seconds=1.0)]})
+
+
 def test_env_plan_reaches_publish_site(monkeypatch):
     """RTPU_FAULTS is the subprocess channel: a publisher in a worker the
     bench spawned must consult the env plan with no code wiring."""
