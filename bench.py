@@ -3248,7 +3248,14 @@ def _topology_lease_arm(workdir: str, tiles_path: str, cfg_path: str,
     sup = Supervisor(members, arm_dir, restart=False, max_restarts=0,
                      poll_s=0.05, lease_dir=lease_dir,
                      base_env={"JAX_PLATFORMS": "cpu",
-                               "RTPU_TOPO_SNAPSHOT_INTERVAL_S": "0.3"})
+                               "RTPU_TOPO_SNAPSHOT_INTERVAL_S": "0.3",
+                               # r24: the crash window (checkpoint:
+                               # crash@4-) is wall-clock tuned against
+                               # the r23 loop cost — an in-worker SLO
+                               # tick would shift which call lands in
+                               # the first hang iteration; SLO chaos
+                               # claims live in detail.slo
+                               "RTPU_SLO": "0"})
     note = None
     join_s = reacquire_s = None
     fenced = None
@@ -3508,6 +3515,11 @@ def _topology_bench(tpu_ok: bool, timeout: float = 420.0) -> dict:
                 "JAX_PLATFORMS": "cpu",
                 "RTPU_TRACE": "1", "RTPU_TRACE_DIR": traces_dir,
                 "RTPU_TOPO_SNAPSHOT_INTERVAL_S": "0.3",
+                # r24: keep this leg's timing/dump budget exactly r19 —
+                # a worker SLO alert would share the bounded post-mortem
+                # budget the death/stitch assertions draw on; SLO chaos
+                # claims live in detail.slo
+                "RTPU_SLO": "0",
             })
         t_soak0 = time.perf_counter()
         sup.start()
@@ -3969,6 +3981,151 @@ def _link_rtt() -> float:
 
 
 # ---------------------------------------------------------------------------
+# Round 24: the SLO burn-rate plane (ISSUE 20) — chaos-proven alerting
+# over the metrics registry. Fully synthetic (no tiles, no chip, no
+# link): an injected-clock serving driver feeds the REAL metric names
+# the committed specs read, two fault classes each fire their MATCHING
+# alert with exactly one post-mortem per transition, the clean arm
+# fires none, and the merge-commute property (topology burn over
+# merge_exports == per-worker sum) is re-proven on the driver's own
+# exports every composite.
+
+
+def _slo_bench() -> dict:
+    """Self-contained ``detail.slo`` leg (~seconds; `--legs slo` fits
+    any window). Mechanism validation, never a throughput claim."""
+    import shutil
+    import tempfile
+
+    from reporter_tpu import faults
+    from reporter_tpu.obs import slo as obs_slo
+    from reporter_tpu.utils import tracing
+    from reporter_tpu.utils.eventlog import EventLog
+    from reporter_tpu.utils.metrics import (MetricsRegistry, delta_exports,
+                                            merge_exports)
+
+    t0 = time.perf_counter()
+    reg = MetricsRegistry()
+    clock = {"now": 0.0}
+    workdir = tempfile.mkdtemp(prefix="rtpu_slo_bench_")
+    ledger = EventLog(os.path.join(workdir, "alerts.jsonl"))
+    # scale 0.1 ⇒ fast windows 6 s / 30 s of VIRTUAL time (the injected
+    # clock steps 1 s per iteration — transitions are deterministic, so
+    # this leg's pass/fail can never ride link mood)
+    ev = obs_slo.SloEvaluator(reg, ledger=ledger, clock=lambda: clock["now"],
+                              scale=0.1, min_tick_s=0.0,
+                              enabled_override=True)
+
+    def drive(n: int) -> None:
+        """n virtual seconds of serving traffic against the REAL metric
+        names the committed specs read. The publish and dispatch fault
+        sites are consulted per event, so an installed FaultPlan turns
+        this into the matching outage."""
+        for _ in range(n):
+            clock["now"] += 1.0
+            for _ in range(10):
+                reg.count("http_requests")
+                reg.count("publish_attempts")
+                if faults.check("publish") is not None:
+                    reg.count("publish_failures")
+                slow = faults.check("dispatch") is not None
+                reg.observe("request_seconds", 1.0 if slow else 0.01)
+            ev.tick()
+
+    tr = tracing.tracer()
+    prev_tr = (tr.enabled, tr.dump_dir, tr.capacity, tr.max_dumps)
+    prev_written = tr.dumps_written
+    try:
+        tr.configure(enabled=True, dump_dir=workdir, max_dumps=8)
+        # clean arm: healthy traffic through every window — zero alerts
+        drive(60)
+        clean_alerts = ev.alerts_total
+        clean_active = list(ev.status()["active"])
+        # chaos arm A: publish outage (open-ended fail) ⇒ the publish
+        # ratio SLO must fire; arm B after recovery: dispatch slowness ⇒
+        # the latency SLO must fire. Distinct fault classes, distinct
+        # matching specs.
+        with faults.use(faults.FaultPlan.parse("publish:fail@0-")):
+            drive(40)
+        publish_fired = "publish" in ev.status()["active"]
+        drive(80)                                    # recovery: resolves
+        publish_resolved = "publish" not in ev.status()["active"]
+        # check(), not fire(): the driver maps the rule to a slow
+        # observation itself, so the nominal hang duration never sleeps
+        with faults.use(faults.FaultPlan.parse("dispatch:hang(0.5)@0-")):
+            drive(40)
+        latency_fired = "latency" in ev.status()["active"]
+        drive(80)
+        latency_resolved = "latency" not in ev.status()["active"]
+        chaos_alerts = ev.alerts_total - clean_alerts
+        dumps = [f for f in sorted(os.listdir(workdir))
+                 if "slo_alert" in f]
+        entries = ledger.read()
+    finally:
+        tr.configure(enabled=prev_tr[0], dump_dir=prev_tr[1],
+                     capacity=prev_tr[2], max_dumps=prev_tr[3])
+        tr.dumps_written = prev_written
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    # one bounded post-mortem per FIRE transition (r18 discipline: a
+    # budget that stays blown dumps once, not once per tick)
+    fires = [e for e in entries if e["event"] == "fire"]
+    resolves = [e for e in entries if e["event"] == "resolve"]
+    one_pm_per_fire = len(dumps) == len(fires) == 2
+    # zero lost ledger entries: every transition the evaluator counted
+    # is durably on disk (fire+resolve per chaos class)
+    ledger_ok = (len(fires) == chaos_alerts
+                 and len(resolves) == chaos_alerts
+                 and sorted(e["slo"] for e in fires)
+                 == ["latency", "publish"])
+
+    # topology-wide burn = per-worker sum BY CONSTRUCTION: delta of the
+    # merged exports equals the merge of per-worker deltas, counters and
+    # buckets both (the r19 merge grid is what makes burn linear)
+    w1, w2 = MetricsRegistry(), MetricsRegistry()
+    for i in range(50):
+        w1.count("http_requests"), w2.count("http_requests", 2)
+        if i % 9 == 0:
+            w1.count("http_errors")
+        w2.observe("request_seconds", 0.02 * (i % 7 + 1))
+    b1, b2 = w1.export(), w2.export()
+    for i in range(30):
+        w1.observe("request_seconds", 0.3)
+        w2.count("http_errors", 3)
+    n1, n2 = w1.export(), w2.export()
+    lhs = delta_exports(merge_exports({"w1": n1, "w2": n2}).export(),
+                        merge_exports({"w1": b1, "w2": b2}).export())
+    rhs = merge_exports({"w1": delta_exports(n1, b1),
+                         "w2": delta_exports(n2, b2)}).export()
+    merge_commute = (lhs["counters"] == rhs["counters"]
+                     and lhs["hist"] == rhs["hist"])
+
+    tp_match = bool(publish_fired and publish_resolved
+                    and latency_fired and latency_resolved)
+    return {
+        "config": ("synthetic injected-clock serving driver, scale=0.1, "
+                   "real spec metric names (no chip, no link — "
+                   "mechanism validation)"),
+        "specs": [s.name for s in ev.specs],
+        "ticks": ev.ticks,
+        "clean_alerts": clean_alerts,
+        "clean_active": clean_active,
+        "chaos_alerts": chaos_alerts,
+        "publish_fired": publish_fired,
+        "publish_resolved": publish_resolved,
+        "latency_fired": latency_fired,
+        "latency_resolved": latency_resolved,
+        "tp_match": tp_match,
+        "post_mortems": len(dumps),
+        "one_pm_per_fire": one_pm_per_fire,
+        "ledger_entries": len(entries),
+        "ledger_ok": ledger_ok,
+        "merge_commute": merge_commute,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Round 15: the capture journal + link-health + regression sentinel — the
 # layer that turns "the tunnel died again" from a zeroed 10-13 min run
 # into a journaled, attributable, resumable artifact (ROADMAP open item
@@ -3986,9 +4143,10 @@ _ALL_LEGS = (
     "streaming", "streaming_capacity", "streaming_soak",
     "latency_attribution", "streaming_overload", "chaos",
     "device_compute", "sweep_ab", "autotune", "quality", "window2",
-    "prepare_bench", "fleet", "topology", "backfill",
+    "prepare_bench", "fleet", "topology", "backfill", "slo",
 )
-_SELF_CONTAINED_LEGS = {"fleet", "topology", "backfill"}   # + sweep_ab /
+_SELF_CONTAINED_LEGS = {"fleet", "topology", "backfill",
+                        "slo"}                             # + sweep_ab /
 #                                         autotune /
 #                                         quality when no chip is in
 #                                         play (their *_cpu_validate
@@ -5102,6 +5260,14 @@ def main() -> None:
         detail["backfill"] = backfill
     split["backfill_s"] = journal.seconds("backfill")
 
+    # -- SLO burn-rate plane (ISSUE 20): every composite; fully
+    # synthetic (injected clock, no chip, no link), so `--legs slo`
+    # fits any window and its pass/fail can never ride link mood ------
+    slo_leg = journal.leg("slo", _slo_bench)
+    if slo_leg:
+        detail["slo"] = slo_leg
+    split["slo_s"] = journal.seconds("slo")
+
     # -- link-health record (round 15): the whole run's window + the
     # measured probe duty (the <0.5% steady-state claim as a field) ------
     if link_enabled:
@@ -5268,6 +5434,24 @@ def _bf_token(_g) -> list:
             None if mkr is None else round(mkr, 1)]
 
 
+def _slo_token(_g) -> list:
+    """slo = [clean-arm alerts (must be 0), chaos-arm alerts (2 = both
+    fault classes fired their matching spec), folded contract bit] —
+    full leg in detail.slo. The fold takes EVERY recorded bit (the
+    mxu-token style): matching-spec fire+resolve per fault class, one
+    post-mortem per fire transition, zero lost ledger entries, and the
+    topology merge-commute property — any recorded False reads 0, an
+    unexercised bit is absent from the fold, never vacuous green."""
+    bits = [b for b in (_g("slo", "tp_match"),
+                        _g("slo", "one_pm_per_fire"),
+                        _g("slo", "ledger_ok"),
+                        _g("slo", "merge_commute"))
+            if b is not None]
+    return [_g("slo", "clean_alerts"),
+            _g("slo", "chaos_alerts"),
+            None if not bits else int(all(bits))]
+
+
 def _summary_line(doc: dict) -> dict:
     """Compact (<1 KB, CI-pinned by tests/test_bench_summary.py)
     machine-readable round summary: headline value, per-tile throughput,
@@ -5340,20 +5524,20 @@ def _summary_line(doc: dict) -> dict:
             None if v is None else int(v)
             for v in (d.get("link_rtt_ms"),
                       _g("second_window", "link_rtt_ms"))],
-        # audit dis is a fixed-order array (r15, same r8 compaction: no
-        # room for six tile names twice) of BASIS-POINT ints (r18
-        # compaction — the qual token needed the bytes; 0.0123 rides as
-        # 123) — insertion order of the audit legs [headline,
+        # audit is a FIXED-ORDER array now (r24 compaction — the slo
+        # token needed the bytes): [total traces, dis_bp array, src
+        # array]. dis_bp: BASIS-POINT ints (r18; 0.0123 rides as 123)
+        # in the audit legs' insertion order [headline,
         # headline-fresh-rot, bayarea, sf+r, organic, bicycle]; named
         # exact values in detail.audit.per_tile
-        "audit": {
-            "traces": _g("audit", "total_traces"),
-            "dis_bp": [None if v.get("disagreement") is None
-                       else int(round(v["disagreement"] * 1e4))
-                       for v in per_tile.values()],
-            "src": sorted({v.get("fidelity_source", "?")
-                           for v in per_tile.values()}),
-        },
+        "audit": [
+            _g("audit", "total_traces"),
+            [None if v.get("disagreement") is None
+             else int(round(v["disagreement"] * 1e4))
+             for v in per_tile.values()],
+            sorted({v.get("fidelity_source", "?")
+                    for v in per_tile.values()}),
+        ],
         # fixed-order arrays (the r8 kpps compaction, applied here when
         # the lattr token needed the bytes back): gt_pm = point-on-edge
         # rate in PER-MILLE ints (r18 compaction: 0.9444 rides as 944)
@@ -5499,6 +5683,8 @@ def _summary_line(doc: dict) -> dict:
         "topo": _topo_token(_g),
         # round-20 backfill token (see _bf_token)
         "bf": _bf_token(_g),
+        # round-24 SLO token (see _slo_token)
+        "slo": _slo_token(_g),
         # round-15 link-health token: [rtt_ms, mbps, mood] — the run's
         # window; CPU composites record mood "cpu", never omit the token
         # (full record incl. measured probe duty in detail.link_health)

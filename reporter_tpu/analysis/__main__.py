@@ -16,6 +16,11 @@ tests/test_static_analysis.py and tests/test_device_contract.py).
                       block from the live constants (the fixtures/
                       regen.py workflow — run it ONLY for intentional
                       compile-universe changes and commit the diff).
+  --slo               SLO spec contract (round 24): validate the
+                      committed DEFAULT_SLOS (window ordering, burn
+                      thresholds vs budget, latency thresholds on the
+                      histogram grid, metric names in README's
+                      inventory). Exit 1 on any finding.
 """
 
 import argparse
@@ -27,7 +32,13 @@ def _main() -> int:
                     help="run the device-program contract gate")
     ap.add_argument("--update-manifest", action="store_true",
                     help="regenerate the golden compile-shape manifest")
+    ap.add_argument("--slo", action="store_true",
+                    help="validate the committed SLO specs")
     args = ap.parse_args()
+    if args.slo:
+        from reporter_tpu.analysis.slo_contract import main as slo_main
+
+        return slo_main()
     if args.update_manifest:
         from reporter_tpu.analysis.compile_manifest import update_golden
 

@@ -220,6 +220,16 @@ _SKIP_KEYS = {
     # pipelined_speedup ratio above carries the compared claim
     # lint: allow[bench-coverage] 2026-08-06 r22 prepare_ab rows land with this round's capture (the A/B is new; no committed composite carries it yet)
     "injected_flight_s", "serial_draw_s", "pipelined_draw_s",
+    # SLO leg (round 24, detail.slo): mechanism-contract tallies at the
+    # leg's FIXED synthetic scale — clean_alerts must be 0 and
+    # chaos_alerts exactly 2 BY CONSTRUCTION (the folded slo summary
+    # bit gates both; a delta here is a broken contract, not a perf
+    # regression), ticks/ledger/post-mortem counts are bookkeeping of
+    # the injected-clock driver
+    # lint: allow[bench-coverage] 2026-08-07 r24 detail.slo rows land with this round's capture (the leg is new; no committed composite carries it yet)
+    "ticks", "clean_alerts", "chaos_alerts", "post_mortems",
+    # lint: allow[bench-coverage] 2026-08-07 r24 detail.slo rows land with this round's capture (the leg is new; no committed composite carries it yet)
+    "ledger_entries",
 }
 
 # every throughput/latency number measured THROUGH the remote link is

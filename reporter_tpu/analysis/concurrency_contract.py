@@ -145,14 +145,27 @@ LOCK_ORDER_EDGES: "dict[tuple[str, str], str]" = {
     # the shadow audit also runs a DEDICATED oracle instance and never
     # takes matcher.fallback at all)
     # ---- topology supervisor (round 19) ----------------------------------
-    # supervisor.members / supervisor.events / supervisor.sink are LEAF
-    # locks BY CONSTRUCTION (distributed/supervisor.py docstring):
-    # spawning (subprocess.Popen is a patched blocking entry point),
+    # supervisor.members / supervisor.sink are LEAF locks BY
+    # CONSTRUCTION (distributed/supervisor.py docstring): spawning
+    # (subprocess.Popen is a patched blocking entry point),
     # post-mortems, gauge publication, and snapshot merging all run
     # outside them, so the topology layer contributes zero order edges
-    # and zero blocking-allow entries. A future edge from any of them
-    # is a design change — justify it here with a date, don't just add
-    # it.
+    # and zero blocking-allow entries. (The r19 supervisor.events lock
+    # was absorbed into the shared eventlog.append class in round 24 —
+    # still a leaf.) A future edge from any of them is a design change
+    # — justify it here with a date, don't just add it.
+    # ---- event logs (round 24) -------------------------------------------
+    ("lease.table", "eventlog.append"): "2026-08-07 lease audit events "
+        "persist inside the table transaction window (through "
+        "StaleLeaseError — a fencing rejection that vanished from the "
+        "log would be undebuggable, round 23), and round 24 moved the "
+        "append behind the shared utils/eventlog.py writer; "
+        "eventlog.append is a LEAF by construction (append+flush of "
+        "prebuilt lines, no fsync, never calls out)",
+    # obs.slo (round 24) is a LEAF by construction: it guards only the
+    # snapshot ring, throttle stamp and alert state — the export pull,
+    # gauge publication, ledger append and tracer all run outside it
+    # (the quality.monitor shape).
     # ---- streaming brokers ----------------------------------------------
     ("broker.partitions", "faults.plan"): "2026-08-04 durable append "
         "consults the broker fault site inside the partition lock so an "

@@ -24,6 +24,7 @@ _ENV_PREFIXES = ("RTPU_", "REPORTER_", "DATASTORE_")
 
 def snapshot() -> dict:
     from reporter_tpu import faults
+    from reporter_tpu.obs import slo as obs_slo
     from reporter_tpu.quality import audit as quality_audit
     from reporter_tpu.utils import linkhealth, tracing
 
@@ -46,6 +47,11 @@ def snapshot() -> dict:
         # None -> X lazy first construction is legal exactly like the
         # link sampler's
         "quality.auditor": quality_audit._global,
+        # the r24 SLO evaluator seam (obs/slo.install) — identity; the
+        # package never installs one itself, so ANY change (including
+        # None -> X) is a test leaving its evaluator behind: later
+        # tests would tick someone else's alert state
+        "obs.slo": obs_slo._installed,
         "env": {k: v for k, v in os.environ.items()
                 if k.startswith(_ENV_PREFIXES)},
     }
@@ -75,6 +81,11 @@ def diff(pre: dict, post: dict) -> "list[str]":
                    "(quality.audit.configure(fake) without restoring "
                    "the previous auditor in finally) — later tests "
                    "sample audits on the fake's schedule and budget")
+    if pre.get("obs.slo") is not post.get("obs.slo"):
+        out.append("SLO evaluator left installed via obs.slo.install "
+                   "and not restored — later tests would tick this "
+                   "test's alert state (restore the previous evaluator "
+                   "in finally; the package itself never installs one)")
     pe, qe = pre["env"], post["env"]
     for k in sorted(set(pe) | set(qe)):
         if pe.get(k) != qe.get(k):

@@ -37,9 +37,12 @@ model):
     owner hands off gracefully (flush → commit → release). The
     Supervisor drives it from its monitor loop; the table applies it.
 
-Concurrency: ``lease.table`` is a LEAF lock except for the load-bearing
-state-file fsync (BLOCKING_ALLOW, concurrency_contract.py) — table
-transactions never call into supervisor or pipeline locks.
+Concurrency: ``lease.table`` holds exactly two contract-dated edges —
+the load-bearing state-file fsync (BLOCKING_ALLOW) and the audit-log
+append through the shared ``eventlog.append`` lock (LOCK_ORDER_EDGES,
+round 24: events must persist in the same transaction window that
+produced them, StaleLeaseError included) — and table transactions never
+call into supervisor or pipeline locks.
 """
 
 from __future__ import annotations
@@ -50,7 +53,7 @@ import os
 import time
 from typing import Callable
 
-from reporter_tpu.utils import locks
+from reporter_tpu.utils import eventlog, locks
 
 _STATE = "leases.json"
 _EVENTS = "lease_events.jsonl"
@@ -99,10 +102,15 @@ class LeaseTable:
 
     def __init__(self, path: str, num_partitions: "int | None" = None,
                  ttl_s: float = DEFAULT_TTL_S,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 metrics=None):
         self.path = str(path)
         self.ttl_s = float(ttl_s)
         self.clock = clock
+        # optional registry: feeds the r24 lease_reacquire SLO (the
+        # observation runs OUTSIDE lease.table — the registry lock must
+        # never nest under the table)
+        self._metrics = metrics
         if self.ttl_s <= 0:
             raise LeaseError(f"lease ttl must be positive, got {ttl_s}")
         self._lock = locks.named_lock("lease.table")
@@ -110,6 +118,7 @@ class LeaseTable:
         self._state_path = os.path.join(self.path, _STATE)
         self._events_path = os.path.join(self.path, _EVENTS)
         self._lock_path = os.path.join(self.path, _LOCK)
+        self._events = eventlog.EventLog(self._events_path)
         with self._txn() as t:
             st = t.state
             if not st:
@@ -185,10 +194,12 @@ class LeaseTable:
                 os.fsync(f.fileno())
             os.replace(tmp, self._state_path)
         if t.events:
+            # the shared EventLog spelling (r24); runs while lease.table
+            # is held — the contract-dated (lease.table, eventlog.append)
+            # edge: audit events must land in the same transaction
+            # window that produced them (incl. through StaleLeaseError)
             now = self.clock()
-            with open(self._events_path, "a", encoding="utf-8") as f:
-                for e in t.events:
-                    f.write(json.dumps({"t": now, **e}) + "\n")
+            self._events.extend({"t": now, **e} for e in t.events)
 
     def _ent(self, t: _Txn, partition: int) -> dict:
         ent = t.state["partitions"].get(str(int(partition)))
@@ -210,6 +221,7 @@ class LeaseTable:
         renews and keeps it), None if another member holds it or it is
         assigned elsewhere by the rebalancer."""
         ttl = self.ttl_s if ttl_s is None else float(ttl_s)
+        reacquire_gap: "float | None" = None
         with self._txn() as t:
             ent = self._ent(t, partition)
             now = self.clock()
@@ -226,6 +238,9 @@ class LeaseTable:
             if prev is not None:
                 t.event("expired", partition=int(partition), member=prev,
                         epoch=int(ent["epoch"]))
+                # expiry→takeover gap: how long the partition sat
+                # unserved — the r24 lease_reacquire SLO's observation
+                reacquire_gap = max(0.0, now - float(ent["expires"]))
             ent["epoch"] = int(ent["epoch"]) + 1
             ent["owner"] = member
             ent["expires"] = now + ttl
@@ -236,7 +251,13 @@ class LeaseTable:
                     epoch=int(ent["epoch"]),
                     committed=int(ent["committed"]),
                     takeover_from=prev)
-            return int(ent["epoch"])
+            epoch = int(ent["epoch"])
+        # observed AFTER the transaction exits: metrics.registry must
+        # never nest under lease.table
+        if reacquire_gap is not None and self._metrics is not None:
+            self._metrics.observe("lease_reacquire_seconds",
+                                  reacquire_gap)
+        return epoch
 
     def renew(self, member: str, ttl_s: "float | None" = None) -> dict:
         """Heartbeat + one consistent view for ``member``: renew every
@@ -401,11 +422,7 @@ class LeaseTable:
                     for p in range(int(t.state["num_partitions"]))]
 
     def events(self) -> "list[dict]":
-        try:
-            with open(self._events_path, encoding="utf-8") as f:
-                return [json.loads(line) for line in f if line.strip()]
-        except FileNotFoundError:
-            return []
+        return self._events.read()
 
 
 def plan_rebalance(state: dict, now: float, member_ttl_s: float,

@@ -36,9 +36,17 @@ or lease expiry orphans partitions, and the planner reassigns them to
 the least-loaded live workers. All lease-table I/O runs OUTSIDE the
 supervisor locks (the table has its own leaf lock + flock).
 
+SLO plane (round 24): the supervisor evaluates the SAME committed
+:data:`~reporter_tpu.obs.slo.DEFAULT_SLOS` the workers do, but over the
+r19 ``merge_exports`` document — burn is linear over counters/buckets,
+so the topology-wide burn rate is one number equal to the per-worker
+sum by construction. Its alert ledger is ``alerts.jsonl`` in the
+workdir; ``/slo`` serves the full status and ``/health`` the roll-up.
+
 Locking discipline (round 14): the member table rides
-``supervisor.members``; the event log rides ``supervisor.events``; the
-sink counter rides ``supervisor.sink``. All three are LEAF locks —
+``supervisor.members``; the sink counter rides ``supervisor.sink``; the
+event log rides the shared ``eventlog.append`` class (round 24 — the
+one JSONL spelling, utils/eventlog.py). All three are LEAF locks —
 spawning (``subprocess.Popen`` is a patched blocking entry point),
 post-mortems, gauge publication, and snapshot merging all run OUTSIDE
 them by construction, so the topology layer adds zero blocking-allow
@@ -57,7 +65,8 @@ import time
 from typing import Any
 
 from reporter_tpu.distributed import aggregate
-from reporter_tpu.utils import locks, metrics, tracing
+from reporter_tpu.obs import slo as obs_slo
+from reporter_tpu.utils import eventlog, locks, metrics, tracing
 
 __all__ = ["MemberSpec", "Supervisor", "ReportSink", "worker_member"]
 
@@ -221,7 +230,7 @@ class Supervisor:
         self.max_restarts = int(max_restarts)
         self.poll_s = float(poll_s)
         self._members_lock = locks.named_lock("supervisor.members")
-        self._events_lock = locks.named_lock("supervisor.events")
+        self._events = eventlog.EventLog(self.events_path)
         self._members: "dict[str, _Member]" = {
             s.name: _Member(s) for s in members}
         self._base_env = dict(base_env or {})
@@ -245,6 +254,20 @@ class Supervisor:
         if lease_dir is not None:
             from reporter_tpu.distributed.lease import LeaseTable
             self._lease_table = LeaseTable(lease_dir)
+        # Round-24 SLO plane: the same committed specs the workers run,
+        # evaluated over the MERGED export — topology-wide burn is one
+        # number. sample_gauges=False: members already folded their own
+        # gauge levels into the synthetic sample counters, and the merge
+        # carries them; sampling the worker-labeled merged gauges here
+        # would double-count.
+        self.alerts_path = os.path.join(workdir, "alerts.jsonl")
+        self.slo: "obs_slo.SloEvaluator | None" = None
+        if obs_slo.enabled():
+            self.slo = obs_slo.SloEvaluator(
+                self.metrics,
+                source=lambda: self.merged_registry().export(),
+                ledger=eventlog.EventLog(self.alerts_path),
+                sample_gauges=False)
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -396,6 +419,8 @@ class Supervisor:
             self.metrics.count("topo_restarts")
             self._spawn(name, reason="restart")
         self._maybe_rebalance()
+        if self.slo is not None:
+            self.slo.tick()         # self-throttled; outside all locks
         self._publish_gauges()
 
     def stop(self, timeout: float = 30.0) -> None:
@@ -582,30 +607,14 @@ class Supervisor:
     # ---- observability ---------------------------------------------------
 
     def _event(self, kind: str, **fields) -> None:
-        """Append one line to the topology event log. Plain
-        append+flush (not tmp+rename): events are immutable history, a
-        torn final line from a crash truncates at read like every other
-        JSONL in the repo, and rewriting the whole log per event would
-        be O(n^2) in topology lifetime."""
-        line = json.dumps({"t": round(time.time(), 3), "event": kind,
-                           **fields})
-        with self._events_lock:
-            with open(self.events_path, "a") as f:
-                f.write(line + "\n")
-                f.flush()
+        """Append one line to the topology event log (the r24 shared
+        EventLog spelling: append+flush, torn-tail truncation at
+        reopen)."""
+        self._events.append({"t": round(time.time(), 3), "event": kind,
+                             **fields})
 
     def events(self) -> "list[dict]":
-        out: "list[dict]" = []
-        try:
-            with open(self.events_path) as f:
-                for ln in f:
-                    try:
-                        out.append(json.loads(ln))
-                    except json.JSONDecodeError:
-                        break               # torn tail: stop at last good
-        except OSError:
-            pass
-        return out
+        return self._events.read()
 
     def _publish_gauges(self) -> None:
         with self._members_lock:
@@ -669,6 +678,8 @@ class Supervisor:
         }
         if self.sink is not None:
             out["sink"] = self.sink.stats()
+        if self.slo is not None:
+            out["slo"] = self.slo.health()
         return out
 
     # ---- WSGI face -------------------------------------------------------
@@ -688,6 +699,12 @@ class Supervisor:
         if path == "/health":
             return _respond(start_response, "200 OK",
                             json.dumps(self.health()).encode(),
+                            "application/json")
+        if path == "/slo":
+            body = (self.slo.status() if self.slo is not None
+                    else {"enabled": False})
+            return _respond(start_response, "200 OK",
+                            json.dumps(body).encode(),
                             "application/json")
         return _respond(start_response, "404 Not Found", b"{}",
                         "application/json")

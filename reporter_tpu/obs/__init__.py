@@ -1,0 +1,8 @@
+"""Round-24 SLO plane: declarative error-budget objectives + multi-window
+burn-rate alerting over the metrics registry (see obs/slo.py)."""
+
+from reporter_tpu.obs.slo import (DEFAULT_SLOS, SloEvaluator, SloSpec,
+                                  active, enabled, install, window_scale)
+
+__all__ = ["DEFAULT_SLOS", "SloEvaluator", "SloSpec", "active",
+           "enabled", "install", "window_scale"]

@@ -30,6 +30,7 @@ from urllib.parse import parse_qs
 from reporter_tpu.utils import locks
 from reporter_tpu.config import Config
 from reporter_tpu.matcher.api import DispatchTimeout, SegmentMatcher, Trace
+from reporter_tpu.obs import slo as obs_slo
 from reporter_tpu.service.cache import PartialTraceCache
 from reporter_tpu.service.datastore import DatastorePublisher, Transport
 from reporter_tpu.service.scheduler import BatchScheduler, ServiceOverloaded
@@ -177,10 +178,24 @@ class ReporterApp:
                      and self.config.matcher_backend == "jax")
         self.scheduler: "BatchScheduler | None" = (
             BatchScheduler(self) if use_sched else None)
+        # SLO plane (round 24): burn-rate evaluation over this app's own
+        # registry. Ticks ride the request path (self-throttled) and
+        # GET /slo; no ledger here — durable alert ledgers belong to the
+        # worker CLI (snapshot spool) and the supervisor (workdir).
+        self.slo: "obs_slo.SloEvaluator | None" = (
+            obs_slo.SloEvaluator(self.matcher.metrics)
+            if obs_slo.enabled() else None)
 
     # ---- core pipeline ---------------------------------------------------
 
     def _bump(self, key: str, delta: int = 1) -> None:
+        # r24 SLO inputs: request/error totals mirror into the registry
+        # (the availability SLO's ratio) BEFORE taking the stats lock —
+        # metrics.registry stays a leaf with no app.stats edge
+        if key == "requests":
+            self.matcher.metrics.count("http_requests", delta)
+        elif key == "errors":
+            self.matcher.metrics.count("http_errors", delta)
         # scheduler mode makes concurrent WSGI handler threads the norm:
         # every stats mutation goes through the lock or loses increments
         with self._stats_lock:
@@ -393,6 +408,10 @@ class ReporterApp:
         # sentinel state, so "are we still matching well?" is answerable
         # at the liveness face (full series at /stats and /metrics)
         out["quality"] = self.matcher.quality.health()
+        # SLO roll-up (round 24): alerting objectives + budget remaining
+        # at the liveness face; full burn detail at /slo
+        if self.slo is not None:
+            out["slo"] = self.slo.health()
         s = linkhealth.sampler() if linkhealth.enabled() else None
         last = s.latest() if s is not None else None
         out["link"] = {
@@ -426,6 +445,10 @@ class ReporterApp:
             finally:
                 self.matcher.metrics.observe(
                     "request_seconds", time.perf_counter() - t0)
+                if self.slo is not None:
+                    # self-throttled burn evaluation rides the request
+                    # path, so a serving app alerts without a poller
+                    self.slo.tick()
         return self._dispatch(environ, start_response, method, path)
 
     def _dispatch(self, environ: dict, start_response: Callable,
@@ -445,6 +468,14 @@ class ReporterApp:
                 return _respond_text(
                     start_response, 200,
                     self.matcher.metrics.render_prometheus())
+            if path == "/slo" and method == "GET":
+                # error-budget status (round 24): burn rates per window
+                # pair, budget remaining, alert states
+                if self.slo is None:
+                    return _respond(start_response, 200,
+                                    {"enabled": False})
+                self.slo.tick()
+                return _respond(start_response, 200, self.slo.status())
             if path == "/aggregates" and method == "GET":
                 # backfill's harvested per-segment doc (round 20):
                 # already k-anonymized at harvest — this face only reads

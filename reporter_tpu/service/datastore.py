@@ -251,18 +251,26 @@ class DatastorePublisher:
         """One transport attempt (no retries, no counting beyond the
         request counter) — the unit the retry loop and spool replay
         share. The ``publish`` fault site lives HERE, so an injected
-        outage hits every path a real one would."""
+        outage hits every path a real one would — and so do the r24
+        ``publish_attempts``/``publish_failures`` counters (the publish
+        SLO's ratio; registry writes run OUTSIDE the count lock)."""
         with self._count_lock:
             self.requests += 1
+        if self._metrics is not None:
+            self._metrics.count("publish_attempts")
         try:
             faults.fire("publish")
             status = self._transport(self.url, payload)
         except (urllib.error.URLError, OSError, TimeoutError) as exc:
             log.warning("datastore POST failed: %s", exc)
+            if self._metrics is not None:
+                self._metrics.count("publish_failures")
             return False
         if 200 <= status < 300:
             return True
         log.warning("datastore POST returned %d", status)
+        if self._metrics is not None:
+            self._metrics.count("publish_failures")
         return False
 
     def _post_with_retries(self, payload: bytes) -> bool:

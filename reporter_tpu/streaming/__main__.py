@@ -189,17 +189,39 @@ def main(argv: "list[str] | None" = None) -> int:
 
     from reporter_tpu import faults
 
+    # the worker's matcher registry is the one every layer feeds — the
+    # snapshot spool exports IT, so the supervisor's merge sees the same
+    # series /stats and /metrics would serve in-process
+    matcher = getattr(pipe, "matcher", None) or pipe.app.matcher
+
     runner = None
     if args.lease_dir:
         from reporter_tpu.distributed.lease import LeaseRunner, LeaseTable
 
         table = LeaseTable(args.lease_dir,
                            num_partitions=config.streaming.num_partitions,
-                           ttl_s=args.lease_ttl)
+                           ttl_s=args.lease_ttl,
+                           metrics=matcher.metrics)
         runner = LeaseRunner(table, member, pipe)
         runner.sync(force=True)
         log.info("lease member %s: partitions %s", member,
                  sorted(runner.epochs))
+
+    # SLO plane (round 24): burn-rate evaluation over this worker's own
+    # registry, ticked from the main loop (self-throttled). The durable
+    # alert ledger rides the snapshot spool dir so the supervisor finds
+    # every member's alerts beside its metrics snapshots.
+    from reporter_tpu.obs import slo as obs_slo
+
+    slo_eval = None
+    if obs_slo.enabled():
+        ledger = None
+        if args.snapshot_dir:
+            from reporter_tpu.utils.eventlog import EventLog
+
+            ledger = EventLog(os.path.join(args.snapshot_dir,
+                                           f"alerts_{member}.jsonl"))
+        slo_eval = obs_slo.SloEvaluator(matcher.metrics, ledger=ledger)
 
     stop = {"now": False}
 
@@ -208,11 +230,6 @@ def main(argv: "list[str] | None" = None) -> int:
 
     signal.signal(signal.SIGINT, _handle)
     signal.signal(signal.SIGTERM, _handle)
-
-    # the worker's matcher registry is the one every layer feeds — the
-    # snapshot spool exports IT, so the supervisor's merge sees the same
-    # series /stats and /metrics would serve in-process
-    matcher = getattr(pipe, "matcher", None) or pipe.app.matcher
 
     def _spool_snapshot(seq: int, st: dict) -> None:
         from reporter_tpu.distributed import aggregate
@@ -251,6 +268,8 @@ def main(argv: "list[str] | None" = None) -> int:
             if runner is not None:
                 runner.push_commits()
             steps += 1
+            if slo_eval is not None:
+                slo_eval.tick()  # self-throttled; cheap on the hot loop
             if args.checkpoint and (time.monotonic() - last_ckpt
                                     >= args.checkpoint_interval):
                 pipe.checkpoint(args.checkpoint)
@@ -383,10 +402,18 @@ def main(argv: "list[str] | None" = None) -> int:
                        "fired": {s: int(n) for s, n in fs["fired"].items()
                                  if n}}
     lease_stats = None if runner is None else dict(runner.stats)
+    # SLO roll-up in the exit report (round 24): a final forced tick so a
+    # short-lived worker's burn state reflects the full run, then the
+    # active-alert/budget block the supervisor surfaces per member
+    slo_block = None
+    if slo_eval is not None:
+        slo_eval.tick(force=True)
+        slo_block = slo_eval.exit_block()
     print(json.dumps({"steps": steps, "reports": reports,
                       "committed": list(pipe.committed),
                       "member": member,
                       "faults": fault_stats, "lease": lease_stats,
+                      "slo": slo_block,
                       "link": link, "quality": quality,
                       **{k: v for k, v in st.items()
                          if k in ("lag", "published", "malformed",

@@ -1,0 +1,99 @@
+"""ONE JSONL event-log spelling (round 24).
+
+Before this round three subsystems each hand-rolled the same append-only
+JSONL event log — ``topology_events.jsonl`` (supervisor),
+``lease_events.jsonl`` (lease table) and now the round-24 SLO alert
+ledger — with three slightly different torn-tail policies.  ``EventLog``
+is the single spelling: every append is ONE ``write()`` of complete
+lines followed by ``flush()`` (the r9 append-log discipline: a reader
+never observes a half-written *prefix* of the log, only possibly a torn
+final line after a crash), and reopening an existing log truncates a
+torn tail so a restarted appender never extends a half-written line into
+a permanently corrupt one.
+
+No fsync: durability-to-the-platter is the snapshot spool's job
+(``distributed/aggregate.py``), and an event log that fsynced under its
+lock would trip the r14 blocking-under-lock gate.  Crash exposure is one
+tail line, which truncation-at-reopen plus the tolerant reader both
+handle.
+
+The lock is ONE named class (``eventlog.append``) shared by every
+instance — per-path dynamic names would blow up the r14 golden lock
+graph (the per-metro build-lock precedent); distinct instances
+serializing against each other is harmless at event-log rates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from reporter_tpu.utils.locks import named_lock
+
+
+def _truncate_torn_tail(path: str) -> None:
+    """Cut a trailing partial line (crash mid-append) back to the last
+    complete one.  Event logs are small — whole-file read keeps this
+    obviously correct."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size == 0:
+        return
+    with open(path, "rb+") as fh:
+        fh.seek(-1, os.SEEK_END)
+        if fh.read(1) == b"\n":
+            return
+        fh.seek(0)
+        data = fh.read()
+        cut = data.rfind(b"\n")
+        fh.truncate(cut + 1 if cut >= 0 else 0)
+
+
+def read_events(path):
+    """Tolerant JSONL reader: parse complete lines, stop at the first
+    unparsable one (with atomic appends the only malformed line is a
+    torn tail written by a process that crashed since the last
+    reopen)."""
+    out = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    break
+    except FileNotFoundError:
+        pass
+    return out
+
+
+class EventLog:
+    """Append-only JSONL log with torn-tail truncation at reopen."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = named_lock("eventlog.append")
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        _truncate_torn_tail(path)
+
+    def append(self, doc: dict) -> None:
+        self.extend((doc,))
+
+    def extend(self, docs) -> None:
+        lines = "".join(json.dumps(d) + "\n" for d in docs)
+        if not lines:
+            return
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(lines)
+                fh.flush()
+
+    def read(self):
+        return read_events(self.path)

@@ -319,6 +319,82 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+def delta_exports(newer: dict, older: dict) -> dict:
+    """Element-wise ``newer − older`` over two ``export()`` documents —
+    the windowed-rate primitive the round-24 SLO plane burns on.
+
+    Counters and histogram buckets diff (clamped at 0: a restarted
+    worker's reset must read as "no progress", never as negative burn);
+    gauges carry ``newer``'s values verbatim (a level has no meaningful
+    difference over a window — the SLO engine samples gauges into
+    synthetic counters instead). The result carries the ``schema`` tag
+    so it IS a valid export: ``merge_exports`` accepts delta documents,
+    which is what makes topology-wide burn well-defined — on counters
+    and buckets the diff is linear, so delta-of-merged-exports equals
+    merge-of-per-worker-deltas exactly (property-tested,
+    tests/test_slo.py)."""
+    old_counters = older.get("counters") or {}
+    counters = {
+        k: max(0.0, float(v) - float(old_counters.get(k, 0.0)))
+        for k, v in (newer.get("counters") or {}).items()}
+    old_hist = older.get("hist") or {}
+    hist = {}
+    for k, buckets in (newer.get("hist") or {}).items():
+        prev = list(old_hist.get(k) or ())
+        prev += [0] * (len(buckets) - len(prev))
+        hist[k] = [max(0, int(b) - int(p))
+                   for b, p in zip(buckets, prev)]
+    return {"schema": EXPORT_SCHEMA, "counters": counters,
+            "gauges": dict(newer.get("gauges") or {}), "hist": hist}
+
+
+def delta_since(snapshots, window_s: float, now: "float | None" = None):
+    """Windowed diff over a chronological ``[(monotonic_t, export), …]``
+    sequence: returns ``(delta_exports(newest, baseline), span_s)``
+    where the baseline is the LATEST snapshot at or before
+    ``now − window_s`` (fallback: the oldest held — a young ring yields
+    a shorter, honestly-reported span rather than a fabricated one).
+    With fewer than two snapshots the delta is all-zero and the span 0.0
+    — a first tick can never alert."""
+    if not snapshots:
+        return None, 0.0
+    t_new, newest = snapshots[-1]
+    if now is None:
+        now = t_new
+    cutoff = now - window_s
+    base_t, base = snapshots[0]
+    for t, exp in snapshots:
+        if t <= cutoff:
+            base_t, base = t, exp
+        else:
+            break
+    return delta_exports(newest, base), max(0.0, t_new - base_t)
+
+
+class SnapshotRing:
+    """Bounded chronological ring of (monotonic_t, export) snapshots —
+    the state behind ``delta_since``. Unlocked by design: the one
+    writer/reader is the SLO evaluator's tick, which holds its own named
+    lock."""
+
+    __slots__ = ("_snaps", "_cap")
+
+    def __init__(self, cap: int = 512):
+        self._snaps: list = []
+        self._cap = cap
+
+    def push(self, t: float, export: dict) -> None:
+        self._snaps.append((float(t), export))
+        if len(self._snaps) > self._cap:
+            del self._snaps[0]
+
+    def __len__(self) -> int:
+        return len(self._snaps)
+
+    def delta_since(self, window_s: float, now: "float | None" = None):
+        return delta_since(self._snaps, window_s, now)
+
+
 def merge_exports(exports: "dict[str, dict]") -> MetricsRegistry:
     """K member ``export()`` documents → ONE fleet-wide registry (the
     round-10 promise, finally performed): keyed by member name so gauges

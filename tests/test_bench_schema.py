@@ -776,3 +776,52 @@ def test_summary_line_carries_delta_token():
     empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
                                  "vs_baseline": 1.0, "detail": {}})
     assert empty["delta"] == [None] * 3
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate leg (round 24)
+
+SLO_KEYS = (
+    "specs", "ticks", "clean_alerts", "clean_active", "chaos_alerts",
+    "publish_fired", "publish_resolved", "latency_fired",
+    "latency_resolved", "tp_match", "post_mortems", "one_pm_per_fire",
+    "ledger_entries", "ledger_ok", "merge_commute", "seconds",
+)
+
+
+def test_slo_leg_schema_keys():
+    """Pin detail.slo (round 24): the clean/chaos arm tallies, the
+    matching-spec + one-post-mortem-per-fire + ledger contracts, and the
+    topology merge-commute property bit. Extend, never drop."""
+    import inspect
+
+    bench = _load_bench()
+    src = inspect.getsource(bench._slo_bench)
+    for key in SLO_KEYS:
+        assert f'"{key}"' in src, key
+
+
+def test_summary_line_carries_slo_token():
+    """slo = [clean-arm alerts (must be 0), chaos-arm alerts (2 = both
+    fault classes fired), folded contract bit]. The fold takes every
+    RECORDED bit (mxu-token style): one recorded False reads 0, nothing
+    recorded reads None — never vacuous green."""
+    bench = _load_bench()
+    doc = {"metric": "probes_per_sec_e2e", "value": 1000000.0,
+           "unit": "probes/s", "vs_baseline": 1.0,
+           "detail": {
+               "slo": {"clean_alerts": 0, "chaos_alerts": 2,
+                       "tp_match": True, "one_pm_per_fire": True,
+                       "ledger_ok": True, "merge_commute": True},
+           }}
+    assert bench._summary_line(doc)["slo"] == [0, 2, 1]
+    # one recorded False anywhere → the fold reads 0
+    doc["detail"]["slo"]["one_pm_per_fire"] = False
+    assert bench._summary_line(doc)["slo"] == [0, 2, 0]
+    # partially recorded (clean arm only): absent bits are excluded
+    # from the fold, present ones still gate
+    doc["detail"]["slo"] = {"clean_alerts": 0, "merge_commute": True}
+    assert bench._summary_line(doc)["slo"] == [0, None, 1]
+    empty = bench._summary_line({"metric": "m", "value": 1.0, "unit": "u",
+                                 "vs_baseline": 1.0, "detail": {}})
+    assert empty["slo"] == [None] * 3

@@ -193,6 +193,17 @@ def _synthetic_doc():
                      "wire_bytes_identical": True},
             "vs_soak_x": 12.34,
         },
+        # widths honest-worst for the leg's FIXED synthetic scale (see
+        # _slo_bench): 2-digit alert counts, single-bit folds
+        "slo": {
+            "clean_alerts": 0,
+            "chaos_alerts": 12,
+            "tp_match": True,
+            "one_pm_per_fire": True,
+            "ledger_ok": True,
+            "merge_commute": True,
+            "ticks": 300, "ledger_entries": 12, "post_mortems": 12,
+        },
         "link_health": {"rtt_ms": 1129.22, "mbps": 125.13,
                         "mood": "degraded", "samples": 123,
                         "probe_duty_pct": 0.4123},

@@ -1,0 +1,589 @@
+"""Round 24: the SLO burn-rate plane (obs/slo.py), the shared JSONL
+event log (utils/eventlog.py), windowed export deltas
+(metrics.delta_since/delta_exports), and the ``--slo`` spec validator.
+
+The chaos discipline is the r18 twin pattern: every SLO class gets a
+true-positive arm (the matching fault fires the matching alert, with
+exactly ONE bounded post-mortem per fire transition) and a clean twin
+(healthy traffic through the same windows fires nothing). Everything
+runs on injected clocks — no sleeps, no wall-clock flake.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from reporter_tpu import faults
+from reporter_tpu.obs import slo as obs_slo
+from reporter_tpu.obs.slo import DEFAULT_SLOS, SloEvaluator, SloSpec
+from reporter_tpu.utils import tracing
+from reporter_tpu.utils.eventlog import EventLog, read_events
+from reporter_tpu.utils.metrics import (MetricsRegistry, SnapshotRing,
+                                        delta_exports, delta_since,
+                                        labeled, merge_exports)
+
+
+# ---------------------------------------------------------------------------
+# utils/eventlog.py — the ONE JSONL append-log spelling
+
+
+def test_eventlog_roundtrip(tmp_path):
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    log.append({"event": "a", "n": 1})
+    log.extend([{"event": "b"}, {"event": "c"}])
+    assert [e["event"] for e in log.read()] == ["a", "b", "c"]
+
+
+def test_eventlog_truncates_torn_tail_at_reopen(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path)
+    log.append({"event": "whole"})
+    with open(path, "a") as f:
+        f.write('{"event": "torn')        # crash mid-append: no newline
+    # a reader between the crash and the reopen skips the torn tail
+    assert [e["event"] for e in read_events(path)] == ["whole"]
+    # reopen truncates it, and the next append lands on a clean tail
+    log2 = EventLog(path)
+    log2.append({"event": "after"})
+    assert [e["event"] for e in log2.read()] == ["whole", "after"]
+    with open(path, "rb") as f:
+        assert f.read().endswith(b"\n")
+
+
+def test_eventlog_reader_tolerates_blanks_and_stops_at_garbage(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as f:
+        f.write('{"event": "a"}\n\n{"event": "b"}\nnot json\n'
+                '{"event": "after-garbage"}\n')
+    # blank lines skip; the first undecodable line ends the read (same
+    # prefix-is-truth contract as the r9 append logs)
+    assert [e["event"] for e in read_events(path)] == ["a", "b"]
+    assert read_events(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_eventlog_concurrent_appends_stay_whole_lines(tmp_path):
+    log = EventLog(str(tmp_path / "events.jsonl"))
+
+    def writer(i):
+        for j in range(25):
+            log.append({"w": i, "j": j})
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = log.read()
+    assert len(events) == 100
+    assert sorted((e["w"], e["j"]) for e in events) == sorted(
+        (i, j) for i in range(4) for j in range(25))
+
+
+# ---------------------------------------------------------------------------
+# metrics.delta_exports / delta_since / SnapshotRing
+
+
+def _reg_with(counts=(), observes=()):
+    r = MetricsRegistry()
+    for name, n in counts:
+        r.count(name, n)
+    for name, v in observes:
+        r.observe(name, v)
+    return r
+
+
+def test_delta_exports_diffs_counters_and_buckets():
+    r = MetricsRegistry()
+    r.count("http_requests", 10)
+    r.observe("request_seconds", 0.01)
+    older = r.export()
+    r.count("http_requests", 5)
+    r.count("http_errors", 2)
+    r.observe("request_seconds", 1.0)
+    d = delta_exports(r.export(), older)
+    assert d["counters"]["http_requests"] == 5.0
+    assert d["counters"]["http_errors"] == 2.0
+    # exactly one new observation across the bucket grid
+    assert sum(d["hist"]["request_seconds"]) == 1
+    # the delta doc carries the schema tag so merge_exports accepts it
+    assert d["schema"] == older["schema"]
+
+
+def test_delta_exports_clamps_counter_resets_to_zero():
+    r1 = _reg_with(counts=[("http_requests", 100)])
+    r2 = _reg_with(counts=[("http_requests", 3)])    # restarted process
+    d = delta_exports(r2.export(), r1.export())
+    assert d["counters"]["http_requests"] == 0.0
+
+
+def test_delta_since_baselines_on_the_window_edge():
+    ring = SnapshotRing()
+    for t in range(10):                      # snapshots at t = 0..9
+        r = _reg_with(counts=[("c", t)])     # cumulative value t(t+1)/2
+        ring.push(float(t), r.export())
+    # window 3 at now=9: baseline is the LATEST snapshot with t <= 6,
+    # so the delta is the counter's rise from t=6 to t=9
+    d, span = ring.delta_since(3.0, now=9.0)
+    assert span == 3.0
+    assert d["counters"]["c"] == 3.0
+    # a window wider than the ring falls back to the oldest held with
+    # an HONEST span, never a fabricated one
+    d, span = ring.delta_since(100.0, now=9.0)
+    assert span == 9.0 and d["counters"]["c"] == 9.0
+
+
+def test_delta_since_first_tick_is_zero():
+    """<2 snapshots ⇒ zero delta over zero span — a first tick can
+    never alert."""
+    ring = SnapshotRing()
+    assert ring.delta_since(60.0) == (None, 0.0)
+    ring.push(0.0, _reg_with(counts=[("c", 5)]).export())
+    d, span = ring.delta_since(60.0, now=0.0)
+    assert span == 0.0
+    assert all(v == 0.0 for v in d["counters"].values())
+
+
+def test_delta_commutes_with_merge_exports():
+    """Burn is linear over counters and buckets, so topology-wide burn
+    over merged exports equals the per-worker sum BY CONSTRUCTION:
+    delta(merge) == merge(deltas), exactly, for every counter and every
+    bucket."""
+    import random
+
+    for seed in range(5):
+        rng = random.Random(seed)
+        regs = {f"w{i}": MetricsRegistry() for i in range(3)}
+
+        def drive(n):
+            for _ in range(n):
+                r = regs[rng.choice(list(regs))]
+                which = rng.random()
+                if which < 0.4:
+                    r.count("http_requests", rng.randint(1, 9))
+                elif which < 0.6:
+                    r.count(labeled("http_errors", metro=rng.choice("ab")))
+                else:
+                    r.observe("request_seconds", rng.uniform(0.001, 20))
+
+        drive(60)
+        base = {m: r.export() for m, r in regs.items()}
+        drive(60)
+        new = {m: r.export() for m, r in regs.items()}
+        lhs = delta_exports(merge_exports(new).export(),
+                            merge_exports(base).export())
+        rhs = merge_exports({m: delta_exports(new[m], base[m])
+                             for m in regs}).export()
+        # hist buckets and event counters are integer-valued: bit-exact.
+        # The float `_total` shadows commute only up to summation order
+        # (ulp-level) — which is why burn ratios are computed from
+        # counts and buckets, never from the float sums.
+        assert lhs["hist"] == rhs["hist"], seed
+        assert set(lhs["counters"]) == set(rhs["counters"]), seed
+        for k, v in lhs["counters"].items():
+            assert rhs["counters"][k] == pytest.approx(v, rel=1e-9), \
+                (seed, k)
+
+
+# ---------------------------------------------------------------------------
+# SloEvaluator — harness + per-class TP/FP twins
+
+
+def _evaluator(reg, **kw):
+    clock = {"now": 0.0}
+    kw.setdefault("scale", 0.1)      # fast windows 6 s of virtual time
+    kw.setdefault("min_tick_s", 0.0)
+    kw.setdefault("enabled_override", True)
+    ev = SloEvaluator(reg, clock=lambda: clock["now"], **kw)
+    return ev, clock
+
+
+def _drive(ev, clock, reg, seconds, feed):
+    for _ in range(seconds):
+        clock["now"] += 1.0
+        feed(reg)
+        ev.tick()
+
+
+def _healthy(reg):
+    reg.count("http_requests", 10)
+    reg.count("publish_attempts", 10)
+    reg.observe("request_seconds", 0.01)
+    reg.observe("match_seconds", 0.005)
+    reg.observe("lease_reacquire_seconds", 0.5)
+    reg.gauge("stream_lag", 10.0)
+
+
+_CLASS_FAULTS = {
+    # spec name -> the bad-traffic feeder for its TP arm
+    "availability": lambda reg: (_healthy(reg),
+                                 reg.count("http_errors", 10)),
+    "latency": lambda reg: (reg.count("http_requests", 10),
+                            reg.observe("request_seconds", 1.0)),
+    "publish": lambda reg: (_healthy(reg),
+                            reg.count("publish_failures", 10)),
+    "dispatch_timeout": lambda reg: (reg.observe("match_seconds", 0.005),
+                                     reg.count("dispatch_timeout", 1)),
+    "stream_lag": lambda reg: (_healthy(reg),
+                               reg.gauge("stream_lag", 99999.0)),
+    "lease_reacquire": lambda reg: (
+        _healthy(reg), reg.observe("lease_reacquire_seconds", 25.0)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_CLASS_FAULTS))
+def test_slo_class_true_positive_fires_matching_alert(name):
+    reg = MetricsRegistry()
+    ev, clock = _evaluator(reg)
+    _drive(ev, clock, reg, 40, _CLASS_FAULTS[name])
+    active = ev.status()["active"]
+    assert name in active, (name, ev.status()["slos"][name])
+    # recovery resolves it (both windows must drain — the slow pair's
+    # 360 virtual seconds dominates)
+    _drive(ev, clock, reg, 400, _healthy)
+    assert name not in ev.status()["active"]
+
+
+def test_slo_clean_twin_fires_nothing():
+    reg = MetricsRegistry()
+    ev, clock = _evaluator(reg)
+    _drive(ev, clock, reg, 400, _healthy)
+    assert ev.alerts_total == 0
+    assert ev.status()["active"] == []
+    st = ev.status()["slos"]
+    assert all(v["budget_remaining"] > 0.9 for v in st.values())
+
+
+def test_idle_service_is_not_out_of_budget():
+    """Zero traffic over every window = zero burn, not 0/0 panic."""
+    reg = MetricsRegistry()
+    ev, clock = _evaluator(reg)
+    _drive(ev, clock, reg, 50, lambda reg: None)
+    assert ev.alerts_total == 0 and ev.status()["active"] == []
+
+
+def test_chaos_fault_plan_drives_matching_alerts(tmp_path):
+    """The faults.py grammar drives the TP arms end to end: an injected
+    publish outage fires the publish SLO, an injected dispatch slowness
+    fires the latency SLO — each transition writes ONE bounded
+    post-mortem (r18 discipline: a budget that stays blown dumps once)
+    and a durable ledger entry, and the resolve edge writes the ledger
+    but no dump."""
+    reg = MetricsRegistry()
+    ledger = EventLog(str(tmp_path / "alerts.jsonl"))
+    ev, clock = _evaluator(reg, ledger=ledger)
+
+    def serve(reg):
+        reg.count("http_requests", 10)
+        for _ in range(10):
+            reg.count("publish_attempts")
+            if faults.check("publish") is not None:
+                reg.count("publish_failures")
+            slow = faults.check("dispatch") is not None
+            reg.observe("request_seconds", 1.0 if slow else 0.01)
+
+    tr = tracing.tracer()
+    prev = (tr.enabled, tr.dump_dir, tr.capacity, tr.max_dumps)
+    prev_written = tr.dumps_written
+    try:
+        tr.configure(enabled=True, dump_dir=str(tmp_path), max_dumps=8)
+        _drive(ev, clock, reg, 40, serve)            # clean warmup
+        assert ev.alerts_total == 0
+        with faults.use(faults.FaultPlan.parse("publish:fail@0-")):
+            _drive(ev, clock, reg, 40, serve)
+        assert "publish" in ev.status()["active"]
+        _drive(ev, clock, reg, 400, serve)           # recovery
+        assert "publish" not in ev.status()["active"]
+        with faults.use(faults.FaultPlan.parse("dispatch:hang(0.5)@0-")):
+            _drive(ev, clock, reg, 40, serve)
+        assert "latency" in ev.status()["active"]
+        _drive(ev, clock, reg, 400, serve)
+        dumps = [f for f in os.listdir(str(tmp_path)) if "slo_alert" in f]
+    finally:
+        tr.configure(enabled=prev[0], capacity=prev[2],
+                     max_dumps=prev[3])
+        tr.dump_dir = prev[1]     # configure(None) means "unchanged"
+        tr.dumps_written = prev_written
+
+    assert ev.alerts_total == 2
+    assert len(dumps) == 2                  # ONE per fire, not per tick
+    entries = ledger.read()
+    fires = [e for e in entries if e["event"] == "fire"]
+    resolves = [e for e in entries if e["event"] == "resolve"]
+    assert sorted(e["slo"] for e in fires) == ["latency", "publish"]
+    assert sorted(e["slo"] for e in resolves) == ["latency", "publish"]
+    # the alert counter rode the registry (per-spec labels)
+    snap = reg.export()["counters"]
+    assert snap[labeled("slo_alerts_total", slo="publish")] == 1.0
+    assert snap[labeled("slo_alerts_total", slo="latency")] == 1.0
+
+
+def test_evaluator_publishes_slo_gauges():
+    reg = MetricsRegistry()
+    ev, clock = _evaluator(reg)
+    _drive(ev, clock, reg, 20, _CLASS_FAULTS["availability"])
+    gauges = reg.export()["gauges"]
+    key = labeled("slo_alert_active", slo="availability")
+    assert gauges[key] == 1.0
+    assert gauges[labeled("slo_budget_remaining", slo="availability")] == 0.0
+    assert gauges[labeled("slo_burn_fast", slo="availability")] > 1.0
+    # the exposition carries them as rtpu_slo_* with no new plumbing
+    text = reg.render_prometheus()
+    assert 'rtpu_slo_alert_active{slo="availability"}' in text
+
+
+def test_tick_self_throttles_and_force_bypasses():
+    reg = MetricsRegistry()
+    clock = {"now": 100.0}
+    ev = SloEvaluator(reg, clock=lambda: clock["now"], min_tick_s=5.0,
+                      enabled_override=True)
+    assert ev.tick()
+    assert not ev.tick()                     # inside min_tick_s
+    assert ev.tick(force=True)
+    clock["now"] += 5.0
+    assert ev.tick()
+    assert ev.ticks == 3
+
+
+def test_disabled_evaluator_is_inert():
+    reg = MetricsRegistry()
+    ev = SloEvaluator(reg, enabled_override=False)
+    assert not ev.tick(force=True)
+    assert ev.status()["enabled"] is False and ev.ticks == 0
+
+
+def test_env_gate_and_scale_parse(monkeypatch):
+    assert obs_slo.enabled({}) is True
+    assert obs_slo.enabled({"RTPU_SLO": "0"}) is False
+    with pytest.raises(ValueError):
+        obs_slo.enabled({"RTPU_SLO": "yep"})         # strict: typos raise
+    assert obs_slo.window_scale({}) == 1.0
+    assert obs_slo.window_scale({"RTPU_SLO_SCALE": "0.25"}) == 0.25
+    with pytest.raises(ValueError):
+        obs_slo.window_scale({"RTPU_SLO_SCALE": "-1"})
+
+
+def test_gauge_sampling_can_be_disabled():
+    """The merged-evaluator mode: workers already folded their gauges
+    into the synthetic slo_sample_* counters; a supervisor sampling the
+    merged worker-labeled gauges would double-count."""
+    reg = MetricsRegistry()
+    reg.gauge("stream_lag", 99999.0)
+    ev, clock = _evaluator(reg, sample_gauges=False)
+    _drive(ev, clock, reg, 30, lambda reg: None)
+    assert labeled("slo_sample_total", slo="stream_lag") \
+        not in reg.export()["counters"]
+    assert "stream_lag" not in ev.status()["active"]
+
+
+def test_exit_block_shape():
+    reg = MetricsRegistry()
+    ev, clock = _evaluator(reg)
+    _drive(ev, clock, reg, 10, _healthy)
+    block = ev.exit_block()
+    assert set(block) == {"active", "alerts_total", "ticks",
+                          "budget_remaining"}
+    assert block["ticks"] == 10 and block["active"] == []
+    json.dumps(block)                        # exit JSON must serialize
+
+
+# ---------------------------------------------------------------------------
+# topology-wide: the supervisor evaluates the SAME specs over merged
+# exports; burn over the merge equals the per-worker sum by construction
+
+
+def test_supervisor_slo_over_merged_exports(tmp_path):
+    from reporter_tpu.distributed import aggregate
+    from reporter_tpu.distributed.supervisor import Supervisor
+
+    sup = Supervisor([], str(tmp_path), poll_s=0.02)
+    assert sup.slo is not None               # default-on gate
+    # swap in an injected-clock twin over the SAME merged source (the
+    # production evaluator's windows are wall-clock scaled)
+    clock = {"now": 0.0}
+    sup.slo = SloEvaluator(
+        sup.metrics, source=lambda: sup.merged_registry().export(),
+        ledger=EventLog(sup.alerts_path), clock=lambda: clock["now"],
+        scale=0.1, min_tick_s=0.0, sample_gauges=False,
+        enabled_override=True)
+
+    w1, w2 = MetricsRegistry(), MetricsRegistry()
+    for t in range(40):
+        clock["now"] += 1.0
+        for w in (w1, w2):
+            w.count("http_requests", 5)
+            if t >= 10:                      # fleet-wide outage begins
+                w.count("http_errors", 5)
+        aggregate.write_snapshot(
+            aggregate.snapshot_path(sup.snapshot_dir, "w1"),
+            w1, "w1", seq=t)
+        aggregate.write_snapshot(
+            aggregate.snapshot_path(sup.snapshot_dir, "w2"),
+            w2, "w2", seq=t)
+        sup.slo.tick()
+    assert "availability" in sup.slo.status()["active"]
+    # the health roll-up and the /slo face surface the merged verdict
+    assert "availability" in sup.health()["slo"]["alerting"]
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = status
+
+    body = json.loads(b"".join(sup.wsgi(
+        {"REQUEST_METHOD": "GET", "PATH_INFO": "/slo"},
+        start_response)))
+    assert captured["status"].startswith("200")
+    assert "availability" in body["active"]
+    # the fleet-wide ledger is durable in the workdir
+    assert any(e["slo"] == "availability"
+               for e in read_events(sup.alerts_path))
+
+
+def test_supervisor_events_ride_shared_eventlog(tmp_path):
+    """The r19 topology event log now goes through utils/eventlog.py:
+    same path, same shape, torn tails truncated at reopen."""
+    from reporter_tpu.distributed.supervisor import Supervisor
+
+    sup = Supervisor([], str(tmp_path), poll_s=0.02)
+    sup._event("synthetic_event", detail="x")
+    assert any(e["event"] == "synthetic_event" for e in sup.events())
+    with open(sup.events_path, "a") as f:
+        f.write('{"event": "torn')
+    sup2 = Supervisor([], str(tmp_path), poll_s=0.02)
+    assert all(e["event"] != "torn" for e in sup2.events())
+
+
+# ---------------------------------------------------------------------------
+# lease_reacquire: the r23 lease table feeds the SLO's latency series
+
+
+def test_lease_reacquire_gap_observed(tmp_path):
+    from reporter_tpu.distributed.lease import LeaseTable
+
+    reg = MetricsRegistry()
+    clock = {"now": 1000.0}
+    table = LeaseTable(str(tmp_path / "lease"), num_partitions=2,
+                       ttl_s=2.0, clock=lambda: clock["now"],
+                       metrics=reg)
+    assert table.acquire("a", 0) is not None
+    clock["now"] += 14.0                     # lease expires at +2 s
+    assert table.acquire("b", 0) is not None
+    counters = reg.export()["counters"]
+    assert counters.get("lease_reacquire_seconds_count") == 1.0
+    # the observed gap is expiry -> takeover (12 s dead air), bucketed
+    # above the spec's 10 s threshold
+    assert counters["lease_reacquire_seconds_total"] == pytest.approx(12.0)
+    # a renewal of one's own live lease observes nothing
+    assert table.acquire("b", 0) is not None
+    assert reg.export()["counters"]["lease_reacquire_seconds_count"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# leak gate: an installed evaluator must not bleed across tests
+
+
+def test_installed_evaluator_is_a_leak_until_restored():
+    from reporter_tpu.analysis import global_state
+
+    pre = global_state.snapshot()
+    ev = SloEvaluator(MetricsRegistry(), enabled_override=True)
+    obs_slo.install(ev)
+    try:
+        msgs = global_state.diff(pre, global_state.snapshot())
+        assert any("SLO evaluator" in m for m in msgs)
+        assert obs_slo.active() is ev
+    finally:
+        obs_slo.install(None)
+    assert not global_state.diff(pre, global_state.snapshot())
+    assert obs_slo.active() is None
+
+
+# ---------------------------------------------------------------------------
+# the --slo spec validator (analysis/slo_contract.py): seeded violation
+# + clean twin per rule, r14 pattern
+
+
+def _ratio(name="ok", **kw):
+    base = dict(bad=("http_errors",), total=("http_requests",))
+    base.update(kw)
+    return SloSpec(name, "ratio", kw.pop("objective", 0.999),
+                   bad=base["bad"], total=base["total"],
+                   windows=base.get("windows",
+                                    obs_slo.DEFAULT_WINDOWS))
+
+
+def test_slo_validator_committed_specs_are_clean():
+    from reporter_tpu.analysis.slo_contract import validate_specs
+
+    readme = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "README.md")
+    assert validate_specs(DEFAULT_SLOS, readme) == []
+
+
+@pytest.mark.parametrize("spec,rule", [
+    # objective out of (0,1)
+    (SloSpec("s", "ratio", 1.0, bad=("b",), total=("t",)), "slo-shape"),
+    # unknown kind
+    (SloSpec("s", "weird", 0.99), "slo-shape"),
+    # ratio without counters
+    (SloSpec("s", "ratio", 0.99), "slo-shape"),
+    # latency threshold off the HISTOGRAM_BUCKETS grid
+    (SloSpec("s", "latency", 0.99, series="x", threshold_s=0.3),
+     "slo-shape"),
+    # gauge without a ceiling
+    (SloSpec("s", "gauge", 0.99, gauge="g", ceiling=0.0), "slo-shape"),
+    # inverted window pair
+    (SloSpec("s", "ratio", 0.999, bad=("b",), total=("t",),
+             windows=((720.0, 60.0, 6.0),)), "slo-windows"),
+    # equal windows (fast < slow must be STRICT)
+    (SloSpec("s", "ratio", 0.999, bad=("b",), total=("t",),
+             windows=((60.0, 60.0, 6.0),)), "slo-windows"),
+    # no windows at all
+    (SloSpec("s", "ratio", 0.999, bad=("b",), total=("t",),
+             windows=()), "slo-windows"),
+    # threshold <= 1 alerts inside budget
+    (SloSpec("s", "ratio", 0.999, bad=("b",), total=("t",),
+             windows=((60.0, 720.0, 0.5),)), "slo-burn"),
+    # threshold above the maximum possible burn can never fire
+    (SloSpec("s", "ratio", 0.999, bad=("b",), total=("t",),
+             windows=((60.0, 720.0, 5000.0),)), "slo-burn"),
+])
+def test_slo_validator_seeded_violations(spec, rule):
+    from reporter_tpu.analysis.slo_contract import validate_specs
+
+    findings = validate_specs([spec])
+    assert any(f.rule == rule for f in findings), \
+        (rule, [str(f) for f in findings])
+    # clean twin: the same kind, well-formed, passes
+    twin = SloSpec("twin", "ratio", 0.999, bad=("b",), total=("t",))
+    assert validate_specs([twin]) == []
+
+
+def test_slo_validator_duplicate_names_and_missing_metrics(tmp_path):
+    from reporter_tpu.analysis.slo_contract import validate_specs
+
+    dup = [SloSpec("same", "ratio", 0.999, bad=("b",), total=("t",)),
+           SloSpec("same", "gauge", 0.99, gauge="g", ceiling=1.0)]
+    assert any(f.rule == "slo-shape" and "duplicate" in f.message
+               for f in validate_specs(dup))
+    readme = tmp_path / "README.md"
+    readme.write_text("<!-- metric-inventory:begin -->\n"
+                      "| `http_requests` | counter |\n"
+                      "<!-- metric-inventory:end -->\n")
+    spec = SloSpec("s", "ratio", 0.999, bad=("nonexistent_series",),
+                   total=("http_requests",))
+    findings = validate_specs([spec], str(readme))
+    assert any(f.rule == "slo-metric"
+               and "nonexistent_series" in f.message for f in findings)
+    # derived-suffix resolution: <base>_count rows resolve to the base
+    ok = SloSpec("s", "ratio", 0.999, bad=("http_requests_count",),
+                 total=("http_requests",))
+    assert validate_specs([ok], str(readme)) == []
+    # a README without the inventory block must fail loudly, not pass
+    # vacuously
+    bare = tmp_path / "BARE.md"
+    bare.write_text("no markers here\n")
+    assert any(f.rule == "slo-metric"
+               for f in validate_specs([spec], str(bare)))

@@ -39,6 +39,9 @@ def test_worker_consumes_broker_and_checkpoints(worker_env, capsys):
                  "--checkpoint", ckpt, "--max-steps", "3"]) == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["lag"] == 0 and out["reports"] > 0
+    # r24: the error-budget roll-up rides every exit report (RTPU_SLO
+    # defaults ON; a healthy short run alerts nothing)
+    assert out["slo"]["alerts_total"] == 0 and out["slo"]["active"] == []
 
     # restart: restores the checkpoint, nothing new to replay
     assert main(["--tiles", worker_env["tiles"], "--broker-dir", broker,
